@@ -1,0 +1,20 @@
+"""redisson_tpu — a TPU-native data-grid framework with Redisson's capabilities.
+
+Probabilistic data structures (HyperLogLog, BitSet, Bloom filter) execute as
+vectorized JAX/Pallas kernels over HBM-resident state; the rest of the
+Redisson object surface (maps, locks, queues, topics, ...) runs over a
+pluggable backend behind the same CommandExecutor seam the reference uses
+(see /root/reference `org/redisson/command/CommandExecutor.java`).
+
+Layers (mirroring SURVEY.md §7):
+  ops/       L0 kernel core — pure JAX, no I/O
+  store      L1 named-object store (name -> device state, slots)
+  executor   L2 async command executor + microbatching engine
+  models/    L3 object API (RHyperLogLog, RBitSet, RBloomFilter, RBatch, ...)
+  client     L4 facade + Config
+  parallel/  multi-chip sharding (mesh, collectives)
+"""
+
+from redisson_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,11 @@
+"""L0 kernel core: pure JAX ops, no I/O.
+
+Sub-modules:
+  u64      -- 64-bit unsigned arithmetic on uint32 (hi, lo) pairs; TPUs have
+              no native int64, so all hash math is built from 32-bit lanes.
+  hashing  -- vectorized MurmurHash3 x64 128 and xxHash64 over byte batches.
+  hll      -- HyperLogLog registers: insert / count / merge.
+  bitset   -- bit arrays with Redis SETBIT/BITCOUNT/BITOP semantics.
+  bloom    -- Bloom filter sizing + k-index double hashing.
+  crc16    -- Redis CRC16 key -> slot mapping (hashtag aware).
+"""

@@ -1,0 +1,153 @@
+"""Bloom filter math and kernels.
+
+Sizing follows the reference exactly (`RedissonBloomFilter.java:69-78`,
+Guava-style):
+
+    m = -n * ln(p) / ln(2)^2              optimal bit count
+    k = max(1, round(m / n * ln(2)))      optimal hash count
+
+Index derivation follows the same double-hashing family as the reference
+(`RedissonBloomFilter.java:116-131`) but is not bit-compatible with it: the
+reference seeds from xxHash-r39 + FarmHash-uo and walks
+`hash += (i%2==0 ? hash2 : hash1)`, masking the sign bit with
+`hash & Long.MAX_VALUE` before `% size`; we source h1/h2 from the two
+MurmurHash3 x64 128 halves (north-star spec) and walk the classic
+index_i = (h1 + i*h2) mod 2^64 mod m. Same uniformity and FPR math, but a
+bit-level import of a reference filter's bit array must re-add keys.
+
+Mod arithmetic on TPU (no int64): we reduce h1 and h2 mod m once via an
+exact unrolled shift-subtract (64 cheap vector steps), then walk the k
+indexes with conditional-subtract adds — so (h1 + i*h2) mod 2^64 mod m is
+computed without any 64-bit division. m is limited to 2^31 (or any power of
+two up to 2^32): large enough for every realistic filter (2^31 bits = 256 MiB
+unpacked cells = 2 GiB HBM); the reference's 2^32 cap
+(`RedissonBloomFilter.java:52`) is matched for power-of-two sizes.
+
+The bit array itself is an ops.bitset unpacked array; add = scatter-max over
+[N, k] indexes, contains = gather + per-key AND-reduce.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from redisson_tpu.ops import u64 as u
+from redisson_tpu.ops.u64 import U64
+
+MAX_SIZE = 1 << 32  # reference cap (power-of-two sizes only above 2^31)
+
+
+def optimal_num_of_bits(n: int, p: float) -> int:
+    """m = -n ln p / ln^2 2 (reference optimalNumOfBits)."""
+    if p == 0.0:
+        p = 5e-324  # Double.MIN_VALUE, as in the reference
+    return int(-n * math.log(p) / (math.log(2.0) ** 2))
+
+
+def optimal_num_of_hash_functions(n: int, m: int) -> int:
+    """k = max(1, round(m/n * ln 2)) (reference optimalNumOfHashFunctions)."""
+    return max(1, round(m / n * math.log(2.0)))
+
+
+def check_size(m: int) -> None:
+    if m <= 0:
+        raise ValueError("bloom size must be positive")
+    if m > MAX_SIZE:
+        raise ValueError(f"bloom size {m} exceeds cap {MAX_SIZE}")
+    if m > (1 << 31) and (m & (m - 1)) != 0:
+        raise ValueError("sizes above 2^31 must be powers of two on the TPU path")
+
+
+def _mod_u64(x: U64, m: int) -> jnp.ndarray:
+    """Exact x mod m as uint32. Requires m <= 2^31 or m a power of two."""
+    if (m & (m - 1)) == 0:
+        # Power of two <= 2^32: the low 32 bits carry the remainder.
+        return x.lo & jnp.uint32(m - 1)
+    # Binary long division, unrolled: r = (r*2 + bit_i) cond-sub m.
+    # r < m < 2^31 throughout, so r*2+1 < 2^32 never overflows uint32.
+    r = jnp.zeros_like(x.lo)
+    mm = jnp.uint32(m)
+    for i in range(63, -1, -1):
+        bit = (x.hi >> (i - 32)) & 1 if i >= 32 else (x.lo >> i) & 1
+        r = (r << 1) | bit
+        r = jnp.where(r >= mm, r - mm, r)
+    return r
+
+
+def _add_mod(a: jnp.ndarray, b: jnp.ndarray, m: int) -> jnp.ndarray:
+    """(a + b) mod m for a, b already reduced mod m."""
+    s = a + b
+    if m == (1 << 32):
+        return s  # natural uint32 wraparound
+    # check_size admits no non-power-of-two m above 2^31, and 2^32 returned
+    # above, so plain conditional-subtract covers every remaining case.
+    mm = jnp.uint32(m)
+    return jnp.where(s >= mm, s - mm, s)
+
+
+def indexes(h1: U64, h2: U64, k: int, m: int) -> jnp.ndarray:
+    """[N] hash pairs -> [N, k] bit indexes via double hashing mod m.
+
+    Semantics: index_i = ((h1 + i*h2) mod 2^64) mod m. The 64-bit accumulator
+    wraps, and for non-power-of-two m a wrap shifts the residue by
+    -(2^64 mod m); we track the carry of the 64-bit add and apply that
+    correction so the reduced walk stays exact without ever re-running the
+    long division.
+    """
+    check_size(m)
+    h1m = _mod_u64(h1, m)
+    h2m = _mod_u64(h2, m)
+    wrap_corr = (1 << 64) % m  # 0 for power-of-two m
+    out = [h1m]
+    acc64 = h1
+    acc = h1m
+    for _ in range(k - 1):
+        nxt64 = u.add(acc64, h2)
+        wrapped = u.lt(nxt64, acc64)  # carry out of bit 63
+        acc = _add_mod(acc, h2m, m)
+        if wrap_corr:
+            acc = _sub_mod(acc, wrap_corr, m, where=wrapped)
+        acc64 = nxt64
+        out.append(acc)
+    stacked = jnp.stack(out, axis=-1)
+    return stacked.astype(jnp.int32) if m <= (1 << 31) else stacked
+
+
+def _sub_mod(a: jnp.ndarray, c: int, m: int, where) -> jnp.ndarray:
+    """(a - c) mod m applied only where the mask holds (a < m, 0 <= c < m)."""
+    mm = jnp.uint32(m)
+    cc = jnp.uint32(c)
+    sub = jnp.where(a >= cc, a - cc, a + (mm - cc))
+    return jnp.where(where, sub, a)
+
+
+def add(bits: jnp.ndarray, idx: jnp.ndarray):
+    """Set all [N, k] indexes; returns (new_bits, added_mask[N]).
+
+    added_mask is True where at least one of the key's bits was unset at
+    *batch start* — the reference add() contract (true iff the filter
+    changed) evaluated against the pre-batch state. Duplicates of one key
+    within a single batch therefore all report True; callers that count
+    distinct insertions from this mask must dedupe the batch first (the L3
+    object layer documents the same batch-visibility rule for ordering).
+    """
+    flat = idx.reshape(-1)
+    old = bits[flat].reshape(idx.shape)
+    new_bits = bits.at[flat].max(jnp.uint8(1))
+    return new_bits, jnp.any(old == 0, axis=-1)
+
+
+def contains(bits: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """[N, k] indexes -> [N] bool membership."""
+    flat = idx.reshape(-1)
+    return jnp.all(bits[flat].reshape(idx.shape) == 1, axis=-1)
+
+
+def count_estimate(bit_count, size: int, hash_iterations: int):
+    """Estimated cardinality from BITCOUNT (reference count(),
+    RedissonBloomFilter.java:188-199): -m/k * ln(1 - X/m)."""
+    x = jnp.asarray(bit_count, jnp.float32)
+    frac = jnp.clip(x / size, 0.0, 1.0 - 1e-7)
+    return -(size / hash_iterations) * jnp.log1p(-frac)

@@ -1,0 +1,50 @@
+"""Redis CRC16 (CCITT) key -> hash-slot mapping.
+
+Reimplements the reference's slot routing for interop/compat:
+`connection/CRC16.java` (polynomial 0x1021 lookup table) and
+`cluster/ClusterConnectionManager.java:543-558` (slot = CRC16(key or
+{hashtag}) % 16384). Host-side python — slot routing happens at op-ingest
+time, before any device work.
+"""
+
+from __future__ import annotations
+
+MAX_SLOT = 16384
+
+_TABLE = []
+
+
+def _build_table():
+    for i in range(256):
+        crc = i << 8
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x1021) if (crc & 0x8000) else (crc << 1)
+            crc &= 0xFFFF
+        _TABLE.append(crc)
+
+
+_build_table()
+
+
+def crc16(data: bytes) -> int:
+    crc = 0
+    for b in data:
+        crc = ((crc << 8) & 0xFFFF) ^ _TABLE[((crc >> 8) ^ b) & 0xFF]
+    return crc
+
+
+def hashtag(key: bytes) -> bytes:
+    """Extract the {hashtag} section if present and non-empty (Redis rules)."""
+    start = key.find(b"{")
+    if start != -1:
+        end = key.find(b"}", start + 1)
+        if end != -1 and end != start + 1:
+            return key[start + 1 : end]
+    return key
+
+
+def key_slot(key) -> int:
+    """CRC16(hashtag(key)) % 16384, the cluster routing function."""
+    if isinstance(key, str):
+        key = key.encode()
+    return crc16(hashtag(key)) % MAX_SLOT

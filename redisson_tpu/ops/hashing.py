@@ -1,0 +1,277 @@
+"""Vectorized hash kernels over batched keys.
+
+MurmurHash3 x64 128 is the workhorse (per the north-star spec: both HLL
+bucketing and Bloom double-hashing derive from its two 64-bit halves).
+xxHash64 is provided for parity with the reference's Bloom hash pair
+(`RedissonBloomFilter.java:117-118` uses xxHash + FarmHash; we standardize on
+Murmur128 halves and keep xxHash64 available for interop/digest paths, see
+`misc/Hash.java:29-40` in the reference).
+
+Key batches are `[N, W]` uint8 buffers, zero-padded beyond per-key `lengths`
+([N] int32). All hash math runs on uint32 lane pairs (ops.u64) — no native
+int64 exists on TPU. Per-key variable length is handled branch-free:
+
+  * full 16-byte blocks are processed unrolled over ceil(W/16) steps with a
+    per-key `i < nblocks` select;
+  * the tail is gathered at each key's `nblocks*16` offset; because buffers
+    are zero beyond `lengths`, the canonical Murmur tail switch collapses to
+    an unconditional mix (zero bytes are xor-identity, and a zero tail word
+    mixes to zero).
+
+This trades some gather traffic for fully static shapes — one compiled
+program per (N, W) bucket, which the L2 executor guarantees via
+pad-to-bucket batching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import u64 as u
+from redisson_tpu.ops.u64 import U64
+
+_U32 = jnp.uint32
+
+# MurmurHash3 x64 128 constants.
+_C1 = 0x87C37B91114253D5
+_C2 = 0x4CF5AD432745937F
+
+# xxHash64 primes.
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def _le32(b) -> jnp.ndarray:
+    """[..., 4] uint8 -> uint32 little-endian."""
+    b = b.astype(_U32)
+    return b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+
+
+def _le64(b) -> U64:
+    """[..., 8] uint8 -> U64 little-endian."""
+    return U64(_le32(b[..., 4:8]), _le32(b[..., 0:4]))
+
+
+def fmix64(k: U64) -> U64:
+    k = u.xor(k, u.shr(k, 33))
+    k = u.mul(k, u.const(0xFF51AFD7ED558CCD))
+    k = u.xor(k, u.shr(k, 33))
+    k = u.mul(k, u.const(0xC4CEB9FE1A85EC53))
+    k = u.xor(k, u.shr(k, 33))
+    return k
+
+
+def _mm_mix_k1(k1: U64) -> U64:
+    k1 = u.mul(k1, u.const(_C1))
+    k1 = u.rotl(k1, 31)
+    return u.mul(k1, u.const(_C2))
+
+
+def _mm_mix_k2(k2: U64) -> U64:
+    k2 = u.mul(k2, u.const(_C2))
+    k2 = u.rotl(k2, 33)
+    return u.mul(k2, u.const(_C1))
+
+
+def _mm_body(h1: U64, h2: U64, k1: U64, k2: U64):
+    h1 = u.xor(h1, _mm_mix_k1(k1))
+    h1 = u.rotl(h1, 27)
+    h1 = u.add(h1, h2)
+    h1 = u.add(u.mul(h1, u.const(5)), u.const(0x52DCFB2F))
+    h2 = u.xor(h2, _mm_mix_k2(k2))
+    h2 = u.rotl(h2, 31)
+    h2 = u.add(h2, h1)
+    h2 = u.add(u.mul(h2, u.const(5)), u.const(0x38495AB5))
+    return h1, h2
+
+
+def _mm_final(h1: U64, h2: U64, lengths) -> tuple[U64, U64]:
+    ln = u.from_u32(lengths.astype(_U32))
+    h1 = u.xor(h1, ln)
+    h2 = u.xor(h2, ln)
+    h1 = u.add(h1, h2)
+    h2 = u.add(h2, h1)
+    h1 = fmix64(h1)
+    h2 = fmix64(h2)
+    h1 = u.add(h1, h2)
+    h2 = u.add(h2, h1)
+    return h1, h2
+
+
+def murmur3_x64_128(data: jnp.ndarray, lengths: jnp.ndarray, seed: int = 0):
+    """Batched MurmurHash3 x64 128.
+
+    Args:
+      data: [N, W] uint8, zero beyond each key's length (enforced by mask).
+      lengths: [N] int32 key lengths, each <= W.
+      seed: static python int seed.
+
+    Returns:
+      (h1, h2): two U64 batches of shape [N].
+    """
+    n, w = data.shape
+    max_blocks = w // 16
+    # Zero-pad so the tail gather at offset nblocks*16 is always in bounds
+    # and reads zeros beyond the logical buffer.
+    wp = max_blocks * 16 + 16
+    buf = jnp.zeros((n, wp), jnp.uint8).at[:, :w].set(data)
+    # Defensive: zero anything past the declared length so callers cannot
+    # perturb the hash with padding garbage.
+    pos = jnp.arange(wp, dtype=jnp.int32)[None, :]
+    buf = jnp.where(pos < lengths[:, None], buf, 0)
+
+    nblocks = (lengths // 16).astype(jnp.int32)
+    h1 = u.full((n,), seed)
+    h2 = u.full((n,), seed)
+    for i in range(max_blocks):
+        block = buf[:, 16 * i : 16 * i + 16]
+        k1 = _le64(block[:, 0:8])
+        k2 = _le64(block[:, 8:16])
+        h1n, h2n = _mm_body(h1, h2, k1, k2)
+        active = i < nblocks
+        h1 = u.where(active, h1n, h1)
+        h2 = u.where(active, h2n, h2)
+
+    # Tail: 16 zero-padded bytes at each key's block end.
+    tidx = nblocks[:, None] * 16 + jnp.arange(16, dtype=jnp.int32)[None, :]
+    tail = jnp.take_along_axis(buf, tidx, axis=1)
+    k1 = _le64(tail[:, 0:8])
+    k2 = _le64(tail[:, 8:16])
+    # Canonical tail switch == unconditional mix given zero padding:
+    # a zero k mixes to zero and xor-ing zero is the identity.
+    h2 = u.xor(h2, _mm_mix_k2(k2))
+    h1 = u.xor(h1, _mm_mix_k1(k1))
+    return _mm_final(h1, h2, lengths)
+
+
+def murmur3_x64_128_u64(x: U64, seed: int = 0):
+    """Fast path: hash each 64-bit value as its 8-byte little-endian encoding.
+
+    Equivalent to murmur3_x64_128 on the 8-byte LE buffer of x — the entire
+    key is the tail (no body blocks), so this is a handful of vector ops.
+    """
+    n_shape = jnp.shape(x.lo)
+    h1 = u.full(n_shape, seed)
+    h2 = u.full(n_shape, seed)
+    h1 = u.xor(h1, _mm_mix_k1(x))
+    lengths = jnp.full(n_shape, 8, jnp.int32)
+    return _mm_final(h1, h2, lengths)
+
+
+def murmur3_x64_128_u32(x: jnp.ndarray, seed: int = 0):
+    """Fast path for 4-byte LE integer keys."""
+    k = u.from_u32(x)
+    n_shape = jnp.shape(k.lo)
+    h1 = u.full(n_shape, seed)
+    h2 = u.full(n_shape, seed)
+    h1 = u.xor(h1, _mm_mix_k1(k))
+    lengths = jnp.full(n_shape, 4, jnp.int32)
+    return _mm_final(h1, h2, lengths)
+
+
+# ---------------------------------------------------------------------------
+# xxHash64
+# ---------------------------------------------------------------------------
+
+
+def _xx_round(acc: U64, lane: U64) -> U64:
+    acc = u.add(acc, u.mul(lane, u.const(_P2)))
+    acc = u.rotl(acc, 31)
+    return u.mul(acc, u.const(_P1))
+
+
+def _xx_merge_round(h: U64, v: U64) -> U64:
+    h = u.xor(h, _xx_round(u.full(jnp.shape(v.lo), 0), v))
+    return u.add(u.mul(h, u.const(_P1)), u.const(_P4))
+
+
+def xxhash64(data: jnp.ndarray, lengths: jnp.ndarray, seed: int = 0) -> U64:
+    """Batched xxHash64 over [N, W] zero-padded uint8 keys."""
+    n, w = data.shape
+    max_stripes = w // 32
+    wp = max_stripes * 32 + 32
+    buf = jnp.zeros((n, wp), jnp.uint8).at[:, :w].set(data)
+    pos = jnp.arange(wp, dtype=jnp.int32)[None, :]
+    buf = jnp.where(pos < lengths[:, None], buf, 0)
+
+    nstripes = jnp.where(lengths >= 32, lengths // 32, 0).astype(jnp.int32)
+
+    v1 = u.full((n,), (seed + _P1 + _P2) & ((1 << 64) - 1))
+    v2 = u.full((n,), (seed + _P2) & ((1 << 64) - 1))
+    v3 = u.full((n,), seed & ((1 << 64) - 1))
+    v4 = u.full((n,), (seed - _P1) & ((1 << 64) - 1))
+    for i in range(max_stripes):
+        stripe = buf[:, 32 * i : 32 * i + 32]
+        active = i < nstripes
+        for j, v in enumerate((v1, v2, v3, v4)):
+            lane = _le64(stripe[:, 8 * j : 8 * j + 8])
+            vn = _xx_round(v, lane)
+            if j == 0:
+                v1 = u.where(active, vn, v1)
+            elif j == 1:
+                v2 = u.where(active, vn, v2)
+            elif j == 2:
+                v3 = u.where(active, vn, v3)
+            else:
+                v4 = u.where(active, vn, v4)
+
+    h_long = u.add(
+        u.add(u.rotl(v1, 1), u.rotl(v2, 7)), u.add(u.rotl(v3, 12), u.rotl(v4, 18))
+    )
+    for v in (v1, v2, v3, v4):
+        h_long = _xx_merge_round(h_long, v)
+    h_short = u.full((n,), (seed + _P5) & ((1 << 64) - 1))
+    h = u.where(lengths >= 32, h_long, h_short)
+    h = u.add(h, u.from_u32(lengths.astype(_U32)))
+
+    # Remaining bytes after the stripes: r in [0, 32).
+    base = nstripes * 32
+    r = lengths - base
+    n8 = r // 8  # 0..3 full 8-byte chunks
+    for i in range(3):
+        off = base + 8 * i
+        idx = off[:, None] + jnp.arange(8, dtype=jnp.int32)[None, :]
+        lane = _le64(jnp.take_along_axis(buf, idx, axis=1))
+        hn = u.xor(h, _xx_round(u.full((n,), 0), lane))
+        hn = u.add(u.mul(u.rotl(hn, 27), u.const(_P1)), u.const(_P4))
+        h = u.where(i < n8, hn, h)
+
+    base4 = base + n8 * 8
+    has4 = (lengths - base4) >= 4
+    idx4 = base4[:, None] + jnp.arange(4, dtype=jnp.int32)[None, :]
+    lane32 = u.from_u32(_le32(jnp.take_along_axis(buf, idx4, axis=1)))
+    hn = u.xor(h, u.mul(lane32, u.const(_P1)))
+    hn = u.add(u.mul(u.rotl(hn, 23), u.const(_P2)), u.const(_P3))
+    h = u.where(has4, hn, h)
+
+    base1 = base4 + jnp.where(has4, 4, 0)
+    for j in range(3):
+        off = base1 + j
+        byte = jnp.take_along_axis(buf, off[:, None], axis=1)[:, 0]
+        lane = u.from_u32(byte.astype(_U32))
+        hn = u.xor(h, u.mul(lane, u.const(_P5)))
+        hn = u.mul(u.rotl(hn, 11), u.const(_P1))
+        h = u.where(off < lengths, hn, h)
+
+    h = u.xor(h, u.shr(h, 33))
+    h = u.mul(h, u.const(_P2))
+    h = u.xor(h, u.shr(h, 29))
+    h = u.mul(h, u.const(_P3))
+    h = u.xor(h, u.shr(h, 32))
+    return h
+
+
+@functools.partial(jax.jit, static_argnames=("seed",))
+def murmur3_x64_128_jit(data, lengths, seed: int = 0):
+    return murmur3_x64_128(data, lengths, seed)
+
+
+@functools.partial(jax.jit, static_argnames=("seed",))
+def xxhash64_jit(data, lengths, seed: int = 0):
+    return xxhash64(data, lengths, seed)

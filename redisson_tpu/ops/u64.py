@@ -1,0 +1,199 @@
+"""Unsigned 64-bit arithmetic on uint32 (hi, lo) pairs.
+
+TPUs have no native 64-bit integer units; enabling jax x64 would make XLA
+emulate int64 lane-by-lane anyway. We instead keep every value as a pair of
+uint32 lanes, which maps directly onto the 8x128 VPU, and implement exactly
+the handful of operations the hash functions need (add, xor, mul mod 2^64,
+rotations, shifts, ctz/clz).
+
+All functions are shape-polymorphic: hi/lo may be scalars or arrays of any
+(matching) shape. Everything here is traceable under jit.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+_U32 = jnp.uint32
+MASK32 = 0xFFFFFFFF
+
+
+class U64(NamedTuple):
+    """A 64-bit unsigned value as two uint32 lanes.
+
+    Indexing/slicing applies to the *batch* dimensions (both lanes at once):
+    `h[:100]` is the first 100 values, not the hi lane. Use `.hi`/`.lo` for
+    the lanes.
+    """
+
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+    @property
+    def shape(self):
+        return jnp.shape(self.lo)
+
+    def __getitem__(self, key) -> "U64":
+        return U64(self.hi[key], self.lo[key])
+
+    def reshape(self, *shape) -> "U64":
+        return U64(self.hi.reshape(*shape), self.lo.reshape(*shape))
+
+
+U64Like = Union[U64, int]
+
+
+def const(value: int) -> U64:
+    """Build a scalar U64 from a python int (taken mod 2^64)."""
+    value &= (1 << 64) - 1
+    return U64(jnp.asarray((value >> 32) & MASK32, _U32), jnp.asarray(value & MASK32, _U32))
+
+
+def _coerce(x: U64Like) -> U64:
+    if isinstance(x, U64):
+        return x
+    return const(x)
+
+
+def from_u32(x) -> U64:
+    x = jnp.asarray(x, _U32)
+    return U64(jnp.zeros_like(x), x)
+
+
+def from_parts(hi, lo) -> U64:
+    return U64(jnp.asarray(hi, _U32), jnp.asarray(lo, _U32))
+
+
+def full(shape, value: int) -> U64:
+    value &= (1 << 64) - 1
+    return U64(
+        jnp.full(shape, (value >> 32) & MASK32, _U32),
+        jnp.full(shape, value & MASK32, _U32),
+    )
+
+
+def to_python(x: U64):
+    """Host-side: convert to python int(s) (numpy object array for vectors)."""
+    import numpy as np
+
+    hi = np.asarray(x.hi, dtype=np.uint64)
+    lo = np.asarray(x.lo, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+def xor(a: U64Like, b: U64Like) -> U64:
+    a, b = _coerce(a), _coerce(b)
+    return U64(a.hi ^ b.hi, a.lo ^ b.lo)
+
+
+def and_(a: U64Like, b: U64Like) -> U64:
+    a, b = _coerce(a), _coerce(b)
+    return U64(a.hi & b.hi, a.lo & b.lo)
+
+
+def or_(a: U64Like, b: U64Like) -> U64:
+    a, b = _coerce(a), _coerce(b)
+    return U64(a.hi | b.hi, a.lo | b.lo)
+
+
+def add(a: U64Like, b: U64Like) -> U64:
+    a, b = _coerce(a), _coerce(b)
+    lo = a.lo + b.lo
+    carry = (lo < a.lo).astype(_U32)
+    return U64(a.hi + b.hi + carry, lo)
+
+
+def shl(a: U64, n: int) -> U64:
+    """Left shift by a static amount n in [0, 64)."""
+    if n == 0:
+        return a
+    if n >= 32:
+        return U64(a.lo << (n - 32) if n > 32 else a.lo, jnp.zeros_like(a.lo))
+    return U64((a.hi << n) | (a.lo >> (32 - n)), a.lo << n)
+
+
+def shr(a: U64, n: int) -> U64:
+    """Logical right shift by a static amount n in [0, 64)."""
+    if n == 0:
+        return a
+    if n >= 32:
+        return U64(jnp.zeros_like(a.hi), a.hi >> (n - 32) if n > 32 else a.hi)
+    return U64(a.hi >> n, (a.lo >> n) | (a.hi << (32 - n)))
+
+
+def rotl(a: U64, n: int) -> U64:
+    n &= 63
+    if n == 0:
+        return a
+    return or_(shl(a, n), shr(a, 64 - n))
+
+
+def mul32(a, b) -> U64:
+    """Full 64-bit product of two uint32 arrays."""
+    a = jnp.asarray(a, _U32)
+    b = jnp.asarray(b, _U32)
+    al, ah = a & 0xFFFF, a >> 16
+    bl, bh = b & 0xFFFF, b >> 16
+    ll = al * bl
+    lh = al * bh
+    hl = ah * bl
+    hh = ah * bh
+    # mid <= (2^16-1)^2 + 2*(2^16-1) = 2^32 - 1: no overflow.
+    mid = lh + (ll >> 16) + (hl & 0xFFFF)
+    lo = (mid << 16) | (ll & 0xFFFF)
+    hi = hh + (hl >> 16) + (mid >> 16)
+    return U64(hi, lo)
+
+
+def mul(a: U64Like, b: U64Like) -> U64:
+    """Product mod 2^64."""
+    a, b = _coerce(a), _coerce(b)
+    p = mul32(a.lo, b.lo)
+    hi = p.hi + a.lo * b.hi + a.hi * b.lo
+    return U64(hi, p.lo)
+
+
+def eq(a: U64Like, b: U64Like):
+    a, b = _coerce(a), _coerce(b)
+    return (a.hi == b.hi) & (a.lo == b.lo)
+
+
+def lt(a: U64Like, b: U64Like):
+    a, b = _coerce(a), _coerce(b)
+    return (a.hi < b.hi) | ((a.hi == b.hi) & (a.lo < b.lo))
+
+
+def where(pred, a: U64, b: U64) -> U64:
+    return U64(jnp.where(pred, a.hi, b.hi), jnp.where(pred, a.lo, b.lo))
+
+
+def ctz32(x):
+    """Count trailing zeros of uint32; returns 32 for x == 0."""
+    x = jnp.asarray(x, _U32)
+    return lax.population_count(~x & (x - 1)).astype(jnp.int32)
+
+
+def clz32(x):
+    x = jnp.asarray(x, _U32)
+    return lax.clz(x).astype(jnp.int32)
+
+
+def ctz(a: U64):
+    """Count trailing zeros of a 64-bit value; 64 when zero."""
+    lo_z = ctz32(a.lo)
+    hi_z = ctz32(a.hi)
+    return jnp.where(a.lo != 0, lo_z, 32 + hi_z)
+
+
+def clz(a: U64):
+    """Count leading zeros of a 64-bit value; 64 when zero."""
+    hi_z = clz32(a.hi)
+    lo_z = clz32(a.lo)
+    return jnp.where(a.hi != 0, hi_z, 32 + lo_z)
+
+
+def popcount(a: U64):
+    return (lax.population_count(a.hi) + lax.population_count(a.lo)).astype(jnp.int32)

@@ -1,0 +1,29 @@
+"""Test config: force a virtual 8-device CPU platform BEFORE any backend
+initializes.
+
+Two layers of forcing are required in this image:
+  * env vars (JAX_PLATFORMS / XLA_FLAGS) for a plain environment;
+  * jax.config.update("jax_platforms", ...) because the axon sitecustomize
+    registers the TPU plugin at interpreter startup and explicitly sets
+    jax_platforms="axon,cpu", which overrides the env var. Without this
+    override every pytest process dials the single TPU tunnel and serializes
+    behind whichever process holds it (observed as silent multi-minute
+    hangs at jax.devices()).
+
+Multi-chip sharding is tested on the virtual CPU mesh; the driver separately
+dry-runs the sharded path via __graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert all(d.platform == "cpu" for d in jax.devices()), (
+    "a backend initialized before conftest could force CPU"
+)

@@ -1,0 +1,137 @@
+"""Pure-python golden implementations used to validate the JAX kernels.
+
+Written independently from the canonical algorithm specs (smhasher for
+MurmurHash3 x64 128, the xxHash spec for xxh64) — slow, scalar, obvious.
+"""
+
+MASK64 = (1 << 64) - 1
+
+
+def _rotl64(x, n):
+    return ((x << n) | (x >> (64 - n))) & MASK64
+
+
+def _fmix64(k):
+    k ^= k >> 33
+    k = (k * 0xFF51AFD7ED558CCD) & MASK64
+    k ^= k >> 33
+    k = (k * 0xC4CEB9FE1A85EC53) & MASK64
+    k ^= k >> 33
+    return k
+
+
+def murmur3_x64_128(data: bytes, seed: int = 0):
+    c1 = 0x87C37B91114253D5
+    c2 = 0x4CF5AD432745937F
+    length = len(data)
+    nblocks = length // 16
+    h1 = h2 = seed & MASK64
+
+    for i in range(nblocks):
+        k1 = int.from_bytes(data[16 * i : 16 * i + 8], "little")
+        k2 = int.from_bytes(data[16 * i + 8 : 16 * i + 16], "little")
+        k1 = (k1 * c1) & MASK64
+        k1 = _rotl64(k1, 31)
+        k1 = (k1 * c2) & MASK64
+        h1 ^= k1
+        h1 = _rotl64(h1, 27)
+        h1 = (h1 + h2) & MASK64
+        h1 = (h1 * 5 + 0x52DCFB2F) & MASK64
+        k2 = (k2 * c2) & MASK64
+        k2 = _rotl64(k2, 33)
+        k2 = (k2 * c1) & MASK64
+        h2 ^= k2
+        h2 = _rotl64(h2, 31)
+        h2 = (h2 + h1) & MASK64
+        h2 = (h2 * 5 + 0x38495AB5) & MASK64
+
+    tail = data[nblocks * 16 :]
+    k1 = k2 = 0
+    for i in range(len(tail)):
+        if i < 8:
+            k1 |= tail[i] << (8 * i)
+        else:
+            k2 |= tail[i] << (8 * (i - 8))
+    if len(tail) > 8:
+        k2 = (k2 * c2) & MASK64
+        k2 = _rotl64(k2, 33)
+        k2 = (k2 * c1) & MASK64
+        h2 ^= k2
+    if len(tail) > 0:
+        k1 = (k1 * c1) & MASK64
+        k1 = _rotl64(k1, 31)
+        k1 = (k1 * c2) & MASK64
+        h1 ^= k1
+
+    h1 ^= length
+    h2 ^= length
+    h1 = (h1 + h2) & MASK64
+    h2 = (h2 + h1) & MASK64
+    h1 = _fmix64(h1)
+    h2 = _fmix64(h2)
+    h1 = (h1 + h2) & MASK64
+    h2 = (h2 + h1) & MASK64
+    return h1, h2
+
+
+_P1 = 0x9E3779B185EBCA87
+_P2 = 0xC2B2AE3D27D4EB4F
+_P3 = 0x165667B19E3779F9
+_P4 = 0x85EBCA77C2B2AE63
+_P5 = 0x27D4EB2F165667C5
+
+
+def _xx_round(acc, lane):
+    acc = (acc + lane * _P2) & MASK64
+    acc = _rotl64(acc, 31)
+    return (acc * _P1) & MASK64
+
+
+def xxhash64(data: bytes, seed: int = 0):
+    length = len(data)
+    p = 0
+    if length >= 32:
+        v1 = (seed + _P1 + _P2) & MASK64
+        v2 = (seed + _P2) & MASK64
+        v3 = seed & MASK64
+        v4 = (seed - _P1) & MASK64
+        while p + 32 <= length:
+            for i, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[p + 8 * i : p + 8 * i + 8], "little")
+                nv = _xx_round(v, lane)
+                if i == 0:
+                    v1 = nv
+                elif i == 1:
+                    v2 = nv
+                elif i == 2:
+                    v3 = nv
+                else:
+                    v4 = nv
+            p += 32
+        h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)) & MASK64
+        for v in (v1, v2, v3, v4):
+            h ^= _xx_round(0, v)
+            h = (h * _P1 + _P4) & MASK64
+    else:
+        h = (seed + _P5) & MASK64
+    h = (h + length) & MASK64
+    while p + 8 <= length:
+        lane = int.from_bytes(data[p : p + 8], "little")
+        h ^= _xx_round(0, lane)
+        h = (_rotl64(h, 27) * _P1 + _P4) & MASK64
+        p += 8
+    if p + 4 <= length:
+        lane = int.from_bytes(data[p : p + 4], "little")
+        h ^= (lane * _P1) & MASK64
+        h = (_rotl64(h, 23) * _P2 + _P3) & MASK64
+        p += 4
+    while p < length:
+        h ^= (data[p] * _P5) & MASK64
+        h = (_rotl64(h, 11) * _P1) & MASK64
+        p += 1
+    h ^= h >> 33
+    h = (h * _P2) & MASK64
+    h ^= h >> 29
+    h = (h * _P3) & MASK64
+    h ^= h >> 32
+    return h

@@ -1,0 +1,29 @@
+"""Shared test helpers."""
+
+import numpy as np
+
+from redisson_tpu.ops import hashing, u64 as u
+
+
+def pack_u64(vals):
+    """Python ints -> U64 batch."""
+    return u.U64(
+        np.array([(v >> 32) & 0xFFFFFFFF for v in vals], np.uint32),
+        np.array([v & 0xFFFFFFFF for v in vals], np.uint32),
+    )
+
+
+def hash_ints(vals):
+    """Hash python ints via the murmur3 8-byte-LE fast path -> (h1, h2)."""
+    return hashing.murmur3_x64_128_u64(pack_u64(vals))
+
+
+def encode_keys(keys, width):
+    """List of bytes -> ([N, width] uint8 zero-padded, [N] int32 lengths)."""
+    n = len(keys)
+    data = np.zeros((n, width), np.uint8)
+    lengths = np.zeros((n,), np.int32)
+    for i, k in enumerate(keys):
+        data[i, : len(k)] = np.frombuffer(k, np.uint8)
+        lengths[i] = len(k)
+    return data, lengths

@@ -1,0 +1,63 @@
+import numpy as np
+
+from redisson_tpu.ops import bitset
+
+
+def test_set_get_clear_roundtrip():
+    bits = bitset.make(1000)
+    idx = np.array([0, 5, 999, 5, 123], np.int32)
+    bits, old = bitset.set_bits(bits, idx)
+    assert old.tolist() == [0, 0, 0, 0, 0]
+    assert bitset.get_bits(bits, np.array([0, 5, 123, 999, 7], np.int32)).tolist() == [1, 1, 1, 1, 0]
+    bits2, old2 = bitset.set_bits(bits, np.array([5, 7], np.int32))
+    assert old2.tolist() == [1, 0]
+    bits3, old3 = bitset.clear_bits(bits2, np.array([5, 11], np.int32))
+    assert old3.tolist() == [1, 0]
+    assert int(bitset.get_bits(bits3, np.array([5], np.int32))[0]) == 0
+
+
+def test_cardinality_length_bitpos():
+    bits = bitset.make(256)
+    bits, _ = bitset.set_bits(bits, np.array([3, 100, 200], np.int32))
+    assert int(bitset.cardinality(bits)) == 3
+    assert int(bitset.length(bits)) == 201
+    assert int(bitset.bitpos(bits, 1)) == 3
+    assert int(bitset.bitpos(bits, 0)) == 0
+    empty = bitset.make(16)
+    assert int(bitset.length(empty)) == 0
+    assert int(bitset.bitpos(empty, 1)) == -1
+    assert int(bitset.cardinality(empty)) == 0
+
+
+def test_set_range():
+    bits = bitset.make(64)
+    bits = bitset.set_range(bits, 10, 20, True)
+    assert int(bitset.cardinality(bits)) == 10
+    assert int(bitset.bitpos(bits, 1)) == 10
+    bits = bitset.set_range(bits, 15, 18, False)
+    assert np.asarray(bits)[14:19].tolist() == [1, 0, 0, 0, 1]
+
+
+def test_bitops():
+    a = bitset.make(32)
+    b = bitset.make(32)
+    a, _ = bitset.set_bits(a, np.array([1, 2, 3], np.int32))
+    b, _ = bitset.set_bits(b, np.array([2, 3, 4], np.int32))
+    assert np.flatnonzero(np.asarray(bitset.bitop_and(a, b))).tolist() == [2, 3]
+    assert np.flatnonzero(np.asarray(bitset.bitop_or(a, b))).tolist() == [1, 2, 3, 4]
+    assert np.flatnonzero(np.asarray(bitset.bitop_xor(a, b))).tolist() == [1, 4]
+    assert int(bitset.cardinality(bitset.bitop_not(a))) == 29
+
+
+def test_pack_unpack_redis_layout():
+    # Redis SETBIT 0 -> MSB of byte 0: value b'\x80'.
+    bits = bitset.make(9)
+    bits, _ = bitset.set_bits(bits, np.array([0], np.int32))
+    assert bytes(np.asarray(bitset.pack(bits))) == b"\x80\x00"
+    bits2 = bitset.make(16)
+    bits2, _ = bitset.set_bits(bits2, np.array([7, 8, 15], np.int32))
+    packed = bytes(np.asarray(bitset.pack(bits2)))
+    assert packed == b"\x01\x81"
+    # Roundtrip.
+    back = bitset.unpack(np.frombuffer(packed, np.uint8), 16)
+    assert np.array_equal(np.asarray(back), np.asarray(bits2))

@@ -1,0 +1,65 @@
+import numpy as np
+
+from redisson_tpu.ops import bitset, bloom
+from tests import golden
+from tests.helpers import hash_ints
+
+
+def test_reference_sizing_vector():
+    # Mirrors RedissonBloomFilterTest.java:10-17 — expectedInsertions=100,
+    # falseProbability=0.03 must size to m=729, k=5.
+    m = bloom.optimal_num_of_bits(100, 0.03)
+    k = bloom.optimal_num_of_hash_functions(100, m)
+    assert m == 729
+    assert k == 5
+
+
+def test_indexes_match_python_mod():
+    for m in (729, 16384, 1 << 20, (1 << 31) - 1, 1 << 31):
+        vals = [v * 0x9E3779B97F4A7C15 + 1 for v in range(64)]
+        h1, h2 = hash_ints(vals)
+        idx = np.asarray(bloom.indexes(h1, h2, 5, m))
+        for row, v in zip(idx, vals):
+            g1, g2 = golden.murmur3_x64_128(int(v & ((1 << 64) - 1)).to_bytes(8, "little"))
+            want = [((g1 + i * g2) % (1 << 64)) % m for i in range(5)]
+            assert row.tolist() == want
+
+
+def test_add_contains_no_false_negatives():
+    m, k = 1 << 16, 7
+    bits = bitset.make(m)
+    members = list(range(1000))
+    h1, h2 = hash_ints(members)
+    idx = bloom.indexes(h1, h2, k, m)
+    bits, added = bloom.add(bits, idx)
+    assert bool(np.all(np.asarray(added)))  # fresh filter: every key new
+    assert bool(np.all(np.asarray(bloom.contains(bits, idx))))
+    # Re-adding the same keys reports no change.
+    _, added2 = bloom.add(bits, idx)
+    assert not bool(np.any(np.asarray(added2)))
+
+
+def test_false_positive_rate_near_design_point():
+    n, p = 5000, 0.02
+    m = bloom.optimal_num_of_bits(n, p)
+    k = bloom.optimal_num_of_hash_functions(n, m)
+    bits = bitset.make(m)
+    members = [v * 2654435761 + 7 for v in range(n)]
+    h1, h2 = hash_ints(members)
+    bits, _ = bloom.add(bits, bloom.indexes(h1, h2, k, m))
+    probes = [v * 2654435761 + 7 for v in range(n, n + 20000)]
+    ph1, ph2 = hash_ints(probes)
+    hits = np.asarray(bloom.contains(bits, bloom.indexes(ph1, ph2, k, m)))
+    fpr = hits.mean()
+    assert fpr < 3 * p, fpr
+
+
+def test_count_estimate():
+    n = 5000
+    m = bloom.optimal_num_of_bits(n, 0.01)
+    k = bloom.optimal_num_of_hash_functions(n, m)
+    bits = bitset.make(m)
+    h1, h2 = hash_ints(list(range(n)))
+    bits, _ = bloom.add(bits, bloom.indexes(h1, h2, k, m))
+    est = float(bloom.count_estimate(bitset.cardinality(bits), m, k))
+    assert abs(est - n) / n < 0.05

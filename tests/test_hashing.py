@@ -1,0 +1,81 @@
+import random
+
+import numpy as np
+
+from redisson_tpu.ops import hashing, u64 as u
+from tests import golden
+
+# Lengths straddling every block/tail boundary of both hashes.
+BOUNDARY_LENGTHS = [0, 1, 3, 4, 5, 7, 8, 9, 15, 16, 17, 23, 24, 31, 32, 33, 40, 47, 48, 63, 64]
+
+
+def _batch(keys, width):
+    n = len(keys)
+    data = np.zeros((n, width), np.uint8)
+    lengths = np.zeros((n,), np.int32)
+    for i, k in enumerate(keys):
+        data[i, : len(k)] = np.frombuffer(k, np.uint8)
+        lengths[i] = len(k)
+    return data, lengths
+
+
+def _rand_keys(seed=0):
+    rng = random.Random(seed)
+    keys = [bytes(rng.getrandbits(8) for _ in range(ln)) for ln in BOUNDARY_LENGTHS]
+    keys += [bytes(rng.getrandbits(8) for _ in range(rng.randrange(0, 64))) for _ in range(40)]
+    return keys
+
+
+def test_murmur3_x64_128_matches_golden():
+    keys = _rand_keys(1)
+    data, lengths = _batch(keys, 64)
+    for seed in (0, 0x9747B28C):
+        h1, h2 = hashing.murmur3_x64_128_jit(data, lengths, seed)
+        got = list(zip(u.to_python(h1).tolist(), u.to_python(h2).tolist()))
+        want = [golden.murmur3_x64_128(k, seed) for k in keys]
+        assert got == want
+
+
+def test_murmur3_u64_fast_path_matches_bytes_path():
+    rng = random.Random(3)
+    vals = [rng.getrandbits(64) for _ in range(50)]
+    x = u.U64(
+        np.array([v >> 32 for v in vals], np.uint32),
+        np.array([v & 0xFFFFFFFF for v in vals], np.uint32),
+    )
+    h1, h2 = hashing.murmur3_x64_128_u64(x)
+    want = [golden.murmur3_x64_128(v.to_bytes(8, "little")) for v in vals]
+    got = list(zip(u.to_python(h1).tolist(), u.to_python(h2).tolist()))
+    assert got == want
+
+
+def test_xxhash64_known_vector_empty():
+    # Canonical xxh64("") seed 0.
+    data = np.zeros((1, 32), np.uint8)
+    lengths = np.zeros((1,), np.int32)
+    h = hashing.xxhash64_jit(data, lengths, 0)
+    assert int(u.to_python(h)[0]) == 0xEF46DB3751D8E999
+
+
+def test_xxhash64_matches_golden():
+    keys = _rand_keys(7)
+    data, lengths = _batch(keys, 64)
+    for seed in (0, 2654435761):
+        h = hashing.xxhash64_jit(data, lengths, seed)
+        got = u.to_python(h).tolist()
+        want = [golden.xxhash64(k, seed) for k in keys]
+        assert got == want
+
+
+def test_padding_garbage_is_ignored():
+    # Bytes beyond each key's length must not affect the hash.
+    keys = [b"hello", b"a-longer-key-123"]
+    data, lengths = _batch(keys, 48)
+    dirty = data.copy()
+    for i, k in enumerate(keys):
+        dirty[i, len(k):] = 0xAB
+    clean1 = hashing.murmur3_x64_128_jit(data, lengths, 0)
+    dirty1 = hashing.murmur3_x64_128_jit(dirty, lengths, 0)
+    assert u.to_python(clean1[0]).tolist() == u.to_python(dirty1[0]).tolist()
+    assert u.to_python(hashing.xxhash64_jit(data, lengths, 0)).tolist() == \
+        u.to_python(hashing.xxhash64_jit(dirty, lengths, 0)).tolist()

@@ -1,0 +1,84 @@
+import numpy as np
+import pytest
+
+from redisson_tpu.ops import hll
+from tests import golden
+from tests.helpers import hash_ints
+
+
+def _insert_python(vals, p=hll.P):
+    """Golden scalar insert path."""
+    m = 1 << p
+    regs = [0] * m
+    for v in vals:
+        h1, _ = golden.murmur3_x64_128(int(v).to_bytes(8, "little"))
+        bucket = h1 & (m - 1)
+        rest = (h1 >> p) | (1 << (64 - p))
+        rank = 1
+        while not rest & 1:
+            rank += 1
+            rest >>= 1
+        regs[bucket] = max(regs[bucket], rank)
+    return regs
+
+
+def test_bucket_rank_matches_golden():
+    vals = list(range(1, 200))
+    h1, _ = hash_ints(vals)
+    bucket, rank = hll.bucket_rank(h1)
+    want = _insert_python(vals)
+    regs = np.zeros(hll.M, np.int32)
+    for b, r in zip(np.asarray(bucket), np.asarray(rank)):
+        regs[b] = max(regs[b], r)
+    assert regs.tolist() == want
+
+
+@pytest.mark.parametrize("impl", ["scatter", "sort"])
+def test_insert_impls_agree(impl):
+    vals = list(range(10_000))
+    h1, _ = hash_ints(vals)
+    regs = hll.add_hashes_jit(hll.make(), h1, impl)
+    want = np.asarray(_insert_python(vals), np.int32)
+    assert np.array_equal(np.asarray(regs), want)
+
+
+@pytest.mark.parametrize("n", [0, 1, 10, 100, 5_000, 200_000])
+def test_count_accuracy(n):
+    if n == 0:
+        est = float(hll.count_jit(hll.make()))
+        assert est == 0.0
+        return
+    vals = [v * 2654435761 + 12345 for v in range(n)]  # distinct keys
+    h1, _ = hash_ints(vals)
+    regs = hll.add_hashes_jit(hll.make(), h1, "sort")
+    est = float(hll.count_jit(regs))
+    # p=14 => stderr ~0.81%; allow 4 sigma (+small-n slack).
+    tol = max(4 * 0.0081, 0.05 if n <= 100 else 0.04)
+    assert abs(est - n) / n < tol, (est, n)
+
+
+def test_merge_is_register_max_and_count_of_union():
+    a_vals = list(range(0, 60_000))
+    b_vals = list(range(30_000, 90_000))
+    ha, _ = hash_ints(a_vals)
+    hb, _ = hash_ints(b_vals)
+    ra = hll.add_hashes_jit(hll.make(), ha, "sort")
+    rb = hll.add_hashes_jit(hll.make(), hb, "sort")
+    merged = hll.merge_jit(ra, rb)
+    assert np.array_equal(np.asarray(merged), np.maximum(np.asarray(ra), np.asarray(rb)))
+    est = float(hll.count_jit(merged))
+    assert abs(est - 90_000) / 90_000 < 0.04
+    # Idempotent: merging a sketch with itself changes nothing.
+    assert np.array_equal(np.asarray(hll.merge_jit(ra, ra)), np.asarray(ra))
+
+
+def test_merge_many():
+    stacks = []
+    for s in range(8):
+        vals = list(range(s * 1000, s * 1000 + 2000))
+        h1, _ = hash_ints(vals)
+        stacks.append(np.asarray(hll.add_hashes_jit(hll.make(), h1, "sort")))
+    merged = hll.merge_many(np.stack(stacks))
+    assert np.array_equal(np.asarray(merged), np.max(np.stack(stacks), axis=0))
+    est = float(hll.count_jit(merged))
+    assert abs(est - 9000) / 9000 < 0.05

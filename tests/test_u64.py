@@ -1,0 +1,85 @@
+import random
+
+import numpy as np
+import pytest
+
+from redisson_tpu.ops import u64 as u
+
+MASK64 = (1 << 64) - 1
+
+
+def _rand64(n, seed=0):
+    rng = random.Random(seed)
+    return [rng.getrandbits(64) for _ in range(n)]
+
+
+def _pack(vals):
+    hi = np.array([v >> 32 for v in vals], np.uint32)
+    lo = np.array([v & 0xFFFFFFFF for v in vals], np.uint32)
+    return u.U64(hi, lo)
+
+
+def _unpack(x):
+    return [int(v) for v in np.atleast_1d(u.to_python(x))]
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (u.add, lambda a, b: (a + b) & MASK64),
+    (u.mul, lambda a, b: (a * b) & MASK64),
+    (u.xor, lambda a, b: a ^ b),
+    (u.and_, lambda a, b: a & b),
+    (u.or_, lambda a, b: a | b),
+])
+def test_binary_ops(op, pyop):
+    a_vals = _rand64(64, 1)
+    b_vals = _rand64(64, 2)
+    got = _unpack(op(_pack(a_vals), _pack(b_vals)))
+    want = [pyop(a, b) for a, b in zip(a_vals, b_vals)]
+    assert got == want
+
+
+@pytest.mark.parametrize("n", [0, 1, 7, 31, 32, 33, 50, 63])
+def test_shifts_and_rot(n):
+    vals = _rand64(32, n + 10)
+    x = _pack(vals)
+    assert _unpack(u.shl(x, n)) == [(v << n) & MASK64 for v in vals]
+    assert _unpack(u.shr(x, n)) == [v >> n for v in vals]
+    assert _unpack(u.rotl(x, n)) == [((v << n) | (v >> (64 - n))) & MASK64 if n else v for v in vals]
+
+
+def test_ctz_clz_popcount():
+    vals = [0, 1, 2, 0x8000000000000000, 0x100000000, 0xF0F0, (1 << 64) - 1] + _rand64(20, 5)
+    x = _pack(vals)
+
+    def pyctz(v):
+        if v == 0:
+            return 64
+        c = 0
+        while not (v >> c) & 1:
+            c += 1
+        return c
+
+    def pyclz(v):
+        if v == 0:
+            return 64
+        return 64 - v.bit_length()
+
+    assert list(np.asarray(u.ctz(x))) == [pyctz(v) for v in vals]
+    assert list(np.asarray(u.clz(x))) == [pyclz(v) for v in vals]
+    assert list(np.asarray(u.popcount(x))) == [bin(v).count("1") for v in vals]
+
+
+def test_mul32():
+    rng = random.Random(9)
+    a = [rng.getrandbits(32) for _ in range(64)]
+    b = [rng.getrandbits(32) for _ in range(64)]
+    got = _unpack(u.mul32(np.array(a, np.uint32), np.array(b, np.uint32)))
+    assert got == [x * y for x, y in zip(a, b)]
+
+
+def test_const_and_compare():
+    assert _unpack(u.const(0xDEADBEEFCAFEBABE)) == [0xDEADBEEFCAFEBABE]
+    a = _pack([5, 10, 10])
+    b = _pack([10, 10, 5])
+    assert list(np.asarray(u.lt(a, b))) == [True, False, False]
+    assert list(np.asarray(u.eq(a, b))) == [False, True, False]
