@@ -1,0 +1,369 @@
+"""The TPU sketch backend: executes op runs against the SketchStore.
+
+This is the component the north star swaps in behind the executor seam —
+where the reference encodes RESP and awaits a Redis reply
+(`client/handler/CommandEncoder.java` / `CommandDecoder.java`), this backend
+pads the coalesced key batch to a bucket, invokes one fused jitted kernel
+(redisson_tpu.engine), swaps the new state into the store, and completes the
+op futures.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from redisson_tpu import engine
+from redisson_tpu.executor import Op
+from redisson_tpu.ops import bitset as bitset_ops, bloom as bloom_ops, hll as hll_ops
+from redisson_tpu.store import ObjectType, SketchStore
+
+
+class TpuBackend:
+    """Stateless op interpreter over a SketchStore (all state lives there)."""
+
+    def __init__(self, store: SketchStore, hll_impl: str = "sort", seed: int = 0):
+        self.store = store
+        self.hll_impl = hll_impl
+        self.seed = seed
+
+    # -- dispatch -----------------------------------------------------------
+
+    def run(self, kind: str, target: str, ops: List[Op]) -> None:
+        handler = getattr(self, "_op_" + kind, None)
+        if handler is None:
+            raise ValueError(f"unknown op kind: {kind}")
+        handler(target, ops)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _coalesce_bytes(self, ops: List[Op]):
+        """Concatenate byte-key payloads; returns (data, lengths, spans)."""
+        widths = {op.payload["data"].shape[1] for op in ops}
+        w = max(widths)
+        total = sum(op.payload["data"].shape[0] for op in ops)
+        data = np.zeros((total, w), np.uint8)
+        lengths = np.zeros((total,), np.int32)
+        spans = []
+        pos = 0
+        for op in ops:
+            d = op.payload["data"]
+            n = d.shape[0]
+            data[pos : pos + n, : d.shape[1]] = d
+            lengths[pos : pos + n] = op.payload["lengths"]
+            spans.append((pos, pos + n))
+            pos += n
+        return data, lengths, spans
+
+    # -- HLL ----------------------------------------------------------------
+
+    def _hll(self, name: str):
+        return self.store.get_or_create(
+            name, ObjectType.HLL, lambda: hll_ops.make(), {"p": hll_ops.P}
+        )
+
+    def _op_hll_add(self, target: str, ops: List[Op]) -> None:
+        # A coalesced run may mix int-key and byte-key payloads; group by
+        # format (PFADD is commutative max-fold, so regrouping is safe).
+        int_ops = [op for op in ops if "hi" in op.payload]
+        byte_ops = [op for op in ops if "hi" not in op.payload]
+        for group in (int_ops, byte_ops):
+            if group:
+                self._hll_add_group(target, group)
+
+    def _hll_add_group(self, target: str, ops: List[Op]) -> None:
+        # store.swap mutates the StoredObject in place, so obj.state is
+        # always the freshest registers across chunks.
+        obj = self._hll(target)
+        changed_any = False
+        if "hi" in ops[0].payload:
+            hi = np.concatenate([op.payload["hi"] for op in ops])
+            lo = np.concatenate([op.payload["lo"] for op in ops])
+            for s, e in engine.chunk_spans(hi.shape[0]):
+                phi, valid = engine.pad_ints(hi[s:e])
+                plo, _ = engine.pad_ints(lo[s:e])
+                new, changed = engine.hll_add_u64(
+                    obj.state, phi, plo, valid, self.hll_impl, self.seed
+                )
+                self.store.swap(target, new)
+                changed_any |= bool(changed)
+        else:
+            data, lengths, _ = self._coalesce_bytes(ops)
+            for s, e in engine.chunk_spans(data.shape[0]):
+                pdata, plengths, valid = engine.pad_bytes(data[s:e], lengths[s:e])
+                new, changed = engine.hll_add_bytes(
+                    obj.state, pdata, plengths, valid, self.hll_impl, self.seed
+                )
+                self.store.swap(target, new)
+                changed_any |= bool(changed)
+        for op in ops:
+            op.future.set_result(changed_any)
+
+    def _op_hll_count(self, target: str, ops: List[Op]) -> None:
+        obj = self.store.get(target, ObjectType.HLL)
+        est = 0 if obj is None else float(engine.hll_count(obj.state))
+        for op in ops:
+            op.future.set_result(int(round(est)))
+
+    def _op_hll_count_with(self, target: str, ops: List[Op]) -> None:
+        # Union count across sketches: merge copies, never mutate.
+        for op in ops:
+            names = [target, *op.payload["names"]]
+            arrays = [
+                o.state
+                for n in names
+                if (o := self.store.get(n, ObjectType.HLL)) is not None
+            ]
+            if not arrays:
+                op.future.set_result(0)
+                continue
+            merged = engine.hll_merge_all(arrays)
+            op.future.set_result(int(round(float(engine.hll_count(merged)))))
+
+    def _op_hll_merge_with(self, target: str, ops: List[Op]) -> None:
+        # PFMERGE semantics: fold sources into target.
+        for op in ops:
+            obj = self._hll(target)
+            arrays = [obj.state] + [
+                o.state
+                for n in op.payload["names"]
+                if (o := self.store.get(n, ObjectType.HLL)) is not None
+            ]
+            self.store.swap(target, engine.hll_merge_all(arrays))
+            op.future.set_result(None)
+
+    # -- BitSet -------------------------------------------------------------
+
+    def _bitset(self, name: str, nbits: int = None):
+        obj = self.store.get(name, ObjectType.BITSET)
+        if obj is None:
+            if nbits is None:
+                raise KeyError(f"bitset '{name}' does not exist")
+            obj = self.store.get_or_create(
+                name, ObjectType.BITSET, lambda: bitset_ops.make(nbits), {"nbits": nbits}
+            )
+        return obj
+
+    def _grow_for(self, obj, max_index: int):
+        """Redis SETBIT auto-grows the string; grow in power-of-two bytes."""
+        nbits = obj.state.shape[0]
+        if max_index < nbits:
+            return obj
+        new_bits = max(1024, 1 << (int(max_index).bit_length()))
+        grown = jnp.zeros((new_bits,), jnp.uint8).at[:nbits].set(obj.state)
+        obj.meta["nbits"] = new_bits
+        self.store.swap(obj.name, grown)
+        return self.store.get(obj.name)
+
+    def _bitset_mutate(self, target: str, ops: List[Op], kernel) -> None:
+        idx = np.concatenate([op.payload["idx"] for op in ops])
+        obj = self._bitset(target, nbits=1024)
+        obj = self._grow_for(obj, int(idx.max()) if idx.size else 0)
+        outs = []
+        for s, e in engine.chunk_spans(idx.shape[0]):
+            pidx, valid = engine.pad_ints(idx[s:e].astype(np.int32))
+            new, old = kernel(obj.state, pidx, valid)
+            self.store.swap(target, new)
+            outs.append(np.asarray(old)[: e - s])
+        old = np.concatenate(outs) if outs else np.zeros((0,), np.uint8)
+        pos = 0
+        for op in ops:
+            n = op.payload["idx"].shape[0]
+            op.future.set_result(old[pos : pos + n].astype(bool))
+            pos += n
+
+    def _op_bitset_set(self, target: str, ops: List[Op]) -> None:
+        self._bitset_mutate(target, ops, engine.bitset_set)
+
+    def _op_bitset_clear(self, target: str, ops: List[Op]) -> None:
+        if self.store.get(target, ObjectType.BITSET) is None:
+            for op in ops:
+                n = op.payload["idx"].shape[0]
+                op.future.set_result(np.zeros((n,), bool))
+            return
+        self._bitset_mutate(target, ops, engine.bitset_clear)
+
+    def _op_bitset_get(self, target: str, ops: List[Op]) -> None:
+        obj = self.store.get(target, ObjectType.BITSET)
+        idx = np.concatenate([op.payload["idx"] for op in ops])
+        if obj is None:
+            vals = np.zeros((idx.shape[0],), np.uint8)
+        else:
+            nbits = obj.state.shape[0]
+            clipped = np.clip(idx, 0, nbits - 1).astype(np.int32)
+            outs = []
+            for s, e in engine.chunk_spans(clipped.shape[0]):
+                pidx, valid = engine.pad_ints(clipped[s:e])
+                outs.append(np.asarray(engine.bitset_get(obj.state, pidx, valid))[: e - s])
+            vals = np.concatenate(outs) if outs else np.zeros((0,), np.uint8)
+            vals = np.where(idx < nbits, vals, 0)
+        pos = 0
+        for op in ops:
+            n = op.payload["idx"].shape[0]
+            op.future.set_result(vals[pos : pos + n].astype(bool))
+            pos += n
+
+    def _op_bitset_cardinality(self, target: str, ops: List[Op]) -> None:
+        obj = self.store.get(target, ObjectType.BITSET)
+        val = 0 if obj is None else int(engine.bitset_cardinality(obj.state))
+        for op in ops:
+            op.future.set_result(val)
+
+    def _op_bitset_length(self, target: str, ops: List[Op]) -> None:
+        obj = self.store.get(target, ObjectType.BITSET)
+        val = 0 if obj is None else int(engine.bitset_length(obj.state))
+        for op in ops:
+            op.future.set_result(val)
+
+    def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
+        """STRLEN * 8 — allocated bit capacity (reference sizeAsync)."""
+        obj = self.store.get(target, ObjectType.BITSET)
+        val = 0 if obj is None else obj.state.shape[0]
+        for op in ops:
+            op.future.set_result(val)
+
+    def _op_bitset_set_range(self, target: str, ops: List[Op]) -> None:
+        for op in ops:
+            start, end, value = op.payload["start"], op.payload["end"], op.payload["value"]
+            obj = self._bitset(target, nbits=1024)
+            if end > 0:
+                obj = self._grow_for(obj, end - 1)
+            new = bitset_ops.set_range(obj.state, start, end, value)
+            self.store.swap(target, new)
+            op.future.set_result(None)
+
+    def _op_bitset_op(self, target: str, ops: List[Op]) -> None:
+        """BITOP AND/OR/XOR/NOT into target (reference and/or/xor/not)."""
+        for op in ops:
+            kind = op.payload["op"]
+            sources = op.payload["names"]
+            arrays = []
+            for n in sources:
+                o = self.store.get(n, ObjectType.BITSET)
+                if o is not None:
+                    arrays.append(o.state)
+            if kind == "not":
+                obj = self.store.get(target, ObjectType.BITSET)
+                if obj is not None:
+                    self.store.swap(target, bitset_ops.bitop_not(obj.state))
+                op.future.set_result(None)
+                continue
+            obj = self._bitset(target, nbits=1024)
+            arrays = [obj.state] + arrays
+            width = max(a.shape[0] for a in arrays)
+            padded = []
+            for a in arrays:
+                if a.shape[0] < width:
+                    a = jnp.zeros((width,), jnp.uint8).at[: a.shape[0]].set(a)
+                padded.append(a)
+            fn = {
+                "and": bitset_ops.bitop_and,
+                "or": bitset_ops.bitop_or,
+                "xor": bitset_ops.bitop_xor,
+            }[kind]
+            # No existing sources: BITOP with only the destination leaves it
+            # unchanged (never wipe the destination).
+            acc = padded[0]
+            for a in padded[1:]:
+                acc = fn(acc, a)
+            obj.meta["nbits"] = width
+            self.store.swap(target, acc)
+            op.future.set_result(None)
+
+    # -- Bloom --------------------------------------------------------------
+
+    def _op_bloom_init(self, target: str, ops: List[Op]) -> None:
+        """tryInit: create config+bits if absent; False if config exists and
+        differs (the reference re-reads config and retries,
+        RedissonBloomFilter.java:80-114)."""
+        for op in ops:
+            n, p = op.payload["expected_insertions"], op.payload["false_probability"]
+            m = bloom_ops.optimal_num_of_bits(n, p)
+            k = bloom_ops.optimal_num_of_hash_functions(n, m)
+            bloom_ops.check_size(m)
+            existing = self.store.get(target, ObjectType.BLOOM)
+            if existing is not None:
+                op.future.set_result(False)
+                continue
+            self.store.get_or_create(
+                target,
+                ObjectType.BLOOM,
+                lambda: bitset_ops.make(m),
+                {
+                    "size": m,
+                    "hash_iterations": k,
+                    "expected_insertions": n,
+                    "false_probability": p,
+                },
+            )
+            op.future.set_result(True)
+
+    def _bloom_meta(self, target: str):
+        obj = self.store.get(target, ObjectType.BLOOM)
+        if obj is None:
+            raise RuntimeError(f"bloom filter '{target}' is not initialized")
+        return obj, obj.meta["size"], obj.meta["hash_iterations"]
+
+    def _op_bloom_add(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        data, lengths, _ = self._coalesce_bytes(ops)
+        pdata, plengths, valid = engine.pad_bytes(data, lengths)
+        new, added = engine.bloom_add_bytes(
+            obj.state, pdata, plengths, valid, k, m, self.seed
+        )
+        self.store.swap(target, new)
+        added = np.asarray(added)
+        pos = 0
+        for op in ops:
+            n = op.payload["data"].shape[0]
+            op.future.set_result(added[pos : pos + n])
+            pos += n
+
+    def _op_bloom_contains(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        data, lengths, _ = self._coalesce_bytes(ops)
+        pdata, plengths, valid = engine.pad_bytes(data, lengths)
+        res = np.asarray(
+            engine.bloom_contains_bytes(
+                obj.state, pdata, plengths, valid, k, m, self.seed
+            )
+        )
+        pos = 0
+        for op in ops:
+            n = op.payload["data"].shape[0]
+            op.future.set_result(res[pos : pos + n])
+            pos += n
+
+    def _op_bloom_meta(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        meta = dict(obj.meta)
+        for op in ops:
+            op.future.set_result(meta)
+
+    def _op_bloom_count(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        bc = int(engine.bitset_cardinality(obj.state))
+        est = float(bloom_ops.count_estimate(bc, m, k))
+        for op in ops:
+            op.future.set_result(int(round(est)))
+
+    # -- generic ------------------------------------------------------------
+
+    def _op_delete(self, target: str, ops: List[Op]) -> None:
+        res = self.store.delete(target)
+        for op in ops:
+            op.future.set_result(res)
+
+    def _op_exists(self, target: str, ops: List[Op]) -> None:
+        res = self.store.exists(target)
+        for op in ops:
+            op.future.set_result(res)
+
+    def _op_flushall(self, target: str, ops: List[Op]) -> None:
+        # Runs on the dispatcher thread, so it is serialized against every
+        # other op (no mid-kernel store mutation).
+        self.store.flushall()
+        for op in ops:
+            op.future.set_result(None)

@@ -1,0 +1,100 @@
+"""L4 — the client facade.
+
+Reference: `Redisson.java` (`create(Config)` picks a ConnectionManager,
+`Redisson.java:96-120`; 60+ typed getters bind objects to the shared
+CommandSyncService). Here create() picks a backend by config mode, builds
+the executor waist around it, and the getters hand out objects bound to it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from redisson_tpu.codecs import get_codec
+from redisson_tpu.config import Config, TpuConfig
+from redisson_tpu.executor import CommandExecutor
+from redisson_tpu.models.batch import RBatch
+from redisson_tpu.models.bitset import RBitSet
+from redisson_tpu.models.bloomfilter import RBloomFilter
+from redisson_tpu.models.hyperloglog import RHyperLogLog
+from redisson_tpu.store import SketchStore
+
+
+class RedissonTPU:
+    """The RedissonClient analogue."""
+
+    def __init__(self, config: Optional[Config] = None):
+        self.config = config or Config()
+        mode = self.config.mode()
+        self._codec = get_codec(self.config.codec)
+
+        if mode == "redis":
+            raise NotImplementedError(
+                "redis passthrough mode is not wired yet; configure it "
+                "alongside tpu/pod as the durability tier instead"
+            )
+        if mode == "pod":
+            from redisson_tpu.parallel.backend_pod import PodBackend
+
+            tcfg = self.config.pod
+            self._backend = PodBackend(tcfg)
+            self._store = self._backend.store
+        else:
+            # 'local' runs the same sketch engine on whatever platform jax
+            # gives us (cpu in tests); 'tpu' expects a TPU device.
+            import jax
+
+            from redisson_tpu.backend_tpu import TpuBackend
+
+            tcfg = self.config.tpu or TpuConfig()
+            device = jax.devices()[min(tcfg.device_index, len(jax.devices()) - 1)]
+            self._store = SketchStore(device=device)
+            self._backend = TpuBackend(
+                self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed
+            )
+        self._widths = tuple(tcfg.key_width_buckets)
+        self._executor = CommandExecutor(
+            self._backend, max_batch_keys=tcfg.max_batch_keys
+        )
+
+    @classmethod
+    def create(cls, config: Optional[Config] = None) -> "RedissonTPU":
+        return cls(config)
+
+    # -- object getters (Redisson.java getter surface) ----------------------
+
+    def get_hyper_log_log(self, name: str, codec=None) -> RHyperLogLog:
+        return RHyperLogLog(name, self._executor, codec or self._codec, self._widths)
+
+    def get_bit_set(self, name: str) -> RBitSet:
+        return RBitSet(name, self._executor, self._codec, self._widths)
+
+    def get_bloom_filter(self, name: str, codec=None) -> RBloomFilter:
+        return RBloomFilter(name, self._executor, codec or self._codec, self._widths)
+
+    def create_batch(self) -> RBatch:
+        return RBatch(self._executor, self._codec, self._widths)
+
+    # -- keys facade (RKeys analogue, partial) ------------------------------
+
+    def keys(self, pattern: str = "*"):
+        return self._store.keys(pattern)
+
+    def flushall(self):
+        # Routed through the executor so it serializes with in-flight ops on
+        # the dispatcher thread (no mid-kernel store mutation).
+        self._executor.execute_sync("", "flushall", None)
+
+    def delete(self, name: str) -> bool:
+        return self._executor.execute_sync(name, "delete", None)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self):
+        self._executor.shutdown()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()

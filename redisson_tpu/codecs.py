@@ -1,0 +1,126 @@
+"""Value codecs: object <-> bytes at the API boundary.
+
+Mirror of the reference's codec stack (`client/codec/` wire codecs +
+`codec/` value serializers, SURVEY.md §2 L4/L5): JSON is the default
+(reference default is JsonJacksonCodec, `Config.java:53-55`), with string /
+long / raw-bytes wire codecs and a pickle codec standing in for JDK
+serialization. Compression wrappers (zlib here; LZ4/Snappy in the reference)
+compose over any inner codec.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import zlib
+from typing import Any
+
+
+class Codec:
+    name = "base"
+
+    def encode(self, value: Any) -> bytes:
+        raise NotImplementedError
+
+    def decode(self, data: bytes) -> Any:
+        raise NotImplementedError
+
+
+class JsonCodec(Codec):
+    """Default codec (JsonJacksonCodec analogue)."""
+
+    name = "json"
+
+    def encode(self, value: Any) -> bytes:
+        return json.dumps(value, sort_keys=True, separators=(",", ":")).encode()
+
+    def decode(self, data: bytes) -> Any:
+        return json.loads(data.decode())
+
+
+class StringCodec(Codec):
+    name = "string"
+
+    def encode(self, value: Any) -> bytes:
+        return value.encode() if isinstance(value, str) else bytes(value)
+
+    def decode(self, data: bytes) -> Any:
+        return data.decode()
+
+
+class LongCodec(Codec):
+    name = "long"
+
+    def encode(self, value: Any) -> bytes:
+        return str(int(value)).encode()
+
+    def decode(self, data: bytes) -> Any:
+        return int(data)
+
+
+class BytesCodec(Codec):
+    name = "bytes"
+
+    def encode(self, value: Any) -> bytes:
+        return bytes(value)
+
+    def decode(self, data: bytes) -> Any:
+        return data
+
+
+class PickleCodec(Codec):
+    """JDK SerializationCodec analogue."""
+
+    name = "pickle"
+
+    def encode(self, value: Any) -> bytes:
+        return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+
+    def decode(self, data: bytes) -> Any:
+        return pickle.loads(data)
+
+
+class CompressionCodec(Codec):
+    """zlib wrapper over an inner codec (LZ4/SnappyCodec analogue)."""
+
+    name = "zlib"
+
+    def __init__(self, inner: Codec):
+        self.inner = inner
+
+    def encode(self, value: Any) -> bytes:
+        return zlib.compress(self.inner.encode(value))
+
+    def decode(self, data: bytes) -> Any:
+        return self.inner.decode(zlib.decompress(data))
+
+
+_REGISTRY = {
+    "json": JsonCodec,
+    "string": StringCodec,
+    "long": LongCodec,
+    "bytes": BytesCodec,
+    "pickle": PickleCodec,
+}
+
+
+def get_codec(name_or_codec) -> Codec:
+    if isinstance(name_or_codec, Codec):
+        return name_or_codec
+    try:
+        return _REGISTRY[name_or_codec]()
+    except KeyError:
+        raise ValueError(f"unknown codec '{name_or_codec}'") from None
+
+
+def encode_key(value: Any, codec: Codec) -> bytes:
+    """Encode a value for hashing: bytes/str pass through, rest via codec."""
+    if isinstance(value, bytes):
+        return value
+    if isinstance(value, str):
+        return value.encode()
+    if isinstance(value, bool):  # before int: bool is an int subtype
+        return codec.encode(value)
+    if isinstance(value, int):
+        return str(value).encode()
+    return codec.encode(value)

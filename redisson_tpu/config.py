@@ -1,0 +1,153 @@
+"""Config system — typed modes + JSON/YAML load/save.
+
+Mirrors the reference's `Config.java` (515 LoC) + `ConfigSupport.java`
+(Jackson JSON/YAML): a top-level Config holding exactly one server-mode
+section. Our modes map the reference's five connection managers
+(`Redisson.java:96-120`) onto the TPU world:
+
+  * local   — in-process pure-python backend (useSingleServer analogue for
+              tests / the long-tail objects).
+  * tpu     — single-chip sketch engine (the north-star backend).
+  * pod     — multi-chip mesh-sharded sketch engine (useClusterServers
+              analogue; shards by slot across devices).
+  * redis   — passthrough to a real Redis via the RESP client (durability /
+              interop tier).
+
+Knobs follow `BaseConfig.java:27-86` where they translate (timeouts, retry
+policy) and add the TPU-specific batching knobs (SURVEY.md §7 step 3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class LocalConfig:
+    """In-process backend (no device)."""
+
+
+@dataclass
+class TpuConfig:
+    """Single-chip sketch engine."""
+
+    device_index: int = 0
+    hll_impl: str = "sort"  # 'sort' | 'scatter'
+    hash_seed: int = 0
+    max_batch_keys: int = 1 << 21
+    key_width_buckets: tuple = (16, 32, 64, 128, 256)
+
+
+@dataclass
+class PodConfig(TpuConfig):
+    """Mesh-sharded sketch engine across all visible devices."""
+
+    mesh_axis: str = "shards"
+    num_shards: int = 0  # 0 = all devices
+    bank_capacity: int = 4096  # sketch rows in the sharded bank
+
+
+@dataclass
+class RedisConfig:
+    """RESP passthrough / durability flush target."""
+
+    address: str = "redis://127.0.0.1:6379"
+    timeout_ms: int = 3000  # BaseConfig.timeout
+    retry_attempts: int = 3  # BaseConfig.retryAttempts
+    retry_interval_ms: int = 1000  # BaseConfig.retryInterval
+    password: Optional[str] = None
+    database: int = 0
+
+
+@dataclass
+class Config:
+    local: Optional[LocalConfig] = None
+    tpu: Optional[TpuConfig] = None
+    pod: Optional[PodConfig] = None
+    redis: Optional[RedisConfig] = None
+    # Durability: flush sketch state to redis every N seconds (0 = off).
+    flush_interval_s: float = 0.0
+    codec: str = "json"  # default value codec, reference Config.java:53-55
+    threads: int = 0  # 0 => cpu_count, reference Config.java:50
+
+    _MODES = ("local", "tpu", "pod", "redis")
+
+    def mode(self) -> str:
+        """The single active backend mode (validated)."""
+        active = [m for m in self._MODES if getattr(self, m) is not None]
+        if len(active) > 1 and not (active == ["tpu", "redis"] or active == ["pod", "redis"]):
+            # redis may coexist as the durability tier behind tpu/pod.
+            raise ValueError(f"multiple backend modes configured: {active}")
+        if not active:
+            return "local"
+        return active[0]
+
+    def use_local(self) -> "LocalConfig":
+        self.local = self.local or LocalConfig()
+        return self.local
+
+    def use_tpu(self) -> "TpuConfig":
+        self.tpu = self.tpu or TpuConfig()
+        return self.tpu
+
+    def use_pod(self) -> "PodConfig":
+        self.pod = self.pod or PodConfig()
+        return self.pod
+
+    def use_redis(self) -> "RedisConfig":
+        self.redis = self.redis or RedisConfig()
+        return self.redis
+
+    # -- (de)serialization (ConfigSupport.java analogue) --------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_"):
+                continue
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            out[f.name] = dataclasses.asdict(v) if dataclasses.is_dataclass(v) else v
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    def to_yaml(self) -> str:
+        import yaml
+
+        return yaml.safe_dump(self.to_dict())
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        kwargs: Dict[str, Any] = {}
+        section_types = {
+            "local": LocalConfig,
+            "tpu": TpuConfig,
+            "pod": PodConfig,
+            "redis": RedisConfig,
+        }
+        for key, value in d.items():
+            sec = section_types.get(key)
+            if sec is not None:
+                value = dict(value)
+                if "key_width_buckets" in value:
+                    value["key_width_buckets"] = tuple(value["key_width_buckets"])
+                kwargs[key] = sec(**value)
+            else:
+                kwargs[key] = value
+        return cls(**kwargs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Config":
+        return cls.from_dict(json.loads(text))
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "Config":
+        import yaml
+
+        return cls.from_dict(yaml.safe_load(text))

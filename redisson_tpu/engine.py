@@ -1,0 +1,198 @@
+"""Jitted device entry points for the sketch engine.
+
+Each function is the fused "one device call" a microbatch compiles to:
+hash -> bucket/rank -> scatter, or hash -> k-indexes -> scatter/gather, with
+a validity mask so the L2 executor can pad batches to static bucket sizes
+without recompiles (pad-to-bucket, SURVEY.md §7 "dispatch amortization").
+
+Masking rules (all no-ops on padded lanes):
+  * HLL insert: padded rank forced to 0; registers hold >= 0 so max(., 0)
+    never changes state.
+  * Bit set: padded index forced to 0 with set-value semantics of max(., 0).
+  * Gathers (contains/getbit): padded lanes read index 0; results sliced off
+    host-side.
+
+State arguments are donated so XLA reuses the HBM buffer — the register
+array never round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import bitset, bloom, hashing, hll
+from redisson_tpu.ops.u64 import U64
+
+# Batch-size buckets: powers of two between MIN_BUCKET and MAX_BUCKET keys.
+MIN_BUCKET = 1 << 10
+MAX_BUCKET = 1 << 21
+
+
+def bucket_size(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n and b < MAX_BUCKET:
+        b <<= 1
+    return b
+
+
+def chunk_spans(n: int, chunk: int = None):
+    """[0, n) split into device-call-sized spans (a single op may exceed the
+    coalescing cap; the backend loops the kernel over these)."""
+    if chunk is None:
+        chunk = MAX_BUCKET  # read at call time (tests shrink it)
+    return [(s, min(s + chunk, n)) for s in range(0, max(n, 1), chunk)] if n else []
+
+
+def pad_bytes(data, lengths):
+    """Pad [N, W] byte batch + lengths to (bucket, W) with a valid mask."""
+    import numpy as np
+
+    n = data.shape[0]
+    b = bucket_size(n)
+    if n == b:
+        return data, lengths, np.ones((b,), bool)
+    pdata = np.zeros((b, data.shape[1]), np.uint8)
+    pdata[:n] = data
+    plengths = np.zeros((b,), np.int32)
+    plengths[:n] = lengths
+    valid = np.zeros((b,), bool)
+    valid[:n] = True
+    return pdata, plengths, valid
+
+
+def pad_ints(arr, fill=0):
+    import numpy as np
+
+    n = arr.shape[0]
+    b = bucket_size(n)
+    if n == b:
+        return arr, np.ones((b,), bool)
+    out = np.full((b,) + arr.shape[1:], fill, arr.dtype)
+    out[:n] = arr
+    valid = np.zeros((b,), bool)
+    valid[:n] = True
+    return out, valid
+
+
+# ---------------------------------------------------------------------------
+# HLL
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
+def hll_add_bytes(regs, data, lengths, valid, impl: str = "sort", seed: int = 0):
+    """PFADD of a padded byte-key batch. Returns (new_regs, changed)."""
+    h1, _ = hashing.murmur3_x64_128(data, lengths, seed)
+    return _hll_add(regs, h1, valid, impl)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
+def hll_add_u64(regs, hi, lo, valid, impl: str = "sort", seed: int = 0):
+    """PFADD of a padded uint64-key batch (8-byte LE fast path)."""
+    h1, _ = hashing.murmur3_x64_128_u64(U64(hi, lo), seed)
+    return _hll_add(regs, h1, valid, impl)
+
+
+def _hll_add(regs, h1, valid, impl):
+    p = regs.shape[0].bit_length() - 1
+    bucket, rank = hll.bucket_rank(h1, p)
+    rank = jnp.where(valid, rank, 0)
+    if impl == "scatter":
+        new = hll.insert_scatter(regs, bucket, rank)
+    else:
+        new = hll.insert_sorted(regs, jnp.where(valid, bucket, 0), rank)
+    # changed: vs pre-batch state; regs is donated so compute before return.
+    changed = jnp.any(new != regs)
+    return new, changed
+
+
+@jax.jit
+def hll_count(regs):
+    return hll.count(regs)
+
+
+@jax.jit
+def hll_merge(dst, src):
+    return jnp.maximum(dst, src)
+
+
+def hll_merge_all(arrays):
+    """Merge a python list of register arrays (eager maximum chain)."""
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = hll_merge(acc, a)
+    return acc
+
+
+@jax.jit
+def hll_count_merged(stack):
+    """Count over [S, m] pre-stacked sketches without mutating them."""
+    return hll.count(jnp.max(stack, axis=0))
+
+
+# ---------------------------------------------------------------------------
+# BitSet
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bitset_set(bits, idx, valid):
+    """SETBIT batch -> (new_bits, old_values). Padded lanes read idx 0."""
+    idx = jnp.where(valid, idx, 0)
+    old = bits[idx]
+    new = bits.at[idx].max(jnp.where(valid, jnp.uint8(1), jnp.uint8(0)))
+    return new, old
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bitset_clear(bits, idx, valid):
+    idx = jnp.where(valid, idx, 0)
+    old = bits[idx]
+    new = bits.at[idx].min(jnp.where(valid, jnp.uint8(0), jnp.uint8(1)))
+    return new, old
+
+
+@jax.jit
+def bitset_get(bits, idx, valid):
+    return bits[jnp.where(valid, idx, 0)]
+
+
+@jax.jit
+def bitset_cardinality(bits):
+    return bitset.cardinality(bits)
+
+
+@jax.jit
+def bitset_length(bits):
+    return bitset.length(bits)
+
+
+# ---------------------------------------------------------------------------
+# Bloom
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+)
+def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+    """Bloom add of a padded byte-key batch -> (new_bits, added_mask)."""
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    idx = bloom.indexes(h1, h2, k, m)
+    idx = jnp.where(valid[:, None], idx, 0)
+    old = bits[idx.reshape(-1)].reshape(idx.shape)
+    vals = jnp.broadcast_to(valid[:, None], idx.shape)
+    new = bits.at[idx.reshape(-1)].max(vals.astype(jnp.uint8).reshape(-1))
+    added = jnp.any(old == 0, axis=-1) & valid
+    return new, added
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def bloom_contains_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    idx = bloom.indexes(h1, h2, k, m)
+    idx = jnp.where(valid[:, None], idx, 0)
+    return bloom.contains(bits, idx) & valid

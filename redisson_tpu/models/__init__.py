@@ -1,0 +1,8 @@
+"""L3 — distributed-object API mirroring the reference's `core/` interfaces."""
+
+from redisson_tpu.models.hyperloglog import RHyperLogLog
+from redisson_tpu.models.bitset import RBitSet
+from redisson_tpu.models.bloomfilter import RBloomFilter
+from redisson_tpu.models.batch import RBatch
+
+__all__ = ["RHyperLogLog", "RBitSet", "RBloomFilter", "RBatch"]

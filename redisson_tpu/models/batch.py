@@ -1,0 +1,68 @@
+"""RBatch — pipelined multi-object execution.
+
+Reference: `RedissonBatch.java` + `command/CommandBatchService.java`: the
+collect phase does no I/O; execute() dispatches everything and returns
+results in staging order (global-index reassembly,
+`CommandBatchService.java:163-174`). Batch-flavored object clones share one
+BatchCollector exactly as the reference's clones share one
+CommandBatchService (`RedissonBatch.java`, wired at `Redisson.java:540-542`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from redisson_tpu.models.bitset import RBitSet
+from redisson_tpu.models.bloomfilter import RBloomFilter
+from redisson_tpu.models.hyperloglog import RHyperLogLog
+
+
+class _StagingExecutor:
+    """Executor facade that stages into a BatchCollector instead of
+    dispatching; async methods return the batch index as a placeholder."""
+
+    def __init__(self, collector):
+        self._collector = collector
+
+    def execute_async(self, target, kind, payload, nkeys=0):
+        return _Staged(self._collector.add(target, kind, payload, nkeys))
+
+    def execute_sync(self, target, kind, payload, nkeys=0):
+        raise RuntimeError(
+            "sync calls are not allowed on batch objects; stage with the "
+            "async variants and call execute()"
+        )
+
+
+class _Staged:
+    """Placeholder future: resolves only after RBatch.execute()."""
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def result(self, timeout=None):
+        raise RuntimeError("batch not executed yet; call RBatch.execute()")
+
+
+class RBatch:
+    def __init__(self, executor, codec, key_width_buckets):
+        self._collector = executor.batch()
+        self._staging = _StagingExecutor(self._collector)
+        self._codec = codec
+        self._widths = key_width_buckets
+
+    def get_hyper_log_log(self, name: str) -> RHyperLogLog:
+        return RHyperLogLog(name, self._staging, self._codec, self._widths)
+
+    def get_bit_set(self, name: str) -> RBitSet:
+        return RBitSet(name, self._staging, self._codec, self._widths)
+
+    def get_bloom_filter(self, name: str) -> RBloomFilter:
+        return RBloomFilter(name, self._staging, self._codec, self._widths)
+
+    def execute(self) -> List[Any]:
+        """Dispatch all staged ops; results in staging order."""
+        return self._collector.execute()
+
+    def execute_async(self):
+        return self._collector.execute_async()

@@ -1,0 +1,91 @@
+"""RBloomFilter — the reference's `core/RBloomFilter.java` surface
+(`RedissonBloomFilter.java`: tryInit, add, contains, count, getSize,
+getHashIterations, getExpectedInsertions, getFalseProbability) with batched
+add_all/contains_all.
+
+The reference guards every op with a Lua config check and retries on
+concurrent re-init (`RedissonBloomFilter.java:80-114`); here config is
+immutable store metadata created once by tryInit, and ops fail loudly if the
+filter was never initialized.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from redisson_tpu.models.object import RObject
+
+
+class RBloomFilter(RObject):
+    def try_init(self, expected_insertions: int, false_probability: float) -> bool:
+        """Size + create; False if the filter already exists
+        (reference tryInit contract)."""
+        if not 0 < false_probability < 1:
+            raise ValueError("false_probability must be in (0, 1)")
+        return self._executor.execute_sync(
+            self.name,
+            "bloom_init",
+            {
+                "expected_insertions": int(expected_insertions),
+                "false_probability": float(false_probability),
+            },
+        )
+
+    # -- mutation -----------------------------------------------------------
+
+    def add(self, value) -> bool:
+        return bool(self.add_all([value])[0])
+
+    def add_all(self, values: Iterable) -> np.ndarray:
+        return self.add_all_async(values).result()
+
+    def add_all_async(self, values: Iterable):
+        data, lengths = self._encode_batch(values)
+        return self._executor.execute_async(
+            self.name,
+            "bloom_add",
+            {"data": data, "lengths": lengths},
+            nkeys=data.shape[0],
+        )
+
+    # -- membership ---------------------------------------------------------
+
+    def contains(self, value) -> bool:
+        return bool(self.contains_all([value])[0])
+
+    def contains_all(self, values: Iterable) -> np.ndarray:
+        return self.contains_all_async(values).result()
+
+    def contains_all_async(self, values: Iterable):
+        data, lengths = self._encode_batch(values)
+        return self._executor.execute_async(
+            self.name,
+            "bloom_contains",
+            {"data": data, "lengths": lengths},
+            nkeys=data.shape[0],
+        )
+
+    # -- introspection ------------------------------------------------------
+
+    def count(self) -> int:
+        """Estimated element count from BITCOUNT
+        (RedissonBloomFilter.java:188-199)."""
+        return self._executor.execute_sync(self.name, "bloom_count", None)
+
+    def _meta(self, key):
+        obj = self._executor.execute_sync(self.name, "bloom_meta", None)
+        return obj[key]
+
+    def get_size(self) -> int:
+        return self._meta("size")
+
+    def get_hash_iterations(self) -> int:
+        return self._meta("hash_iterations")
+
+    def get_expected_insertions(self) -> int:
+        return self._meta("expected_insertions")
+
+    def get_false_probability(self) -> float:
+        return self._meta("false_probability")

@@ -1,0 +1,75 @@
+"""RHyperLogLog — the reference's `core/RHyperLogLog.java` surface
+(`RedissonHyperLogLog.java:40-97`: add/addAll/count/countWith/mergeWith,
+each with an async twin) plus TPU-native batch entry points.
+
+The reference's `addAllAsync` has an argument-passing bug (object name sent
+twice, `RedissonHyperLogLog.java:71-76`); we implement the documented
+contract, not the bug.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from redisson_tpu.models.object import RObject
+
+
+class RHyperLogLog(RObject):
+    # -- mutation -----------------------------------------------------------
+
+    def add(self, value) -> bool:
+        return self.add_async(value).result()
+
+    def add_async(self, value):
+        return self.add_all_async([value])
+
+    def add_all(self, values: Iterable) -> bool:
+        return self.add_all_async(values).result()
+
+    def add_all_async(self, values: Iterable):
+        data, lengths = self._encode_batch(values)
+        return self._executor.execute_async(
+            self.name,
+            "hll_add",
+            {"data": data, "lengths": lengths},
+            nkeys=data.shape[0],
+        )
+
+    def add_ints(self, values: np.ndarray) -> bool:
+        """TPU fast path: a uint64 array hashed as 8-byte LE keys — no
+        per-key python encoding. This is the 100M/sec ingest surface."""
+        return self.add_ints_async(values).result()
+
+    def add_ints_async(self, values: np.ndarray):
+        values = np.ascontiguousarray(values, np.uint64)
+        hi = (values >> np.uint64(32)).astype(np.uint32)
+        lo = (values & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        return self._executor.execute_async(
+            self.name, "hll_add", {"hi": hi, "lo": lo}, nkeys=values.shape[0]
+        )
+
+    # -- reads --------------------------------------------------------------
+
+    def count(self) -> int:
+        return self.count_async().result()
+
+    def count_async(self):
+        return self._executor.execute_async(self.name, "hll_count", None)
+
+    def count_with(self, *other_names: str) -> int:
+        return self.count_with_async(*other_names).result()
+
+    def count_with_async(self, *other_names: str):
+        return self._executor.execute_async(
+            self.name, "hll_count_with", {"names": list(other_names)}
+        )
+
+    def merge_with(self, *other_names: str) -> None:
+        return self.merge_with_async(*other_names).result()
+
+    def merge_with_async(self, *other_names: str):
+        return self._executor.execute_async(
+            self.name, "hll_merge_with", {"names": list(other_names)}
+        )

@@ -1,0 +1,55 @@
+"""Object base classes (reference: `RedissonObject.java` — name + codec +
+executor triple; every object is stateless client-side)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+from redisson_tpu.codecs import Codec, encode_key
+
+
+class RObject:
+    """name + codec + executor; all state lives behind the executor."""
+
+    def __init__(self, name: str, executor, codec: Codec, key_width_buckets: Sequence[int] = (16, 32, 64, 128, 256)):
+        self.name = name
+        self._executor = executor
+        self._codec = codec
+        self._width_buckets = tuple(key_width_buckets)
+
+    # -- key encoding -------------------------------------------------------
+
+    def _encode_batch(self, values: Iterable) -> tuple:
+        """values -> ([N, W] uint8 zero-padded, [N] int32 lengths).
+
+        W is the smallest configured width bucket holding the longest key, so
+        repeated batches of similar keys reuse one compiled kernel.
+        """
+        keys: List[bytes] = [encode_key(v, self._codec) for v in values]
+        max_len = max((len(k) for k in keys), default=1)
+        w = next((b for b in self._width_buckets if b >= max_len), None)
+        if w is None:
+            raise ValueError(
+                f"key length {max_len} exceeds max width bucket "
+                f"{self._width_buckets[-1]}"
+            )
+        n = len(keys)
+        data = np.zeros((n, w), np.uint8)
+        lengths = np.empty((n,), np.int32)
+        for i, k in enumerate(keys):
+            data[i, : len(k)] = np.frombuffer(k, np.uint8)
+            lengths[i] = len(k)
+        return data, lengths
+
+    # -- RObject surface (RObjectAsync mirrored with _async suffix) ---------
+
+    def delete(self) -> bool:
+        return self.delete_async().result()
+
+    def delete_async(self):
+        return self._executor.execute_async(self.name, "delete", None)
+
+    def is_exists(self) -> bool:
+        return self._executor.execute_sync(self.name, "exists", None)

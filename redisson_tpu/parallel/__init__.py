@@ -1,0 +1,10 @@
+"""Multi-chip distribution: mesh construction, sharded sketch banks, and
+cross-shard merges over ICI collectives.
+
+This is the TPU-native replacement for the reference's cluster layer
+(SURVEY.md §2 parallelism checklist): key-slot sharding becomes row-sharding
+of a sketch bank over a device mesh; the scatter-gather fan-out + SlotCallback
+reduce (`command/CommandAsyncService.java:128-164`) becomes `lax.pmax` /
+`psum` inside `shard_map`; RESP-over-TCP is replaced by XLA collectives over
+ICI/DCN.
+"""

@@ -1,0 +1,194 @@
+"""Pod backend: HLL objects live as rows of a mesh-sharded sketch bank.
+
+The cluster-mode analogue (`cluster/ClusterConnectionManager.java`): object
+names are assigned rows in a [S, m] bank sharded over the device mesh; the
+slot function stays CRC16 for interop, but placement is by allocation order
+(contiguous rows -> balanced shards) rather than slot ranges. Non-HLL
+objects delegate to a single-device TpuBackend on device 0 of the mesh —
+the sketch bank is the multi-chip surface (BASELINE configs #4/#5).
+
+Cross-object coalescing: hll_add is declared GLOBAL_COALESCE, so one device
+call can carry inserts for thousands of different sketches (each key tagged
+with its target row) — the pipelined-PFADD-across-256-sketches config
+collapses to a single SPMD program launch.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from redisson_tpu import engine
+from redisson_tpu.backend_tpu import TpuBackend
+from redisson_tpu.executor import Op
+from redisson_tpu.ops import hll as hll_ops
+from redisson_tpu.parallel import sharded
+from redisson_tpu.parallel.mesh import build_mesh
+from redisson_tpu.store import SketchStore
+
+
+class PodBackend:
+    GLOBAL_COALESCE = frozenset({"hll_add"})
+
+    def __init__(self, cfg):
+        self.mesh = build_mesh(cfg.num_shards)
+        self.seed = cfg.hash_seed
+        self.bank_capacity = cfg.bank_capacity
+        ndev = self.mesh.devices.size
+        if self.bank_capacity % ndev:
+            self.bank_capacity += ndev - self.bank_capacity % ndev
+        self.bank = sharded.make_bank(self.mesh, self.bank_capacity)
+        self._rows: dict = {}  # name -> row
+        self._free_rows: list = []  # rows returned by delete, for reuse
+        self._next_row = 0
+        # Non-HLL ops delegate to a single-device backend.
+        self.store = SketchStore(device=self.mesh.devices.flat[0])
+        self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed)
+
+    # -- routing ------------------------------------------------------------
+
+    def row_of(self, name: str) -> int:
+        row = self._rows.get(name)
+        if row is None:
+            if self._free_rows:
+                row = self._free_rows.pop()
+            elif self._next_row < self.bank_capacity:
+                row = self._next_row
+                self._next_row += 1
+            else:
+                raise RuntimeError(
+                    f"sketch bank full ({self.bank_capacity} rows); raise "
+                    "PodConfig.bank_capacity"
+                )
+            self._rows[name] = row
+        return row
+
+    def run(self, kind: str, target: str, ops: List[Op]) -> None:
+        handler = getattr(self, "_op_" + kind, None)
+        if handler is not None:
+            handler(target, ops)
+            return
+        self._delegate.run(kind, target, ops)
+
+    # -- lifecycle ops must see bank-resident HLLs too ----------------------
+
+    def _op_delete(self, target: str, ops: List[Op]) -> None:
+        row = self._rows.pop(target, None)
+        if row is not None:
+            self.bank = sharded.zero_row(self.bank, row)
+            self._free_rows.append(row)
+            for op in ops:
+                op.future.set_result(True)
+            return
+        self._delegate.run("delete", target, ops)
+
+    def _op_exists(self, target: str, ops: List[Op]) -> None:
+        if target in self._rows:
+            for op in ops:
+                op.future.set_result(True)
+            return
+        self._delegate.run("exists", target, ops)
+
+    def _op_flushall(self, target: str, ops: List[Op]) -> None:
+        self._rows.clear()
+        self._free_rows.clear()
+        self._next_row = 0
+        self.bank = sharded.make_bank(self.mesh, self.bank_capacity)
+        self.store.flushall()
+        for op in ops:
+            op.future.set_result(None)
+
+    # -- HLL over the bank --------------------------------------------------
+
+    def _keys_of(self, op: Op):
+        """Extract (hi, lo) uint32 key arrays from either payload format."""
+        p = op.payload
+        if "hi" in p:
+            return p["hi"], p["lo"]
+        # Byte keys: hash host-side is wrong (device does it); instead pack
+        # bytes through the murmur u64 fast path is impossible — so for the
+        # pod bank we pre-hash byte keys to u64 on device via the delegate
+        # path. Round-1 simplification: hash bytes on host with the golden
+        # algorithm would be slow; we instead fold bytes to u64 with FNV-1a
+        # host-side as the *key id* — uniformity is preserved because the
+        # bank path re-hashes ids with murmur3 on device.
+        data, lengths = p["data"], p["lengths"]
+        ids = _fnv1a_u64(data, lengths)
+        return (ids >> np.uint64(32)).astype(np.uint32), (
+            ids & np.uint64(0xFFFFFFFF)
+        ).astype(np.uint32)
+
+    def _op_hll_add(self, target: str, ops: List[Op]) -> None:
+        his, los, rows = [], [], []
+        for op in ops:
+            hi, lo = self._keys_of(op)
+            his.append(hi)
+            los.append(lo)
+            rows.append(np.full((hi.shape[0],), self.row_of(op.target), np.int32))
+        hi = np.concatenate(his)
+        lo = np.concatenate(los)
+        row = np.concatenate(rows)
+        changed_any = False
+        for s, e in engine.chunk_spans(hi.shape[0]):
+            phi, valid = engine.pad_ints(hi[s:e])
+            plo, _ = engine.pad_ints(lo[s:e])
+            prow, _ = engine.pad_ints(row[s:e])
+            self.bank, changed = sharded.bank_insert(
+                self.bank, phi, plo, prow, valid, self.mesh, self.seed
+            )
+            changed_any |= bool(changed)
+        for op in ops:
+            op.future.set_result(changed_any)
+
+    def _op_hll_count(self, target: str, ops: List[Op]) -> None:
+        row = self._rows.get(target)
+        est = (
+            0.0
+            if row is None
+            else float(sharded.bank_count_row(self.bank, np.int32(row)))
+        )
+        for op in ops:
+            op.future.set_result(int(round(est)))
+
+    def _op_hll_count_with(self, target: str, ops: List[Op]) -> None:
+        for op in ops:
+            names = [target, *op.payload["names"]]
+            rows = [self._rows[n] for n in names if n in self._rows]
+            if not rows:
+                op.future.set_result(0)
+                continue
+            rows_arr = np.array(rows, np.int32)
+            est = float(
+                sharded.bank_count_rows_merged(self.bank, rows_arr, self.mesh)
+            )
+            op.future.set_result(int(round(est)))
+
+    def _op_hll_merge_with(self, target: str, ops: List[Op]) -> None:
+        import jax.numpy as jnp
+
+        for op in ops:
+            rows = [self.row_of(target)] + [
+                self._rows[n] for n in op.payload["names"] if n in self._rows
+            ]
+            rows_arr = np.array(rows, np.int32)
+            merged = jnp.max(self.bank[rows_arr], axis=0)
+            self.bank = self.bank.at[self.row_of(target)].set(merged)
+            op.future.set_result(None)
+
+    def _op_hll_count_all(self, target: str, ops: List[Op]) -> None:
+        """Union count of the entire bank — one ICI pmax all-reduce."""
+        est = float(sharded.bank_count_all(self.bank, self.mesh))
+        for op in ops:
+            op.future.set_result(int(round(est)))
+
+
+def _fnv1a_u64(data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a over padded byte rows (host-side key-id fold)."""
+    h = np.full((data.shape[0],), 0xCBF29CE484222325, np.uint64)
+    prime = np.uint64(0x100000001B3)
+    for j in range(data.shape[1]):
+        active = j < lengths
+        nh = (h ^ data[:, j].astype(np.uint64)) * prime
+        h = np.where(active, nh, h)
+    return h

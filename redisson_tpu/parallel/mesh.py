@@ -1,0 +1,26 @@
+"""Device mesh helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shards"
+
+
+def build_mesh(num_devices: int = 0, axis: str = SHARD_AXIS) -> Mesh:
+    """1-D mesh over the first num_devices devices (0 = all)."""
+    devs = jax.devices()
+    if num_devices:
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def bank_sharding(mesh: Mesh, axis: str = SHARD_AXIS) -> NamedSharding:
+    """[S, m] sketch bank: rows sharded across devices, registers local."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

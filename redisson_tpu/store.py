@@ -1,0 +1,141 @@
+"""L1 — the sketch store: named objects -> device-resident state.
+
+The TPU analogue of the reference's connection/topology layer
+(`connection/ConnectionManager.java`): where the reference maps a key to a
+hash slot to a Redis node's connection pool, we map an object name to a hash
+slot (same CRC16/16384 function, `cluster/ClusterConnectionManager.java:543`)
+and to a device-resident array (single chip) or a mesh shard (see
+redisson_tpu.parallel).
+
+State is held as jax Arrays behind a host-side registry keyed by name.
+Mutation is functional: ops compute new arrays and swap the handle under the
+registry lock. Double-buffering for concurrent read-during-merge falls out
+of jax's immutable arrays for free — a reader holding the old Array keeps a
+consistent snapshot while a writer installs the new one (the reference needs
+pub/sub lock machinery for the analogous race, `PubSubConnectionEntry.java`).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from redisson_tpu.ops import crc16
+
+
+class ObjectType:
+    HLL = "hll"
+    BITSET = "bitset"
+    BLOOM = "bloom"
+
+
+@dataclass
+class StoredObject:
+    """One named object: its device state plus immutable metadata."""
+
+    name: str
+    otype: str
+    state: jax.Array
+    slot: int
+    meta: Dict[str, Any] = field(default_factory=dict)
+    version: int = 0
+
+
+class WrongTypeError(TypeError):
+    """Operation against a key holding the wrong kind of value (Redis
+    WRONGTYPE)."""
+
+
+class SketchStore:
+    """Thread-safe name -> StoredObject registry on one device.
+
+    The reference's topology analogue: `slot_of` is the routing function; a
+    sharded deployment (parallel.ShardedStore) partitions names by slot
+    exactly as cluster mode partitions keys.
+    """
+
+    def __init__(self, device: Optional[jax.Device] = None):
+        self._lock = threading.RLock()
+        self._objects: Dict[str, StoredObject] = {}
+        self.device = device if device is not None else jax.devices()[0]
+
+    @staticmethod
+    def slot_of(name: str) -> int:
+        return crc16.key_slot(name)
+
+    def get(self, name: str, otype: Optional[str] = None) -> Optional[StoredObject]:
+        with self._lock:
+            obj = self._objects.get(name)
+        if obj is not None and otype is not None and obj.otype != otype:
+            raise WrongTypeError(
+                f"key '{name}' holds {obj.otype}, operation needs {otype}"
+            )
+        return obj
+
+    def get_or_create(
+        self,
+        name: str,
+        otype: str,
+        factory: Callable[[], jax.Array],
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> StoredObject:
+        with self._lock:
+            obj = self._objects.get(name)
+            if obj is None:
+                state = jax.device_put(factory(), self.device)
+                obj = StoredObject(
+                    name=name,
+                    otype=otype,
+                    state=state,
+                    slot=self.slot_of(name),
+                    meta=dict(meta or {}),
+                )
+                self._objects[name] = obj
+        if obj.otype != otype:
+            raise WrongTypeError(
+                f"key '{name}' holds {obj.otype}, operation needs {otype}"
+            )
+        return obj
+
+    def swap(self, name: str, new_state: jax.Array, expected_version: Optional[int] = None) -> bool:
+        """Install new state; optionally CAS on version (returns False on
+        mismatch, the caller retries against fresh state)."""
+        with self._lock:
+            obj = self._objects.get(name)
+            if obj is None:
+                return False
+            if expected_version is not None and obj.version != expected_version:
+                return False
+            obj.state = new_state
+            obj.version += 1
+            return True
+
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self._objects.pop(name, None) is not None
+
+    def exists(self, name: str) -> bool:
+        with self._lock:
+            return name in self._objects
+
+    def keys(self, pattern: Optional[str] = None):
+        import fnmatch
+
+        with self._lock:
+            names = list(self._objects)
+        if pattern is None or pattern == "*":
+            return names
+        return [n for n in names if fnmatch.fnmatch(n, pattern)]
+
+    def flushall(self) -> None:
+        with self._lock:
+            self._objects.clear()
+
+    def snapshot(self, name: str) -> Optional[jax.Array]:
+        """Consistent read handle (immutability = free double buffering)."""
+        obj = self.get(name)
+        return None if obj is None else obj.state

@@ -1,0 +1,246 @@
+"""End-to-end API tests over the local (CPU) sketch engine — the analogue of
+the reference's per-object functional suites (RedissonHyperLogLogTest,
+RedissonBitSetTest, RedissonBloomFilterTest) against its embedded fixture."""
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _flush(client):
+    client.flushall()
+
+
+class TestHyperLogLog:
+    def test_add_count(self, client):
+        hll = client.get_hyper_log_log("hll:basic")
+        assert hll.add("a") is True
+        hll.add_all(["b", "c", "d", 17, (1, 2)])
+        n = hll.count()
+        assert 5 <= n <= 7  # 6 distinct, small-range exactness not guaranteed
+
+    def test_add_duplicates_not_counted(self, client):
+        hll = client.get_hyper_log_log("hll:dup")
+        hll.add_all(["x"] * 100)
+        assert hll.count() == 1
+
+    def test_count_with_and_merge_with(self, client):
+        a = client.get_hyper_log_log("hll:a")
+        b = client.get_hyper_log_log("hll:b")
+        a.add_all([f"a{i}" for i in range(3000)])
+        b.add_all([f"b{i}" for i in range(3000)])
+        union = a.count_with("hll:b")
+        assert abs(union - 6000) / 6000 < 0.05
+        a.merge_with("hll:b")
+        merged = a.count()
+        assert abs(merged - 6000) / 6000 < 0.05
+        # b unchanged
+        assert abs(b.count() - 3000) / 3000 < 0.05
+
+    def test_int_fast_path_same_as_string_of_bytes(self, client):
+        hll = client.get_hyper_log_log("hll:ints")
+        hll.add_ints(np.arange(50_000, dtype=np.uint64))
+        est = hll.count()
+        assert abs(est - 50_000) / 50_000 < 0.03
+
+    def test_delete_exists(self, client):
+        hll = client.get_hyper_log_log("hll:del")
+        assert not hll.is_exists()
+        hll.add("x")
+        assert hll.is_exists()
+        assert hll.delete() is True
+        assert not hll.is_exists()
+        assert hll.count() == 0
+
+
+class TestBitSet:
+    def test_set_get(self, client):
+        bs = client.get_bit_set("bs:basic")
+        assert bs.set(3) is False  # previous value
+        assert bs.set(3) is True
+        assert bs.get(3) is True
+        assert bs.get(4) is False
+        assert bs.set(3, False) is True
+        assert bs.get(3) is False
+
+    def test_batch_and_aggregates(self, client):
+        bs = client.get_bit_set("bs:agg")
+        old = bs.set_bits([1, 5, 9, 5])
+        assert old.tolist() == [False, False, False, False]
+        assert bs.cardinality() == 3
+        assert bs.length() == 10
+        assert bs.size() >= 10
+
+    def test_auto_grow(self, client):
+        bs = client.get_bit_set("bs:grow")
+        bs.set(100_000)
+        assert bs.get(100_000) is True
+        assert bs.length() == 100_001
+        assert bs.get(1_000_000) is False  # out of allocated range reads 0
+
+    def test_set_range_and_clear(self, client):
+        bs = client.get_bit_set("bs:range")
+        bs.set_range(10, 20)
+        assert bs.cardinality() == 10
+        bs.clear(12, 15)
+        assert bs.cardinality() == 7
+        assert bs.get(12) is False
+        assert bs.get(15) is True
+
+    def test_bitops(self, client):
+        a = client.get_bit_set("bs:opA")
+        b = client.get_bit_set("bs:opB")
+        a.set_bits([1, 2, 3])
+        b.set_bits([2, 3, 4])
+        a.or_("bs:opB")
+        assert np.flatnonzero(a.to_numpy()).tolist() == [1, 2, 3, 4]
+        a2 = client.get_bit_set("bs:opC")
+        a2.set_bits([1, 2])
+        a2.and_("bs:opB")
+        assert np.flatnonzero(a2.to_numpy()).tolist() == [2]
+
+    def test_to_numpy_roundtrip(self, client):
+        bs = client.get_bit_set("bs:np")
+        bs.set_bits([0, 7, 63])
+        arr = bs.to_numpy()
+        assert arr.shape[0] == 64
+        assert np.flatnonzero(arr).tolist() == [0, 7, 63]
+
+
+class TestBloomFilter:
+    def test_try_init_once(self, client):
+        bf = client.get_bloom_filter("bf:init")
+        assert bf.try_init(1000, 0.01) is True
+        assert bf.try_init(1000, 0.01) is False  # already exists
+        assert bf.get_expected_insertions() == 1000
+        assert bf.get_false_probability() == 0.01
+        assert bf.get_size() == 9585  # guava sizing for (1000, 0.01)
+        assert bf.get_hash_iterations() == 7
+
+    def test_add_contains(self, client):
+        bf = client.get_bloom_filter("bf:basic")
+        bf.try_init(10_000, 0.02)
+        members = [f"user:{i}" for i in range(2000)]
+        added = bf.add_all(members)
+        assert added.all()
+        assert bf.contains("user:0")
+        assert bf.contains_all(members).all()
+        added2 = bf.add_all(members)
+        assert not added2.any()
+        fresh = [f"ghost:{i}" for i in range(2000)]
+        fpr = bf.contains_all(fresh).mean()
+        assert fpr < 0.06
+
+    def test_count(self, client):
+        bf = client.get_bloom_filter("bf:count")
+        bf.try_init(10_000, 0.01)
+        bf.add_all([f"k{i}" for i in range(5000)])
+        assert abs(bf.count() - 5000) / 5000 < 0.05
+
+    def test_uninitialized_raises(self, client):
+        bf = client.get_bloom_filter("bf:raw")
+        with pytest.raises(RuntimeError, match="not initialized"):
+            bf.add("x")
+
+
+class TestBatch:
+    def test_pipelined_hll_and_merge(self, client):
+        # BASELINE config #3 shape: pipelined PFADD across sketches + merge.
+        batch = client.create_batch()
+        for s in range(16):
+            batch.get_hyper_log_log(f"batch:hll:{s}").add_all_async(
+                [f"s{s}:k{i}" for i in range(200)]
+            )
+        results = batch.execute()
+        assert len(results) == 16
+        main = client.get_hyper_log_log("batch:hll:0")
+        main.merge_with(*[f"batch:hll:{s}" for s in range(1, 16)])
+        est = main.count()
+        assert abs(est - 3200) / 3200 < 0.05
+
+    def test_results_in_staging_order(self, client):
+        bs = client.get_bit_set("batch:bs")
+        bs.set_bits([0, 1, 2])
+        batch = client.create_batch()
+        batch.get_bit_set("batch:bs").get_bits_async([0])
+        batch.get_hyper_log_log("batch:h").add_all_async(["x"])
+        batch.get_bit_set("batch:bs").get_bits_async([9])
+        r = batch.execute()
+        assert r[0].tolist() == [True]
+        assert r[1] is True
+        assert r[2].tolist() == [False]
+
+    def test_batch_reuse_rejected(self, client):
+        batch = client.create_batch()
+        batch.get_hyper_log_log("batch:r").add_all_async(["x"])
+        batch.execute()
+        with pytest.raises(RuntimeError):
+            batch.execute()
+
+    def test_staged_future_before_execute_raises(self, client):
+        batch = client.create_batch()
+        fut = batch.get_hyper_log_log("batch:f").add_all_async(["x"])
+        with pytest.raises(RuntimeError, match="not executed"):
+            fut.result()
+
+
+class TestExecutorSemantics:
+    def test_per_object_fifo_read_your_writes(self, client):
+        bs = client.get_bit_set("sem:fifo")
+        futs = []
+        for i in range(50):
+            futs.append(bs.set_bits_async([i]))
+            futs.append(bs.get_bits_async([i]))
+        for i in range(50):
+            assert futs[2 * i + 1].result().tolist() == [True]
+
+    def test_wrong_type_error(self, client):
+        client.get_hyper_log_log("sem:type").add("x")
+        with pytest.raises(TypeError):
+            client.get_bit_set("sem:type").set(1)
+
+    def test_concurrent_adds_from_threads(self, client):
+        import threading
+
+        hll = client.get_hyper_log_log("sem:threads")
+
+        def work(t):
+            hll.add_all([f"t{t}:k{i}" for i in range(500)])
+
+        threads = [threading.Thread(target=work, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        est = hll.count()
+        assert abs(est - 4000) / 4000 < 0.05
+
+
+class TestConfig:
+    def test_json_yaml_roundtrip(self):
+        cfg = Config()
+        cfg.use_tpu().hll_impl = "scatter"
+        cfg.flush_interval_s = 5.0
+        as_json = cfg.to_json()
+        back = Config.from_json(as_json)
+        assert back.tpu.hll_impl == "scatter"
+        assert back.flush_interval_s == 5.0
+        back2 = Config.from_yaml(cfg.to_yaml())
+        assert back2.tpu.hll_impl == "scatter"
+
+    def test_mode_exclusivity(self):
+        cfg = Config()
+        cfg.use_local()
+        cfg.use_tpu()
+        with pytest.raises(ValueError):
+            cfg.mode()

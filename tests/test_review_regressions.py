@@ -1,0 +1,113 @@
+"""Regression pins for review findings (oversized ops, BITOP no-source,
+pod lifecycle, clear overloads, redis mode guard, flushall serialization,
+pod changed contract)."""
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def pod():
+    c = RedissonTPU.create(Config.from_yaml("pod:\n  num_shards: 8\n  bank_capacity: 16\n"))
+    yield c
+    c.shutdown()
+
+
+def test_single_op_larger_than_max_bucket(client, monkeypatch):
+    # Shrink the chunk cap so the test stays fast while exercising the
+    # multi-chunk path a 3M-key op would take.
+    from redisson_tpu import engine
+
+    monkeypatch.setattr(engine, "MAX_BUCKET", 1 << 12)
+    hll = client.get_hyper_log_log("reg:bigop")
+    n = (1 << 12) * 3 + 17  # 3+ chunks, ragged tail
+    assert hll.add_ints(np.arange(n, dtype=np.uint64)) is True
+    est = hll.count()
+    assert abs(est - n) / n < 0.05
+
+
+def test_bitop_or_with_missing_source_keeps_destination(client):
+    a = client.get_bit_set("reg:bitop")
+    a.set_bits([1, 2, 3])
+    a.or_("reg:does-not-exist")
+    assert np.flatnonzero(a.to_numpy()).tolist() == [1, 2, 3]
+    a.xor("reg:also-missing")
+    assert np.flatnonzero(a.to_numpy()).tolist() == [1, 2, 3]
+
+
+def test_clear_single_bit_overload(client):
+    bs = client.get_bit_set("reg:clear1")
+    bs.set_bits([4, 5])
+    bs.clear(4)
+    assert bs.get(4) is False
+    assert bs.get(5) is True
+
+
+def test_redis_only_mode_rejected():
+    cfg = Config()
+    cfg.use_redis()
+    with pytest.raises(NotImplementedError):
+        RedissonTPU.create(cfg)
+
+
+def test_pod_lifecycle_delete_exists_flush(pod):
+    pod.flushall()
+    h = pod.get_hyper_log_log("reg:pod:x")
+    assert not h.is_exists()
+    h.add_ints(np.arange(1000, dtype=np.uint64))
+    assert h.is_exists()
+    assert h.count() > 900
+    assert h.delete() is True
+    assert not h.is_exists()
+    assert h.count() == 0
+    # Deleted rows are reused: fill to capacity after a delete cycle.
+    for i in range(16):
+        pod.get_hyper_log_log(f"reg:pod:fill{i}").add("v")
+    with pytest.raises(RuntimeError, match="bank full"):
+        pod.get_hyper_log_log("reg:pod:overflow").add("v")
+    pod.flushall()
+    assert pod.get_hyper_log_log("reg:pod:after").add("v") is True
+
+
+def test_pod_changed_contract(pod):
+    pod.flushall()
+    h = pod.get_hyper_log_log("reg:pod:chg")
+    assert h.add("x") is True
+    assert h.add("x") is False  # same key, no register raised
+    assert h.add("y") is True
+
+
+def test_flushall_serializes_with_inflight_ops(client):
+    import threading
+
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        bs = client.get_bit_set("reg:flush:bs")
+        i = 0
+        while not stop.is_set():
+            try:
+                bs.set_bits([i % 100_000, 100_000 + i % 50_000])
+            except Exception as e:  # any backend crash is a failure
+                errors.append(e)
+                return
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    for _ in range(20):
+        client.flushall()
+    stop.set()
+    t.join()
+    assert not errors, errors
