@@ -2,21 +2,45 @@
 
 Reference: `Redisson.java` (`create(Config)` picks a ConnectionManager,
 `Redisson.java:96-120`; 60+ typed getters bind objects to the shared
-CommandSyncService). Here create() picks a backend by config mode, builds
-the executor waist around it, and the getters hand out objects bound to it.
+CommandSyncService). Here create() picks a backend by config mode, wraps it
+with the RoutingBackend (sketch tier + structure tier), builds the executor
+waist around it, and the getters hand out objects bound to it.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from redisson_tpu.codecs import get_codec
 from redisson_tpu.config import Config, TpuConfig
+from redisson_tpu.eviction import EvictionScheduler
 from redisson_tpu.executor import CommandExecutor
 from redisson_tpu.models.batch import RBatch
 from redisson_tpu.models.bitset import RBitSet
 from redisson_tpu.models.bloomfilter import RBloomFilter
+from redisson_tpu.models.bucket import RAtomicDouble, RAtomicLong, RBucket, RBuckets
+from redisson_tpu.models.collections import RList, RSet
+from redisson_tpu.models.geo import RGeo
 from redisson_tpu.models.hyperloglog import RHyperLogLog
+from redisson_tpu.models.keys import RKeys
+from redisson_tpu.models.lock import (
+    LockWatchdog,
+    RCountDownLatch,
+    RFairLock,
+    RLock,
+    RMultiLock,
+    RReadWriteLock,
+    RSemaphore,
+    new_client_id,
+)
+from redisson_tpu.models.map import RMap
+from redisson_tpu.models.mapcache import RMapCache, RSetCache
+from redisson_tpu.models.multimap import RListMultimap, RSetMultimap
+from redisson_tpu.models.queue import RBlockingDeque, RBlockingQueue, RDeque, RQueue
+from redisson_tpu.models.scoredsortedset import RLexSortedSet, RScoredSortedSet
+from redisson_tpu.models.sortedset import RSortedSet
+from redisson_tpu.models.topic import RPatternTopic, RTopic
+from redisson_tpu.routing import RoutingBackend
 from redisson_tpu.store import SketchStore
 
 
@@ -27,6 +51,7 @@ class RedissonTPU:
         self.config = config or Config()
         mode = self.config.mode()
         self._codec = get_codec(self.config.codec)
+        self.id = new_client_id()  # connection-manager UUID analogue
 
         if mode == "redis":
             raise NotImplementedError(
@@ -37,8 +62,8 @@ class RedissonTPU:
             from redisson_tpu.parallel.backend_pod import PodBackend
 
             tcfg = self.config.pod
-            self._backend = PodBackend(tcfg)
-            self._store = self._backend.store
+            sketch = PodBackend(tcfg)
+            self._store = sketch.store
         else:
             # 'local' runs the same sketch engine on whatever platform jax
             # gives us (cpu in tests); 'tpu' expects a TPU device.
@@ -49,19 +74,24 @@ class RedissonTPU:
             tcfg = self.config.tpu or TpuConfig()
             device = jax.devices()[min(tcfg.device_index, len(jax.devices()) - 1)]
             self._store = SketchStore(device=device)
-            self._backend = TpuBackend(
+            sketch = TpuBackend(
                 self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed
             )
+        self._routing = RoutingBackend(sketch)
+        self._backend = self._routing
         self._widths = tuple(tcfg.key_width_buckets)
         self._executor = CommandExecutor(
-            self._backend, max_batch_keys=tcfg.max_batch_keys
+            self._routing, max_batch_keys=tcfg.max_batch_keys
         )
+        self._pubsub = self._routing.pubsub
+        self._watchdog = LockWatchdog(self._executor)
+        self._eviction = EvictionScheduler(self._executor)
 
     @classmethod
     def create(cls, config: Optional[Config] = None) -> "RedissonTPU":
         return cls(config)
 
-    # -- object getters (Redisson.java getter surface) ----------------------
+    # -- sketch objects (the TPU tier) --------------------------------------
 
     def get_hyper_log_log(self, name: str, codec=None) -> RHyperLogLog:
         return RHyperLogLog(name, self._executor, codec or self._codec, self._widths)
@@ -75,10 +105,107 @@ class RedissonTPU:
     def create_batch(self) -> RBatch:
         return RBatch(self._executor, self._codec, self._widths)
 
-    # -- keys facade (RKeys analogue, partial) ------------------------------
+    # -- structure objects (the long-tail tier) -----------------------------
+
+    def get_bucket(self, name: str, codec=None) -> RBucket:
+        return RBucket(name, self._executor, codec or self._codec, self._widths)
+
+    def get_buckets(self, codec=None) -> RBuckets:
+        return RBuckets(self._executor, codec or self._codec)
+
+    def get_atomic_long(self, name: str) -> RAtomicLong:
+        return RAtomicLong(name, self._executor, self._codec, self._widths)
+
+    def get_atomic_double(self, name: str) -> RAtomicDouble:
+        return RAtomicDouble(name, self._executor, self._codec, self._widths)
+
+    def get_map(self, name: str, codec=None) -> RMap:
+        return RMap(name, self._executor, codec or self._codec, self._widths)
+
+    def get_map_cache(self, name: str, codec=None) -> RMapCache:
+        return RMapCache(
+            name, self._executor, codec or self._codec, self._widths,
+            eviction_scheduler=self._eviction,
+        )
+
+    def get_set(self, name: str, codec=None) -> RSet:
+        return RSet(name, self._executor, codec or self._codec, self._widths)
+
+    def get_set_cache(self, name: str, codec=None) -> RSetCache:
+        return RSetCache(
+            name, self._executor, codec or self._codec, self._widths,
+            eviction_scheduler=self._eviction,
+        )
+
+    def get_list(self, name: str, codec=None) -> RList:
+        return RList(name, self._executor, codec or self._codec, self._widths)
+
+    def get_queue(self, name: str, codec=None) -> RQueue:
+        return RQueue(name, self._executor, codec or self._codec, self._widths)
+
+    def get_deque(self, name: str, codec=None) -> RDeque:
+        return RDeque(name, self._executor, codec or self._codec, self._widths)
+
+    def get_blocking_queue(self, name: str, codec=None) -> RBlockingQueue:
+        return RBlockingQueue(name, self._executor, codec or self._codec, self._widths)
+
+    def get_blocking_deque(self, name: str, codec=None) -> RBlockingDeque:
+        return RBlockingDeque(name, self._executor, codec or self._codec, self._widths)
+
+    def get_sorted_set(self, name: str, codec=None, key: Optional[Callable] = None) -> RSortedSet:
+        return RSortedSet(
+            name, self._executor, codec or self._codec, self._widths, key=key,
+            guard_lock=self.get_lock(name + "__sortedset_guard"),
+        )
+
+    def get_scored_sorted_set(self, name: str, codec=None) -> RScoredSortedSet:
+        return RScoredSortedSet(name, self._executor, codec or self._codec, self._widths)
+
+    def get_lex_sorted_set(self, name: str) -> RLexSortedSet:
+        return RLexSortedSet(name, self._executor, self._codec, self._widths)
+
+    def get_set_multimap(self, name: str, codec=None) -> RSetMultimap:
+        return RSetMultimap(name, self._executor, codec or self._codec, self._widths)
+
+    def get_list_multimap(self, name: str, codec=None) -> RListMultimap:
+        return RListMultimap(name, self._executor, codec or self._codec, self._widths)
+
+    def get_geo(self, name: str, codec=None) -> RGeo:
+        return RGeo(name, self._executor, codec or self._codec, self._widths)
+
+    def get_topic(self, name: str, codec=None) -> RTopic:
+        return RTopic(name, self._executor, codec or self._codec, self._pubsub)
+
+    def get_pattern_topic(self, pattern: str, codec=None) -> RPatternTopic:
+        return RPatternTopic(pattern, self._executor, codec or self._codec, self._pubsub)
+
+    # -- coordination -------------------------------------------------------
+
+    def get_lock(self, name: str) -> RLock:
+        return RLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+
+    def get_fair_lock(self, name: str) -> RFairLock:
+        return RFairLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+
+    def get_read_write_lock(self, name: str) -> RReadWriteLock:
+        return RReadWriteLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+
+    def get_multi_lock(self, *locks: RLock) -> RMultiLock:
+        return RMultiLock(*locks)
+
+    def get_semaphore(self, name: str) -> RSemaphore:
+        return RSemaphore(name, self._executor, self._pubsub)
+
+    def get_count_down_latch(self, name: str) -> RCountDownLatch:
+        return RCountDownLatch(name, self._executor, self._pubsub)
+
+    # -- keys facade (RKeys analogue) ---------------------------------------
+
+    def get_keys(self) -> RKeys:
+        return RKeys(self._executor, self._routing)
 
     def keys(self, pattern: str = "*"):
-        return self._store.keys(pattern)
+        return self._executor.execute_sync("", "keys", {"pattern": pattern})
 
     def flushall(self):
         # Routed through the executor so it serializes with in-flight ops on
@@ -91,7 +218,12 @@ class RedissonTPU:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self):
+        self._eviction.shutdown()
+        self._watchdog.shutdown()
         self._executor.shutdown()
+        # Dispatcher has exited: release threads parked in blocking pops.
+        self._routing.structures.fail_waiters()
+        self._pubsub.shutdown()
 
     def __enter__(self):
         return self

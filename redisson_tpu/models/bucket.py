@@ -1,0 +1,149 @@
+"""RBucket / RBuckets / RAtomicLong / RAtomicDouble.
+
+Reference: `RedissonBucket.java` (GET/SET/GETSET/SETNX/SETEX object holder),
+`RedissonBuckets` multi-get via MGET (`Redisson.java` loadBucketValues),
+`RedissonAtomicLong.java` (INCRBY/DECRBY/GETSET/CAS via WAIT-free commands),
+`RedissonAtomicDouble.java` (INCRBYFLOAT).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional
+
+from redisson_tpu.models.expirable import RExpirable
+from redisson_tpu.models.object import map_future as _map_future
+
+
+class RBucket(RExpirable):
+    """Typed value holder (codec-encoded bytes under one key)."""
+
+    def get(self) -> Any:
+        return self.get_async().result()
+
+    def get_async(self):
+        f = self._executor.execute_async(self.name, "get", None)
+        return _map_future(f, lambda raw: None if raw is None else self._codec.decode(raw))
+
+    def set(self, value: Any, ttl_s: Optional[float] = None) -> None:
+        self.set_async(value, ttl_s).result()
+
+    def set_async(self, value: Any, ttl_s: Optional[float] = None):
+        payload = {"value": self._codec.encode(value)}
+        if ttl_s:
+            payload["ttl_ms"] = int(ttl_s * 1000)
+        return self._executor.execute_async(self.name, "set", payload)
+
+    def get_and_set(self, value: Any) -> Any:
+        raw = self._executor.execute_sync(self.name, "getset", {"value": self._codec.encode(value)})
+        return None if raw is None else self._codec.decode(raw)
+
+    def try_set(self, value: Any, ttl_s: Optional[float] = None) -> bool:
+        payload = {"value": self._codec.encode(value)}
+        if ttl_s:
+            payload["ttl_ms"] = int(ttl_s * 1000)
+        return self._executor.execute_sync(self.name, "setnx", payload)
+
+    def compare_and_set(self, expect: Any, update: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name,
+            "compare_and_set",
+            {
+                "expect": None if expect is None else self._codec.encode(expect),
+                "update": self._codec.encode(update),
+            },
+        )
+
+    def size(self) -> int:
+        return self._executor.execute_sync(self.name, "strlen", None)
+
+
+class RBuckets:
+    """Multi-bucket facade (reference `RBuckets`: MGET/MSET/MSETNX)."""
+
+    def __init__(self, executor, codec):
+        self._executor = executor
+        self._codec = codec
+
+    def get(self, *names: str) -> Dict[str, Any]:
+        raw = self._executor.execute_sync("", "mget", {"names": list(names)})
+        return {k: self._codec.decode(v) for k, v in raw.items()}
+
+    def set(self, values: Dict[str, Any]) -> None:
+        pairs = {k: self._codec.encode(v) for k, v in values.items()}
+        self._executor.execute_sync("", "mset", {"pairs": pairs})
+
+    def try_set(self, values: Dict[str, Any]) -> bool:
+        pairs = {k: self._codec.encode(v) for k, v in values.items()}
+        return self._executor.execute_sync("", "msetnx", {"pairs": pairs})
+
+
+class RAtomicLong(RExpirable):
+    def get(self) -> int:
+        return int(self._executor.execute_sync(self.name, "num_get", {}))
+
+    def set(self, value: int) -> None:
+        self._executor.execute_sync(self.name, "set", {"value": str(int(value)).encode()})
+
+    def increment_and_get(self) -> int:
+        return self.add_and_get(1)
+
+    def decrement_and_get(self) -> int:
+        return self.add_and_get(-1)
+
+    def add_and_get(self, delta: int) -> int:
+        return int(self._executor.execute_sync(self.name, "incr", {"by": int(delta)}))
+
+    def get_and_increment(self) -> int:
+        return self.add_and_get(1) - 1
+
+    def get_and_decrement(self) -> int:
+        return self.add_and_get(-1) + 1
+
+    def get_and_add(self, delta: int) -> int:
+        return self.add_and_get(delta) - int(delta)
+
+    def get_and_set(self, value: int) -> int:
+        return int(self._executor.execute_sync(self.name, "num_getandset", {"value": int(value)}))
+
+    def compare_and_set(self, expect: int, update: int) -> bool:
+        return self._executor.execute_sync(
+            self.name, "num_cas", {"expect": int(expect), "update": int(update)}
+        )
+
+
+class RAtomicDouble(RExpirable):
+    def get(self) -> float:
+        return float(self._executor.execute_sync(self.name, "num_get", {"float": True}))
+
+    def set(self, value: float) -> None:
+        self._executor.execute_sync(self.name, "set", {"value": repr(float(value)).encode()})
+
+    def add_and_get(self, delta: float) -> float:
+        return float(
+            self._executor.execute_sync(self.name, "incr", {"by": float(delta), "float": True})
+        )
+
+    def increment_and_get(self) -> float:
+        return self.add_and_get(1.0)
+
+    def decrement_and_get(self) -> float:
+        return self.add_and_get(-1.0)
+
+    def get_and_add(self, delta: float) -> float:
+        return self.add_and_get(delta) - float(delta)
+
+    def get_and_set(self, value: float) -> float:
+        return float(
+            self._executor.execute_sync(
+                self.name, "num_getandset", {"value": float(value), "float": True}
+            )
+        )
+
+    def compare_and_set(self, expect: float, update: float) -> bool:
+        return self._executor.execute_sync(
+            self.name,
+            "num_cas",
+            {"expect": float(expect), "update": float(update), "float": True},
+        )
+
+

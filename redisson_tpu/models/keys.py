@@ -1,0 +1,46 @@
+"""RKeys — keyspace facade (reference: `RedissonKeys.java` over
+KEYS/RANDOMKEY/DEL/FLUSHALL; fans out across both storage tiers via the
+RoutingBackend, the analogue of `readAllAsync` + SlotCallback)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class RKeys:
+    def __init__(self, executor, routing):
+        self._executor = executor
+        self._routing = routing
+
+    def get_keys(self, pattern: str = "*") -> List[str]:
+        # A real op on the dispatcher thread, so the listing is serialized
+        # with in-flight mutations across both tiers.
+        return self._executor.execute_sync("", "keys", {"pattern": pattern})
+
+    def get_keys_by_pattern(self, pattern: str) -> List[str]:
+        return self.get_keys(pattern)
+
+    def random_key(self) -> Optional[str]:
+        import random
+
+        keys = self.get_keys()
+        return random.choice(keys) if keys else None
+
+    def count(self) -> int:
+        return len(self.get_keys())
+
+    def delete(self, *names: str) -> int:
+        n = 0
+        for name in names:
+            if self._executor.execute_sync(name, "delete", None):
+                n += 1
+        return n
+
+    def delete_by_pattern(self, pattern: str) -> int:
+        return self.delete(*self.get_keys(pattern))
+
+    def flushall(self) -> None:
+        self._executor.execute_sync("", "flushall", None)
+
+    def flushdb(self) -> None:
+        self.flushall()

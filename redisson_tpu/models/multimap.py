@@ -1,0 +1,93 @@
+"""RSetMultimap / RListMultimap (reference: `RedissonSetMultimap.java`,
+`RedissonListMultimap.java`, `RedissonListMultimapValues.java` 714 LoC —
+key -> sub-collection of values)."""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Tuple
+
+from redisson_tpu.models.expirable import RExpirable
+
+
+class _RMultimap(RExpirable):
+    _IS_LIST = False
+
+    def _p(self, **kw) -> dict:
+        kw["list"] = self._IS_LIST
+        return kw
+
+    def _ek(self, k: Any) -> bytes:
+        return self._codec.encode(k)
+
+    def _ev(self, v: Any) -> bytes:
+        return self._codec.encode(v)
+
+    def _d(self, raw) -> Any:
+        return None if raw is None else self._codec.decode(raw)
+
+    def put(self, key: Any, value: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name, "mm_put", self._p(key=self._ek(key), value=self._ev(value))
+        )
+
+    def put_all(self, key: Any, values: Iterable[Any]) -> bool:
+        changed = False
+        for v in values:
+            changed |= self.put(key, v)
+        return changed
+
+    def get_all(self, key: Any) -> List[Any]:
+        raw = self._executor.execute_sync(self.name, "mm_get_all", self._p(key=self._ek(key)))
+        return [self._d(v) for v in raw]
+
+    def remove(self, key: Any, value: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name, "mm_remove", self._p(key=self._ek(key), value=self._ev(value))
+        )
+
+    def remove_all(self, key: Any) -> List[Any]:
+        raw = self._executor.execute_sync(self.name, "mm_remove_all", self._p(key=self._ek(key)))
+        return [self._d(v) for v in raw]
+
+    def key_set(self) -> List[Any]:
+        return [self._d(k) for k in self._executor.execute_sync(self.name, "mm_keys", self._p())]
+
+    def key_size(self) -> int:
+        return self._executor.execute_sync(self.name, "mm_key_size", self._p())
+
+    def size(self) -> int:
+        return self._executor.execute_sync(self.name, "mm_size", self._p())
+
+    def contains_key(self, key: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name, "mm_contains_key", self._p(key=self._ek(key))
+        )
+
+    def contains_value(self, value: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name, "mm_contains_value", self._p(value=self._ev(value))
+        )
+
+    def contains_entry(self, key: Any, value: Any) -> bool:
+        return self._executor.execute_sync(
+            self.name, "mm_contains_entry", self._p(key=self._ek(key), value=self._ev(value))
+        )
+
+    def entries(self) -> List[Tuple[Any, Any]]:
+        raw = self._executor.execute_sync(self.name, "mm_entries", self._p())
+        return [(self._d(k), self._d(v)) for k, v in raw]
+
+
+class RSetMultimap(_RMultimap):
+    """Values per key form a set (duplicate entries collapse)."""
+
+    _IS_LIST = False
+
+    def get_all(self, key: Any):  # set semantics on read
+        return set(super().get_all(key))
+
+
+class RListMultimap(_RMultimap):
+    """Values per key form a list (duplicates and order preserved)."""
+
+    _IS_LIST = True

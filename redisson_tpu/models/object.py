@@ -10,6 +10,30 @@ import numpy as np
 from redisson_tpu.codecs import Codec, encode_key
 
 
+def map_future(f, fn):
+    """Chain a decode step onto an executor future (async mirrors return
+    decoded values, like the reference's reply convertors)."""
+    from concurrent.futures import Future
+
+    out = Future()
+
+    def done(src):
+        if src.cancelled():
+            out.cancel()
+            return
+        exc = src.exception()
+        if exc is not None:
+            out.set_exception(exc)
+        else:
+            try:
+                out.set_result(fn(src.result()))
+            except Exception as e:  # decode error
+                out.set_exception(e)
+
+    f.add_done_callback(done)
+    return out
+
+
 class RObject:
     """name + codec + executor; all state lives behind the executor."""
 

@@ -1,0 +1,133 @@
+"""RQueue / RDeque / RBlockingQueue / RBlockingDeque.
+
+Reference: `RedissonQueue.java` (LPUSH/RPOP family), `RedissonDeque.java`,
+`RedissonBlockingQueue.java` — blocking pops ride the L2 no-timeout path
+(`CommandAsyncService.java:491-497`); here they ride the engine's waiter
+protocol (park a future, fulfilled by the push that satisfies it; timeouts
+resolved by a `bpop_cancel` op so the race is serialized on the dispatcher).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any, Iterable, List, Optional
+
+from redisson_tpu.models.collections import RList
+
+
+class RQueue(RList):
+    """FIFO over the list type (offer=RPUSH, poll=LPOP)."""
+
+    def offer(self, value: Any) -> bool:
+        return self._executor.execute_sync(self.name, "rpush", {"values": [self._e(value)]}) > 0
+
+    def offer_async(self, value: Any):
+        return self._executor.execute_async(self.name, "rpush", {"values": [self._e(value)]})
+
+    def poll(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "lpop", None))
+
+    def peek(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "lindex", {"index": 0}))
+
+    def poll_last_and_offer_first_to(self, dest: str) -> Any:
+        """RPOPLPUSH."""
+        return self._d(self._executor.execute_sync(self.name, "rpoplpush", {"dst": dest}))
+
+
+class RDeque(RQueue):
+    def add_first(self, value: Any) -> None:
+        self._executor.execute_sync(self.name, "lpush", {"values": [self._e(value)]})
+
+    def add_last(self, value: Any) -> None:
+        self._executor.execute_sync(self.name, "rpush", {"values": [self._e(value)]})
+
+    def offer_first(self, value: Any) -> bool:
+        return self._executor.execute_sync(self.name, "lpush", {"values": [self._e(value)]}) > 0
+
+    def offer_last(self, value: Any) -> bool:
+        return self.offer(value)
+
+    def poll_first(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "lpop", None))
+
+    def poll_last(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "rpop", None))
+
+    def peek_first(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "lindex", {"index": 0}))
+
+    def peek_last(self) -> Any:
+        return self._d(self._executor.execute_sync(self.name, "lindex", {"index": -1}))
+
+    def pop(self) -> Any:
+        return self.poll_first()
+
+    def push(self, value: Any) -> None:
+        self.add_first(value)
+
+
+class RBlockingQueue(RQueue):
+    """take()/poll(timeout) parity with `RedissonBlockingQueue.java`."""
+
+    def _blocking_pop(self, timeout_s: Optional[float], side: str, dest: Optional[str] = None):
+        payload = {"side": side}
+        if dest is not None:
+            payload["dest"] = dest
+        f = self._executor.execute_async(self.name, "bpop", payload)
+        try:
+            raw = f.result(timeout=timeout_s)
+        except concurrent.futures.TimeoutError:
+            # Serialize the cancel/fulfill race on the dispatcher: the cancel
+            # op reads the waiter id the bpop handler wrote into the shared
+            # payload. If a push won the race, the future already has the
+            # value and the cancel is a no-op.
+            self._executor.execute_sync(self.name, "bpop_cancel", {"ref": payload})
+            raw = f.result(timeout=0) if f.done() else None
+        return self._d(raw)
+
+    def take(self) -> Any:
+        """Block until an element arrives (BLPOP with no timeout)."""
+        return self._blocking_pop(None, "left")
+
+    def poll(self, timeout_s: Optional[float] = None) -> Any:
+        if timeout_s is None:
+            return super().poll()
+        return self._blocking_pop(timeout_s, "left")
+
+    def poll_last_and_offer_first_to(self, dest: str, timeout_s: Optional[float] = None) -> Any:
+        """BRPOPLPUSH / RPOPLPUSH."""
+        if timeout_s is None:
+            return super().poll_last_and_offer_first_to(dest)
+        return self._blocking_pop(timeout_s, "right", dest=dest)
+
+    def put(self, value: Any) -> None:
+        self.offer(value)
+
+    def drain_to(self, collection: List[Any], max_elements: Optional[int] = None) -> int:
+        n = 0
+        while max_elements is None or n < max_elements:
+            v = super().poll()
+            if v is None:
+                break
+            collection.append(v)
+            n += 1
+        return n
+
+
+class RBlockingDeque(RBlockingQueue, RDeque):
+    def take_first(self) -> Any:
+        return self._blocking_pop(None, "left")
+
+    def take_last(self) -> Any:
+        return self._blocking_pop(None, "right")
+
+    def poll_first(self, timeout_s: Optional[float] = None) -> Any:
+        if timeout_s is None:
+            return RDeque.poll_first(self)
+        return self._blocking_pop(timeout_s, "left")
+
+    def poll_last(self, timeout_s: Optional[float] = None) -> Any:
+        if timeout_s is None:
+            return RDeque.poll_last(self)
+        return self._blocking_pop(timeout_s, "right")

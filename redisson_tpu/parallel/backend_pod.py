@@ -71,6 +71,22 @@ class PodBackend:
             return
         self._delegate.run(kind, target, ops)
 
+    def handles(self, kind: str) -> bool:
+        """Op kinds served here or by the single-chip delegate (the
+        RoutingBackend probes this before falling back to the structure
+        engine)."""
+        return hasattr(self, "_op_" + kind) or hasattr(self._delegate, "_op_" + kind)
+
+    def names(self, pattern: str = "*") -> List[str]:
+        """Bank-resident names + delegate-store names (RKeys support)."""
+        import fnmatch
+
+        out = dict.fromkeys(self.store.keys(pattern))
+        for n in self._rows:
+            if pattern in (None, "*") or fnmatch.fnmatchcase(n, pattern):
+                out[n] = None
+        return list(out)
+
     # -- lifecycle ops must see bank-resident HLLs too ----------------------
 
     def _op_delete(self, target: str, ops: List[Op]) -> None:

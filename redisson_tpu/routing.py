@@ -1,0 +1,88 @@
+"""RoutingBackend — composes the device sketch backend with the structures
+engine behind the single CommandExecutor waist.
+
+The analogue of the reference's NodeSource routing inside
+`CommandAsyncService.async()` (`command/CommandAsyncService.java:378`):
+where the reference picks a Redis node per key slot, we pick the *tier* per
+op kind — sketch kinds go to the TPU/pod backend, everything else to the
+in-process structure engine. Keyspace-wide ops (delete/exists/flushall/keys)
+fan out to both tiers and reduce, mirroring `readAllAsync` + SlotCallback
+(`CommandAsyncService.java:128-164`).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from redisson_tpu.executor import Op
+from redisson_tpu.structures.engine import StructureBackend
+
+
+class RoutingBackend:
+    """kind-based router between the sketch tier and the structure tier."""
+
+    def __init__(self, sketch_backend, structures: Optional[StructureBackend] = None):
+        self.sketch = sketch_backend
+        self.structures = structures or StructureBackend()
+        self.GLOBAL_COALESCE = frozenset(getattr(sketch_backend, "GLOBAL_COALESCE", ()))
+        self.pubsub = self.structures.pubsub
+
+    # sketch kinds = everything the sketch backend implements, minus the
+    # keyspace-wide ops we intercept.
+    _BOTH = {"delete", "exists", "flushall", "keys"}
+
+    def _sketch_handles(self, kind: str) -> bool:
+        # Backends that wrap a delegate (PodBackend) answer through
+        # handles(); plain backends by _op_* probing.
+        handles = getattr(self.sketch, "handles", None)
+        if callable(handles):
+            return handles(kind)
+        return hasattr(self.sketch, "_op_" + kind)
+
+    def run(self, kind: str, target: str, ops: List[Op]) -> None:
+        if kind in self._BOTH:
+            getattr(self, "_both_" + kind)(target, ops)
+            return
+        if self._sketch_handles(kind):
+            self.sketch.run(kind, target, ops)
+            return
+        self.structures.run(kind, target, ops)
+
+    # -- keyspace-wide fan-out ----------------------------------------------
+
+    def _sketch_side(self, kind: str, target: str):
+        """Run the sketch backend's own handler (it may hold state outside
+        the store, e.g. the pod bank rows) and return its result."""
+        probe = Op(target=target, kind=kind, payload=None)
+        self.sketch.run(kind, target, [probe])
+        return probe.future.result()
+
+    def _both_delete(self, target: str, ops: List[Op]) -> None:
+        res = bool(self._sketch_side("delete", target)) | self.structures.delete(target)
+        for op in ops:
+            op.future.set_result(res)
+
+    def _both_exists(self, target: str, ops: List[Op]) -> None:
+        res = bool(self._sketch_side("exists", target)) or self.structures.exists(target)
+        for op in ops:
+            op.future.set_result(res)
+
+    def _both_flushall(self, target: str, ops: List[Op]) -> None:
+        self._sketch_side("flushall", "")
+        self.structures.flushall()
+        for op in ops:
+            op.future.set_result(None)
+
+    def _both_keys(self, target: str, ops: List[Op]) -> None:
+        """KEYS across both tiers, serialized on the dispatcher thread."""
+        for op in ops:
+            pattern = (op.payload or {}).get("pattern", "*")
+            op.future.set_result(self.keys(pattern))
+
+    def keys(self, pattern: str = "*") -> List[str]:
+        names = getattr(self.sketch, "names", None)
+        sketch_keys = names(pattern) if callable(names) else self.sketch.store.keys(pattern)
+        seen = dict.fromkeys(sketch_keys)
+        for k in self.structures.keys(pattern):
+            seen[k] = None
+        return list(seen)

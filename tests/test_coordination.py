@@ -1,0 +1,434 @@
+"""Coordination + caching tests, modeled on the reference's concurrency
+suites (RedissonLockTest, RedissonSemaphoreTest,
+RedissonCountDownLatchConcurrentTest, RedissonTopicTest,
+RedissonBlockingQueueTest, RedissonMapCacheTest)."""
+
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _flush(client):
+    client.flushall()
+    yield
+
+
+# ---- locks ----------------------------------------------------------------
+
+
+def test_lock_basic(client):
+    lk = client.get_lock("lk")
+    assert not lk.is_locked()
+    lk.lock()
+    assert lk.is_locked()
+    assert lk.is_held_by_current_thread()
+    assert lk.get_hold_count() == 1
+    lk.lock()  # reentrant
+    assert lk.get_hold_count() == 2
+    lk.unlock()
+    assert lk.is_locked()
+    lk.unlock()
+    assert not lk.is_locked()
+
+
+def test_lock_unlock_not_owner_raises(client):
+    lk = client.get_lock("lk2")
+    with pytest.raises(RuntimeError):
+        lk.unlock()
+
+
+def test_lock_contention_across_threads(client):
+    lk = client.get_lock("lk3")
+    order = []
+
+    def worker(i):
+        with client.get_lock("lk3"):
+            order.append(("in", i))
+            time.sleep(0.02)
+            order.append(("out", i))
+
+    lk.lock()
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    assert order == []  # all blocked while we hold it
+    lk.unlock()
+    for t in threads:
+        t.join(timeout=5)
+    # mutual exclusion: in/out strictly alternate
+    assert len(order) == 6
+    for j in range(0, 6, 2):
+        assert order[j][0] == "in" and order[j + 1][0] == "out"
+        assert order[j][1] == order[j + 1][1]
+
+
+def test_try_lock_timeout(client):
+    lk = client.get_lock("lk4")
+    lk.lock()
+
+    result = {}
+
+    def attempt():
+        other = client.get_lock("lk4")
+        t0 = time.monotonic()
+        result["ok"] = other.try_lock(wait_time_s=0.1)
+        result["dt"] = time.monotonic() - t0
+
+    t = threading.Thread(target=attempt)
+    t.start()
+    t.join(timeout=5)
+    assert result["ok"] is False
+    assert result["dt"] >= 0.09
+    lk.unlock()
+
+
+def test_lock_lease_expiry_allows_takeover(client):
+    lk = client.get_lock("lk5")
+    assert lk.try_lock(lease_time_s=0.05)
+    done = {}
+
+    def taker():
+        done["ok"] = client.get_lock("lk5").try_lock(wait_time_s=2.0, lease_time_s=1.0)
+
+    t = threading.Thread(target=taker)
+    t.start()
+    t.join(timeout=5)
+    assert done["ok"] is True  # lease expired -> orphan reaped
+
+
+def test_force_unlock(client):
+    lk = client.get_lock("lk6")
+    lk.lock()
+    assert lk.force_unlock()
+    assert not lk.is_locked()
+
+
+def test_fair_lock_fifo(client):
+    lk = client.get_fair_lock("flk")
+    lk.lock()
+    acquired = []
+
+    def worker(i):
+        w = client.get_fair_lock("flk")
+        w.lock()
+        acquired.append(i)
+        w.unlock()
+
+    threads = []
+    for i in range(3):
+        t = threading.Thread(target=worker, args=(i,))
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)  # establish queue order
+    lk.unlock()
+    for t in threads:
+        t.join(timeout=5)
+    assert acquired == [0, 1, 2]
+
+
+def test_read_write_lock(client):
+    rw = client.get_read_write_lock("rw")
+    r1 = rw.read_lock()
+    r1.lock()
+    # second reader (other thread) may enter
+    got = {}
+
+    def reader():
+        r = client.get_read_write_lock("rw").read_lock()
+        got["r"] = r.try_lock(wait_time_s=0.5)
+        if got["r"]:
+            r.unlock()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join(timeout=5)
+    assert got["r"] is True
+
+    def writer():
+        w = client.get_read_write_lock("rw").write_lock()
+        got["w"] = w.try_lock(wait_time_s=0.2)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join(timeout=5)
+    assert got["w"] is False  # writer blocked by reader
+    r1.unlock()
+
+
+def test_multi_lock(client):
+    locks = [client.get_lock(f"ml{i}") for i in range(3)]
+    ml = client.get_multi_lock(*locks)
+    assert ml.try_lock()
+    assert all(lk.is_locked() for lk in locks)
+    ml.unlock()
+    assert not any(lk.is_locked() for lk in locks)
+
+    # if one child is held elsewhere, acquisition fails and rolls back
+    blocker = {}
+
+    def hold():
+        lk = client.get_lock("ml1")
+        lk.lock()
+        blocker["ev"].wait()
+        lk.unlock()
+
+    blocker["ev"] = threading.Event()
+    t = threading.Thread(target=hold)
+    t.start()
+    time.sleep(0.05)
+    assert not ml.try_lock(wait_time_s=0.1)
+    assert not locks[0].is_locked()  # rolled back
+    blocker["ev"].set()
+    t.join(timeout=5)
+
+
+# ---- semaphore / latch ----------------------------------------------------
+
+
+def test_semaphore(client):
+    sem = client.get_semaphore("sem")
+    assert sem.try_set_permits(2)
+    assert not sem.try_set_permits(5)
+    assert sem.try_acquire()
+    assert sem.try_acquire()
+    assert not sem.try_acquire()
+    sem.release()
+    assert sem.available_permits() == 1
+    assert sem.try_acquire(permits=1, timeout_s=0.1)
+    assert sem.drain_permits() == 0
+    sem.add_permits(3)
+    assert sem.available_permits() == 3
+    sem.reduce_permits(1)
+    assert sem.available_permits() == 2
+
+
+def test_semaphore_blocking_release(client):
+    sem = client.get_semaphore("sem2")
+    sem.try_set_permits(0)
+    got = {}
+
+    def acq():
+        got["ok"] = sem.try_acquire(timeout_s=2.0)
+
+    t = threading.Thread(target=acq)
+    t.start()
+    time.sleep(0.05)
+    sem.release()
+    t.join(timeout=5)
+    assert got["ok"] is True
+
+
+def test_count_down_latch(client):
+    latch = client.get_count_down_latch("cdl")
+    assert latch.try_set_count(3)
+    assert latch.get_count() == 3
+    done = {}
+
+    def waiter():
+        done["ok"] = latch.await_(timeout_s=3.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    for _ in range(3):
+        latch.count_down()
+    t.join(timeout=5)
+    assert done["ok"] is True
+    assert latch.get_count() == 0
+    assert latch.await_(timeout_s=0.01)  # already zero
+
+
+# ---- topic ----------------------------------------------------------------
+
+
+def test_topic_pubsub(client):
+    topic = client.get_topic("news")
+    got = []
+    ev = threading.Event()
+
+    def listener(channel, msg):
+        got.append((channel, msg))
+        ev.set()
+
+    lid = topic.add_listener(listener)
+    n = topic.publish({"headline": "hello"})
+    assert n == 1
+    assert ev.wait(timeout=2)
+    assert got == [("news", {"headline": "hello"})]
+    topic.remove_listener(lid)
+    assert topic.publish("ignored") == 0
+
+
+def test_pattern_topic(client):
+    pt = client.get_pattern_topic("evt:*")
+    got = []
+    ev = threading.Event()
+
+    def listener(pattern, channel, msg):
+        got.append((pattern, channel, msg))
+        ev.set()
+
+    pt.add_listener(listener)
+    client.get_topic("evt:a").publish("m1")
+    assert ev.wait(timeout=2)
+    assert got == [("evt:*", "evt:a", "m1")]
+    pt.remove_all_listeners()
+    assert client.get_topic("evt:b").publish("m2") == 0
+
+
+# ---- blocking queue -------------------------------------------------------
+
+
+def test_blocking_queue_immediate(client):
+    q = client.get_blocking_queue("bq")
+    q.offer("a")
+    assert q.take() == "a"
+
+
+def test_blocking_queue_poll_timeout(client):
+    q = client.get_blocking_queue("bq2")
+    t0 = time.monotonic()
+    assert q.poll(timeout_s=0.15) is None
+    assert time.monotonic() - t0 >= 0.14
+
+
+def test_blocking_queue_take_waits_for_push(client):
+    q = client.get_blocking_queue("bq3")
+    got = {}
+
+    def taker():
+        got["v"] = q.take()
+
+    t = threading.Thread(target=taker)
+    t.start()
+    time.sleep(0.05)
+    client.get_blocking_queue("bq3").offer("pushed")
+    t.join(timeout=5)
+    assert got["v"] == "pushed"
+
+
+def test_blocking_queue_fifo_waiters(client):
+    q = client.get_blocking_queue("bq4")
+    got = []
+    lock = threading.Lock()
+
+    def taker(i):
+        v = q.poll(timeout_s=5.0)
+        with lock:
+            got.append((i, v))
+
+    threads = []
+    for i in range(2):
+        t = threading.Thread(target=taker, args=(i,))
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)
+    q.offer("first")
+    q.offer("second")
+    for t in threads:
+        t.join(timeout=5)
+    assert {v for _, v in got} == {"first", "second"}
+    # FIFO: the first-parked waiter gets the first element
+    assert dict(got)[0] == "first"
+
+
+def test_blocking_deque_and_brpoplpush(client):
+    d = client.get_blocking_deque("bd")
+    d.add_first("x")
+    assert d.take_last() == "x"
+
+    q = client.get_blocking_queue("bsrc")
+    got = {}
+
+    def mover():
+        got["v"] = q.poll_last_and_offer_first_to("bdst", timeout_s=3.0)
+
+    t = threading.Thread(target=mover)
+    t.start()
+    time.sleep(0.05)
+    q.offer("moved")
+    t.join(timeout=5)
+    assert got["v"] == "moved"
+    assert client.get_queue("bdst").peek() == "moved"
+
+
+# ---- caches ---------------------------------------------------------------
+
+
+def test_map_cache_ttl(client):
+    mc = client.get_map_cache("mc")
+    assert mc.put("k", "v", ttl_s=0.05) is None
+    assert mc.get("k") == "v"
+    assert mc.contains_key("k")
+    time.sleep(0.08)
+    assert mc.get("k") is None
+    assert not mc.contains_key("k")
+
+    mc.put("p", "forever")
+    assert mc.get("p") == "forever"
+    assert mc.put_if_absent("p", "nope") == "forever"
+    assert mc.put_if_absent("q", "yes") is None
+    assert mc.size() == 2
+    assert mc.remove("q") == "yes"
+
+
+def test_map_cache_max_idle(client):
+    mc = client.get_map_cache("mc2")
+    mc.put("k", "v", max_idle_s=0.1)
+    for _ in range(3):  # touches keep it alive
+        time.sleep(0.04)
+        assert mc.get("k") == "v"
+    time.sleep(0.15)  # no touch -> idles out
+    assert mc.get("k") is None
+
+
+def test_map_cache_eviction_sweep(client):
+    mc = client.get_map_cache("mc3")
+    for i in range(10):
+        mc.put(f"k{i}", i, ttl_s=0.03)
+    mc.put("keep", "alive")
+    time.sleep(0.06)
+    removed = mc.evict_expired()
+    assert removed == 10
+    assert mc.read_all_map() == {"keep": "alive"}
+
+
+def test_set_cache(client):
+    sc = client.get_set_cache("sc")
+    assert sc.add("a", ttl_s=0.05)
+    assert sc.add("b")
+    assert sc.contains("a")
+    assert sc.size() == 2
+    time.sleep(0.08)
+    assert not sc.contains("a")
+    assert sc.size() == 1
+    assert sc.read_all() == {"b"}
+    assert sc.remove("b")
+    assert not sc.remove("b")
+
+
+# ---- cross-tier sanity ----------------------------------------------------
+
+
+def test_sketch_and_structures_coexist(client):
+    hll = client.get_hyper_log_log("mix:hll")
+    hll.add_all([f"u{i}" for i in range(100)])
+    m = client.get_map("mix:map")
+    m.fast_put("count", 100)
+    assert abs(hll.count() - 100) <= 3
+    assert m.get("count") == 100
+    assert set(client.keys("mix:*")) == {"mix:hll", "mix:map"}
+    client.flushall()
+    assert client.keys("mix:*") == []

@@ -111,3 +111,126 @@ def test_flushall_serializes_with_inflight_ops(client):
     stop.set()
     t.join()
     assert not errors, errors
+
+
+# ---- round-1 structures review pins ---------------------------------------
+
+
+def test_pod_mode_bitset_bloom_route_to_sketch_tier(pod):
+    """Pod mode serves bitset/bloom via the delegate; the router must not
+    misroute them to the structure engine."""
+    pod.flushall()
+    bs = pod.get_bit_set("reg:pod:bs")
+    bs.set(3)
+    assert bs.get(3) is True
+    assert bs.cardinality() == 1
+    bf = pod.get_bloom_filter("reg:pod:bf")
+    assert bf.try_init(1000, 0.01)
+    bf.add("k")
+    assert bf.contains("k")
+    pod.flushall()
+
+
+def test_fair_lock_abandoned_waiter_does_not_wedge(client):
+    import time
+
+    lk = client.get_fair_lock("reg:flk")
+    lk.lock()
+
+    import threading
+
+    def failed_waiter():
+        client.get_fair_lock("reg:flk").try_lock(wait_time_s=0.05)
+
+    t = threading.Thread(target=failed_waiter)
+    t.start()
+    t.join(timeout=5)
+    lk.unlock()
+    # the abandoned waiter dequeued itself on timeout; lock is acquirable
+    assert lk.try_lock(wait_time_s=1.0)
+    lk.unlock()
+
+
+def test_write_lock_not_downgraded_by_reentrant_read(client):
+    import threading
+
+    rw = client.get_read_write_lock("reg:rw")
+    w = rw.write_lock()
+    r = rw.read_lock()
+    w.lock()
+    r.lock()  # read-after-write is legal and must keep exclusion
+
+    got = {}
+
+    def other_reader():
+        orr = client.get_read_write_lock("reg:rw").read_lock()
+        got["ok"] = orr.try_lock(wait_time_s=0.1)
+
+    t = threading.Thread(target=other_reader)
+    t.start()
+    t.join(timeout=5)
+    assert got["ok"] is False  # still write-excluded
+    r.unlock()
+    w.unlock()
+
+
+def test_shutdown_releases_blocked_take():
+    import threading
+
+    c = RedissonTPU.create(Config())
+    q = c.get_blocking_queue("reg:bqshut")
+    res = {}
+
+    def taker():
+        try:
+            res["v"] = q.take()
+        except RuntimeError as e:
+            res["exc"] = str(e)
+
+    t = threading.Thread(target=taker)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    c.shutdown()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert "exc" in res
+
+
+def test_sorted_set_concurrent_adds_stay_sorted(client):
+    import random
+    import threading
+
+    ss = client.get_sorted_set("reg:ss:conc")
+    vals = list(range(120))
+    random.shuffle(vals)
+    chunks = [vals[i::4] for i in range(4)]
+
+    def adder(chunk):
+        s = client.get_sorted_set("reg:ss:conc")
+        for v in chunk:
+            s.add(v)
+
+    threads = [threading.Thread(target=adder, args=(c,)) for c in chunks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    out = ss.read_all()
+    assert out == sorted(out)
+    assert len(out) == 120
+
+
+def test_lock_renew_does_not_resurrect_key(client):
+    # watchdog renewal racing an unlock must not recreate the lock key
+    client._executor.execute_sync("reg:ghostlock", "lock_renew", {"owner": "o", "lease_ms": 1000})
+    assert "reg:ghostlock" not in client.keys("reg:ghost*")
+
+
+def test_map_cache_delete_unschedules_sweep(client):
+    mc = client.get_map_cache("reg:mc:del")
+    assert "reg:mc:del" in client._eviction._timers
+    mc.put("a", 1)
+    mc.delete()
+    assert "reg:mc:del" not in client._eviction._timers

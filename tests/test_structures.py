@@ -1,0 +1,407 @@
+"""Functional tests for the long-tail structure objects, modeled on the
+reference's per-object suites (RedissonMapTest, RedissonSetTest,
+RedissonListTest, RedissonScoredSortedSetTest, ...)."""
+
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _flush(client):
+    client.flushall()
+    yield
+
+
+# ---- bucket / atomics -----------------------------------------------------
+
+
+def test_bucket_set_get(client):
+    b = client.get_bucket("b1")
+    assert b.get() is None
+    b.set({"a": 1})
+    assert b.get() == {"a": 1}
+    assert b.is_exists()
+    assert b.delete()
+    assert b.get() is None
+
+
+def test_bucket_try_set_and_cas(client):
+    b = client.get_bucket("b2")
+    assert b.try_set("v1")
+    assert not b.try_set("v2")
+    assert b.get() == "v1"
+    assert b.compare_and_set("v1", "v3")
+    assert not b.compare_and_set("v1", "v4")
+    assert b.get() == "v3"
+    assert b.get_and_set("v5") == "v3"
+
+
+def test_bucket_ttl(client):
+    b = client.get_bucket("b3")
+    b.set("x", ttl_s=0.05)
+    assert b.get() == "x"
+    time.sleep(0.08)
+    assert b.get() is None
+
+
+def test_buckets_multi(client):
+    client.get_bucket("m1").set(1)
+    client.get_bucket("m2").set(2)
+    bs = client.get_buckets()
+    assert bs.get("m1", "m2", "m3") == {"m1": 1, "m2": 2}
+    bs.set({"m4": 4, "m5": 5})
+    assert client.get_bucket("m4").get() == 4
+    assert not bs.try_set({"m5": 9, "m6": 6})  # m5 exists -> all-or-nothing
+    assert client.get_bucket("m6").get() is None
+
+
+def test_atomic_long(client):
+    al = client.get_atomic_long("al")
+    assert al.get() == 0
+    assert al.increment_and_get() == 1
+    assert al.add_and_get(10) == 11
+    assert al.get_and_increment() == 11
+    assert al.get() == 12
+    assert al.compare_and_set(12, 100)
+    assert not al.compare_and_set(12, 200)
+    assert al.get_and_set(7) == 100
+    assert al.decrement_and_get() == 6
+
+
+def test_atomic_double(client):
+    ad = client.get_atomic_double("ad")
+    assert ad.get() == 0.0
+    assert ad.add_and_get(1.5) == pytest.approx(1.5)
+    assert ad.increment_and_get() == pytest.approx(2.5)
+    assert ad.compare_and_set(2.5, 10.0)
+    assert ad.get() == pytest.approx(10.0)
+
+
+# ---- map ------------------------------------------------------------------
+
+
+def test_map_basic(client):
+    m = client.get_map("m")
+    assert m.put("k1", "v1") is None
+    assert m.put("k1", "v2") == "v1"
+    assert m.get("k1") == "v2"
+    assert m.size() == 1
+    assert m.fast_put("k2", {"x": [1, 2]})
+    assert not m.fast_put("k2", "other")
+    assert m.contains_key("k2")
+    assert m.contains_value("v2")
+    assert not m.contains_value("nope")
+    assert sorted(m.key_set()) == ["k1", "k2"]
+    assert m.remove("k1") == "v2"
+    assert m.remove("k1") is None
+    assert m.fast_remove("k2", "kx") == 1
+    assert m.size() == 0
+
+
+def test_map_compound_ops(client):
+    m = client.get_map("m2")
+    assert m.put_if_absent("a", 1) is None
+    assert m.put_if_absent("a", 2) == 1
+    assert m.replace("a", 5) == 1
+    assert m.replace("missing", 5) is None
+    assert m.replace("a", 5, 6)
+    assert not m.replace("a", 5, 7)
+    assert m.remove("a", 99) is False
+    assert m.remove("a", 6) is True
+    m.put_all({"x": 1, "y": 2, "z": 3})
+    assert m.get_all(["x", "z", "nope"]) == {"x": 1, "z": 3}
+    assert m.read_all_map() == {"x": 1, "y": 2, "z": 3}
+    assert m.add_and_get("ctr", 5) == 5
+    assert m.add_and_get("ctr", -2) == 3
+    assert m.add_and_get("fctr", 0.5) == pytest.approx(0.5)
+
+
+def test_map_iteration_and_sugar(client):
+    m = client.get_map("m3")
+    for i in range(25):
+        m[f"k{i}"] = i
+    assert len(m) == 25
+    assert dict(m.iter_entries(count=7)) == {f"k{i}": i for i in range(25)}
+    assert m["k3"] == 3
+    assert "k3" in m
+    del m["k3"]
+    assert "k3" not in m
+    with pytest.raises(KeyError):
+        m["k3"]
+
+
+# ---- set ------------------------------------------------------------------
+
+
+def test_set_basic(client):
+    s = client.get_set("s")
+    assert s.add("a")
+    assert not s.add("a")
+    assert s.add_all(["b", "c"])
+    assert s.size() == 3
+    assert s.contains("b")
+    assert s.read_all() == {"a", "b", "c"}
+    assert s.remove("b")
+    assert not s.remove("b")
+    assert s.contains_all(["a", "c"])
+    assert not s.contains_all(["a", "zz"])
+    got = s.remove_random(1)
+    assert len(got) == 1 and got[0] in {"a", "c"}
+
+
+def test_set_algebra(client):
+    s1, s2 = client.get_set("sa"), client.get_set("sb")
+    s1.add_all([1, 2, 3, 4])
+    s2.add_all([3, 4, 5])
+    assert s1.read_intersection("sb") == {3, 4}
+    assert s1.read_union("sb") == {1, 2, 3, 4, 5}
+    assert s1.read_diff("sb") == {1, 2}
+    assert s1.retain_all([1, 2, 3])  # changed
+    assert s1.read_all() == {1, 2, 3}
+    assert not s1.retain_all([1, 2, 3])  # unchanged
+    assert s1.union("sb") == 5
+    assert s1.read_all() == {1, 2, 3, 4, 5}
+
+
+def test_set_move_and_iter(client):
+    s1, s2 = client.get_set("mv1"), client.get_set("mv2")
+    s1.add_all(range(20))
+    assert s1.move("mv2", 7)
+    assert not s1.contains(7)
+    assert s2.contains(7)
+    assert set(s1.iterator(count=6)) == set(range(20)) - {7}
+
+
+# ---- list / queue ---------------------------------------------------------
+
+
+def test_list_basic(client):
+    lst = client.get_list("l")
+    assert lst.add("a")
+    lst.add_all(["b", "c", "d"])
+    assert lst.size() == 4
+    assert lst.get(0) == "a"
+    assert lst.get(-1) == "d"
+    assert lst.read_all() == ["a", "b", "c", "d"]
+    assert lst.index_of("c") == 2
+    assert lst.index_of("zz") == -1
+    lst.insert(1, "x")
+    assert lst.read_all() == ["a", "x", "b", "c", "d"]
+    assert lst.set(0, "A") == "a"
+    assert lst.remove_at(1) == "x"
+    assert lst.remove("c")
+    assert lst.read_all() == ["A", "b", "d"]
+    lst.trim(0, 1)
+    assert lst.read_all() == ["A", "b"]
+
+
+def test_list_duplicates_lrem(client):
+    lst = client.get_list("l2")
+    lst.add_all(["a", "b", "a", "c", "a"])
+    assert lst.last_index_of("a") == 4
+    assert lst.remove("a", count=2)
+    assert lst.read_all() == ["b", "c", "a"]
+
+
+def test_queue_deque(client):
+    q = client.get_queue("q")
+    assert q.offer("1")
+    q.offer("2")
+    assert q.peek() == "1"
+    assert q.poll() == "1"
+    assert q.poll() == "2"
+    assert q.poll() is None
+
+    d = client.get_deque("dq")
+    d.add_last("m")
+    d.add_first("f")
+    d.add_last("l")
+    assert d.peek_first() == "f"
+    assert d.peek_last() == "l"
+    assert d.poll_last() == "l"
+    assert d.poll_first() == "f"
+
+
+def test_rpoplpush(client):
+    q1, q2 = client.get_queue("qa"), client.get_queue("qb")
+    q1.offer("x")
+    q1.offer("y")
+    assert q1.poll_last_and_offer_first_to("qb") == "y"
+    assert q2.peek() == "y"
+
+
+# ---- zset -----------------------------------------------------------------
+
+
+def test_scored_sorted_set(client):
+    z = client.get_scored_sorted_set("z")
+    assert z.add(3.0, "c")
+    assert z.add(1.0, "a")
+    assert z.add(2.0, "b")
+    assert not z.add(5.0, "a")  # update, not add
+    assert z.size() == 3
+    assert z.get_score("b") == 2.0
+    assert z.rank("b") == 0
+    assert z.value_range(0, -1) == ["b", "c", "a"]  # a moved to score 5
+    assert z.entry_range(0, 0) == [("b", 2.0)]
+    assert z.rev_rank("a") == 0
+    assert z.first() == "b"
+    assert z.last() == "a"
+    assert z.count(min=2.0, max=5.0, min_inc=True, max_inc=False) == 2
+    assert z.add_score("b", 10.0) == 12.0
+    assert z.poll_first() == "c"
+    assert z.remove("a")
+    assert not z.remove("a")
+
+
+def test_zset_range_by_score_and_remove(client):
+    z = client.get_scored_sorted_set("z2")
+    z.add_all([(float(i), f"m{i}") for i in range(10)])
+    assert z.value_range_by_score(2.0, True, 5.0, False) == ["m2", "m3", "m4"]
+    assert z.value_range_by_score(None, True, 3.0, True, offset=1, count=2) == ["m1", "m2"]
+    assert z.remove_range_by_score(0.0, True, 4.0, True) == 5
+    assert z.size() == 5
+    assert z.remove_range_by_rank(0, 1) == 2
+    assert z.value_range(0, -1) == ["m7", "m8", "m9"]
+    assert z.union("z_missing") == 3
+
+
+def test_lex_sorted_set(client):
+    lx = client.get_lex_sorted_set("lex")
+    assert lx.add_all(["b", "a", "d", "c"]) == 4
+    assert lx.read_all() == ["a", "b", "c", "d"]
+    assert lx.lex_range(from_element="b", from_inclusive=True) == ["b", "c", "d"]
+    assert lx.lex_range(from_element="b", from_inclusive=False) == ["c", "d"]
+    assert lx.lex_range_head("c", inclusive=False) == ["a", "b"]
+    assert lx.lex_count(from_element="a", from_inclusive=False, to_element="d", to_inclusive=False) == 2
+    assert lx.remove_range(from_element="a", from_inclusive=True, to_element="b", to_inclusive=True) == 2
+    assert lx.read_all() == ["c", "d"]
+
+
+def test_sorted_set_comparator(client):
+    ss = client.get_sorted_set("ss")
+    assert ss.add(5)
+    assert ss.add(1)
+    assert ss.add(3)
+    assert not ss.add(3)
+    assert ss.read_all() == [1, 3, 5]
+    assert ss.first() == 1 and ss.last() == 5
+    assert ss.contains(3)
+    assert not ss.contains(4)
+    assert ss.remove(3)
+    assert ss.read_all() == [1, 5]
+    # custom key: reverse order
+    ss2 = client.get_sorted_set("ss2", key=lambda v: -v)
+    ss2.add_all([1, 5, 3])
+    assert ss2.read_all() == [5, 3, 1]
+
+
+# ---- multimap -------------------------------------------------------------
+
+
+def test_set_multimap(client):
+    mm = client.get_set_multimap("smm")
+    assert mm.put("k1", "a")
+    assert mm.put("k1", "b")
+    assert not mm.put("k1", "a")  # set semantics
+    assert mm.get_all("k1") == {"a", "b"}
+    assert mm.size() == 2
+    assert mm.key_size() == 1
+    assert mm.contains_key("k1")
+    assert mm.contains_entry("k1", "a")
+    assert not mm.contains_entry("k1", "zz")
+    assert mm.contains_value("b")
+    assert mm.remove("k1", "a")
+    assert sorted(mm.remove_all("k1")) == ["b"]
+    assert mm.size() == 0
+
+
+def test_list_multimap(client):
+    mm = client.get_list_multimap("lmm")
+    mm.put("k", "a")
+    mm.put("k", "a")
+    mm.put("k", "b")
+    assert mm.get_all("k") == ["a", "a", "b"]  # duplicates preserved
+    assert mm.size() == 3
+    assert mm.remove("k", "a")
+    assert mm.get_all("k") == ["a", "b"]
+    entries = mm.entries()
+    assert ("k", "b") in entries
+
+
+# ---- geo ------------------------------------------------------------------
+
+
+def test_geo(client):
+    g = client.get_geo("geo")
+    assert g.add_entries(
+        (13.361389, 38.115556, "Palermo"), (15.087269, 37.502669, "Catania")
+    ) == 2
+    d = g.dist("Palermo", "Catania", unit="km")
+    assert d == pytest.approx(166.27, abs=1.0)
+    pos = g.pos("Palermo")
+    assert pos["Palermo"][0] == pytest.approx(13.361389)
+    hits = g.radius(15.0, 37.0, 200, unit="km")
+    assert set(hits) == {"Palermo", "Catania"}
+    assert g.radius(15.0, 37.0, 100, unit="km") == ["Catania"]
+    with_dist = g.radius_with_distance(15.0, 37.0, 200, unit="km")
+    assert with_dist["Catania"] < with_dist["Palermo"]
+    assert g.radius_by_member("Palermo", 200, unit="km") == ["Palermo", "Catania"]
+
+
+# ---- keys / expiry --------------------------------------------------------
+
+
+def test_keys_facade(client):
+    client.get_bucket("kx:1").set(1)
+    client.get_map("kx:2").fast_put("a", 1)
+    client.get_hyper_log_log("kx:3").add("v")
+    keys = client.get_keys()
+    assert set(keys.get_keys("kx:*")) == {"kx:1", "kx:2", "kx:3"}
+    assert keys.count() >= 3
+    assert keys.delete("kx:1", "kx:nope") == 1
+    assert keys.delete_by_pattern("kx:*") == 2
+    assert keys.get_keys("kx:*") == []
+
+
+def test_expirable_surface(client):
+    m = client.get_map("exp")
+    m.fast_put("a", 1)
+    assert m.remain_time_to_live() == -1
+    assert m.expire(0.05)
+    ttl = m.remain_time_to_live()
+    assert 0 < ttl <= 50
+    assert m.clear_expire()
+    assert m.remain_time_to_live() == -1
+    assert m.expire(0.03)
+    time.sleep(0.06)
+    assert not m.is_exists()
+    assert m.remain_time_to_live() == -2
+
+
+def test_rename(client):
+    b = client.get_bucket("rn1")
+    b.set("v")
+    b.rename("rn2")
+    assert b.name == "rn2"
+    assert client.get_bucket("rn2").get() == "v"
+    assert client.get_bucket("rn1").get() is None
+
+
+def test_wrongtype_guard(client):
+    client.get_bucket("wt").set("v")
+    from redisson_tpu.store import WrongTypeError
+
+    with pytest.raises(WrongTypeError):
+        client.get_map("wt").fast_put("a", 1)
