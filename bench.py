@@ -43,22 +43,28 @@ def main():
         batches.append((jax.device_put(hi, dev), jax.device_put(lo, dev)))
     valid = jax.device_put(np.ones((n,), bool), dev)
 
+    # The TPU tunnel in this image shows intermittent ~70 ms dispatch stalls
+    # on synced calls; time pipelined rounds (dispatch all, sync once) and
+    # keep the best round as the device-rate estimate.
     best = 0.0
     for impl in ("scatter", "sort"):
         regs = jax.device_put(hll.make(), dev)
         # Warmup / compile.
         regs, _ = engine.hll_add_u64(regs, *batches[0], valid, impl, 0)
         regs.block_until_ready()
-        t0 = time.perf_counter()
-        for r in range(1, reps):
-            regs, _ = engine.hll_add_u64(regs, *batches[r], valid, impl, 0)
-        regs.block_until_ready()
-        dt = time.perf_counter() - t0
-        rate = (reps - 1) * n / dt
+        rate = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for r in range(1, reps):
+                regs, _ = engine.hll_add_u64(regs, *batches[r], valid, impl, 0)
+            regs.block_until_ready()
+            dt = time.perf_counter() - t0
+            rate = max(rate, (reps - 1) * n / dt)
         print(f"# hll_add[{impl}]: {rate/1e6:.1f} M inserts/s", file=sys.stderr)
         est = float(engine.hll_count(regs))
         print(f"# count est {est/1e6:.2f}M (true ~{reps*n/1e6:.2f}M)", file=sys.stderr)
-        best = max(best, rate)
+        if impl == "scatter":
+            best = rate  # headline: the default engine path
 
     # Secondary: PFMERGE across 1K sketches (BASELINE: <50 ms).
     stack = jax.device_put(
@@ -66,11 +72,13 @@ def main():
     )
     merged = engine.hll_count_merged(stack)  # compile
     merged.block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(10):
-        merged = engine.hll_count_merged(stack)
-    merged.block_until_ready()
-    merge_ms = (time.perf_counter() - t0) / 10 * 1e3
+    merge_ms = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            merged = engine.hll_count_merged(stack)
+        merged.block_until_ready()
+        merge_ms = min(merge_ms, (time.perf_counter() - t0) / 10 * 1e3)
     print(f"# pfmerge(1000 sketches)+count: {merge_ms:.2f} ms", file=sys.stderr)
 
     print(

@@ -24,7 +24,7 @@ from redisson_tpu.store import ObjectType, SketchStore
 class TpuBackend:
     """Stateless op interpreter over a SketchStore (all state lives there)."""
 
-    def __init__(self, store: SketchStore, hll_impl: str = "sort", seed: int = 0):
+    def __init__(self, store: SketchStore, hll_impl: str = "scatter", seed: int = 0):
         self.store = store
         self.hll_impl = hll_impl
         self.seed = seed
@@ -258,16 +258,12 @@ class TpuBackend:
                 if a.shape[0] < width:
                     a = jnp.zeros((width,), jnp.uint8).at[: a.shape[0]].set(a)
                 padded.append(a)
-            fn = {
-                "and": bitset_ops.bitop_and,
-                "or": bitset_ops.bitop_or,
-                "xor": bitset_ops.bitop_xor,
-            }[kind]
             # No existing sources: BITOP with only the destination leaves it
             # unchanged (never wipe the destination).
-            acc = padded[0]
-            for a in padded[1:]:
-                acc = fn(acc, a)
+            if len(padded) == 1:
+                acc = padded[0]
+            else:
+                acc = engine.bitset_bitop(jnp.stack(padded), kind)
             obj.meta["nbits"] = width
             self.store.swap(target, acc)
             op.future.set_result(None)

@@ -35,7 +35,7 @@ class TpuConfig:
     """Single-chip sketch engine."""
 
     device_index: int = 0
-    hll_impl: str = "sort"  # 'sort' | 'scatter'
+    hll_impl: str = "scatter"  # "scatter" | "sort"; scatter ~30 us vs sort ~75 ms per 1M-key batch on v5e (ops/hll.py)
     hash_seed: int = 0
     max_batch_keys: int = 1 << 21
     key_width_buckets: tuple = (16, 32, 64, 128, 256)

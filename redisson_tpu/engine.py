@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 
 from redisson_tpu.ops import bitset, bloom, hashing, hll
+from redisson_tpu.ops import pallas_kernels as pk
 from redisson_tpu.ops.u64 import U64
 
 # Batch-size buckets: powers of two between MIN_BUCKET and MAX_BUCKET keys.
@@ -83,14 +84,14 @@ def pad_ints(arr, fill=0):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
-def hll_add_bytes(regs, data, lengths, valid, impl: str = "sort", seed: int = 0):
+def hll_add_bytes(regs, data, lengths, valid, impl: str = "scatter", seed: int = 0):
     """PFADD of a padded byte-key batch. Returns (new_regs, changed)."""
     h1, _ = hashing.murmur3_x64_128(data, lengths, seed)
     return _hll_add(regs, h1, valid, impl)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
-def hll_add_u64(regs, hi, lo, valid, impl: str = "sort", seed: int = 0):
+def hll_add_u64(regs, hi, lo, valid, impl: str = "scatter", seed: int = 0):
     """PFADD of a padded uint64-key batch (8-byte LE fast path)."""
     h1, _ = hashing.murmur3_x64_128_u64(U64(hi, lo), seed)
     return _hll_add(regs, h1, valid, impl)
@@ -120,17 +121,26 @@ def hll_merge(dst, src):
 
 
 def hll_merge_all(arrays):
-    """Merge a python list of register arrays (eager maximum chain)."""
-    acc = arrays[0]
-    for a in arrays[1:]:
-        acc = hll_merge(acc, a)
-    return acc
+    """Merge a python list of register arrays (one stacked bank reduce)."""
+    if len(arrays) == 1:
+        return arrays[0]
+    if len(arrays) == 2:
+        return hll_merge(arrays[0], arrays[1])
+    return hll_merge_stack(jnp.stack(arrays))
+
+
+@jax.jit
+def hll_merge_stack(stack):
+    """PFMERGE over an [S, m] bank (pallas streaming kernel on TPU)."""
+    if pk.use_pallas():
+        return pk.merge_stack(stack)
+    return jnp.max(stack, axis=0)
 
 
 @jax.jit
 def hll_count_merged(stack):
     """Count over [S, m] pre-stacked sketches without mutating them."""
-    return hll.count(jnp.max(stack, axis=0))
+    return hll.count(hll_merge_stack(stack))
 
 
 # ---------------------------------------------------------------------------
@@ -162,7 +172,21 @@ def bitset_get(bits, idx, valid):
 
 @jax.jit
 def bitset_cardinality(bits):
+    if pk.use_pallas():
+        return pk.popcount_cells(bits)
     return bitset.cardinality(bits)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def bitset_bitop(stack, op: str):
+    """BITOP AND|OR|XOR over [K, n] stacked operands -> [n]."""
+    if pk.use_pallas():
+        return pk.bitop_cells(stack, op)
+    fn = {"and": jnp.bitwise_and, "or": jnp.bitwise_or, "xor": jnp.bitwise_xor}[op]
+    acc = stack[0]
+    for k in range(1, stack.shape[0]):
+        acc = fn(acc, stack[k])
+    return acc
 
 
 @jax.jit

@@ -1,0 +1,93 @@
+"""Pallas kernels vs their XLA/numpy references (interpret mode on CPU)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from redisson_tpu import engine
+from redisson_tpu.ops import hll
+from redisson_tpu.ops import pallas_kernels as pk
+
+
+class TestMergeStack:
+    def test_matches_xla_max(self):
+        rng = np.random.default_rng(0)
+        stack = rng.integers(0, 52, size=(37, hll.M), dtype=np.int32)
+        got = np.asarray(pk.merge_stack(jnp.asarray(stack), block=8))
+        np.testing.assert_array_equal(got, stack.max(axis=0))
+
+    def test_single_sketch(self):
+        rng = np.random.default_rng(1)
+        stack = rng.integers(0, 52, size=(1, hll.M), dtype=np.int32)
+        got = np.asarray(pk.merge_stack(jnp.asarray(stack), block=8))
+        np.testing.assert_array_equal(got, stack[0])
+
+    def test_exact_block_multiple(self):
+        rng = np.random.default_rng(2)
+        stack = rng.integers(0, 52, size=(16, hll.M), dtype=np.int32)
+        got = np.asarray(pk.merge_stack(jnp.asarray(stack), block=8))
+        np.testing.assert_array_equal(got, stack.max(axis=0))
+
+    def test_empty_stack(self):
+        got = np.asarray(pk.merge_stack(jnp.zeros((0, hll.M), jnp.int32)))
+        np.testing.assert_array_equal(got, np.zeros(hll.M, np.int32))
+
+    def test_count_of_merge_matches(self):
+        rng = np.random.default_rng(3)
+        stack = rng.integers(0, 30, size=(10, hll.M), dtype=np.int32)
+        merged = pk.merge_stack(jnp.asarray(stack), block=4)
+        a = float(hll.count_jit(merged))
+        b = float(hll.count_jit(jnp.max(jnp.asarray(stack), axis=0)))
+        assert a == pytest.approx(b, rel=1e-6)
+
+
+class TestPopcountCells:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(4)
+        cells = (rng.random(100_000) < 0.3).astype(np.uint8)
+        got = int(pk.popcount_cells(jnp.asarray(cells), block=4096))
+        assert got == int(cells.sum())
+
+    def test_ragged_tail(self):
+        cells = np.ones(5001, np.uint8)
+        assert int(pk.popcount_cells(jnp.asarray(cells), block=4096)) == 5001
+
+    def test_empty(self):
+        assert int(pk.popcount_cells(jnp.zeros((0,), jnp.uint8))) == 0
+
+
+class TestBitopCells:
+    @pytest.mark.parametrize("op,fn", [
+        ("and", np.bitwise_and), ("or", np.bitwise_or), ("xor", np.bitwise_xor),
+    ])
+    def test_matches_numpy(self, op, fn):
+        rng = np.random.default_rng(5)
+        stack = (rng.random((3, 7001)) < 0.5).astype(np.uint8)
+        got = np.asarray(pk.bitop_cells(jnp.asarray(stack), op, block=2048))
+        want = fn(fn(stack[0], stack[1]), stack[2])
+        np.testing.assert_array_equal(got, want)
+
+    def test_two_operands(self):
+        rng = np.random.default_rng(6)
+        stack = (rng.random((2, 512)) < 0.5).astype(np.uint8)
+        got = np.asarray(pk.bitop_cells(jnp.asarray(stack), "xor", block=256))
+        np.testing.assert_array_equal(got, stack[0] ^ stack[1])
+
+
+class TestEngineWiring:
+    """The engine routes bank ops through the kernels (XLA path off-TPU,
+    pallas on TPU — semantics must agree, asserted here via the engine)."""
+
+    def test_merge_all_stacked(self):
+        rng = np.random.default_rng(7)
+        arrays = [jnp.asarray(rng.integers(0, 52, hll.M, dtype=np.int32))
+                  for _ in range(5)]
+        got = np.asarray(engine.hll_merge_all(arrays))
+        want = np.max(np.stack([np.asarray(a) for a in arrays]), axis=0)
+        np.testing.assert_array_equal(got, want)
+
+    def test_bitset_bitop(self):
+        rng = np.random.default_rng(8)
+        stack = (rng.random((3, 300)) < 0.5).astype(np.uint8)
+        got = np.asarray(engine.bitset_bitop(jnp.asarray(stack), "or"))
+        np.testing.assert_array_equal(got, stack[0] | stack[1] | stack[2])
