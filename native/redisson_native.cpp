@@ -1,0 +1,417 @@
+// redisson_tpu native runtime — C ABI shared library.
+//
+// TPU-native counterpart of the reference's two external native components
+// (see SURVEY.md §2 header): the openhft zero-allocation hash intrinsics
+// (/root/reference src: RedissonBloomFilter.java:117-118, misc/Hash.java:30-31)
+// and the Netty epoll transport codec path (client/handler/CommandEncoder.java,
+// client/handler/CommandDecoder.java). Here they become:
+//
+//   * batch MurmurHash3 x64 128 / xxHash64 over variable-length host keys —
+//     the host ingest path that turns raw byte keys into u64 lanes before a
+//     single fixed-shape device dispatch (hash-on-host, scatter-on-TPU);
+//   * CRC16 (Redis key-slot polynomial, connection/CRC16.java) with hashtag
+//     extraction (cluster/ClusterConnectionManager.java:543-558 semantics);
+//   * a RESP2 wire codec: pipeline encoder + incremental streaming parser
+//     (the durability/interop client's hot path).
+//
+// Everything is plain C ABI for ctypes; no Python headers needed.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+#include <string>
+
+#if defined(_WIN32)
+#define RTPU_EXPORT extern "C" __declspec(dllexport)
+#else
+#define RTPU_EXPORT extern "C" __attribute__((visibility("default")))
+#endif
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x64 128  (spec: smhasher MurmurHash3_x64_128)
+// ---------------------------------------------------------------------------
+
+static inline uint64_t rotl64(uint64_t x, int8_t r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t fmix64(uint64_t k) {
+  k ^= k >> 33;
+  k *= 0xff51afd7ed558ccdULL;
+  k ^= k >> 33;
+  k *= 0xc4ceb9fe1a85ec53ULL;
+  k ^= k >> 33;
+  return k;
+}
+
+static inline uint64_t load_le64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86/arm64)
+  return v;
+}
+
+static void murmur3_x64_128_one(const uint8_t* data, int64_t len, uint64_t seed,
+                                uint64_t* out_h1, uint64_t* out_h2) {
+  const uint64_t c1 = 0x87c37b91114253d5ULL;
+  const uint64_t c2 = 0x4cf5ad432745937fULL;
+  uint64_t h1 = seed, h2 = seed;
+  const int64_t nblocks = len / 16;
+
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint64_t k1 = load_le64(data + i * 16);
+    uint64_t k2 = load_le64(data + i * 16 + 8);
+    k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+    h1 = rotl64(h1, 27); h1 += h2; h1 = h1 * 5 + 0x52dce729ULL;
+    k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2;
+    h2 = rotl64(h2, 31); h2 += h1; h2 = h2 * 5 + 0x38495ab5ULL;
+  }
+
+  const uint8_t* tail = data + nblocks * 16;
+  uint64_t k1 = 0, k2 = 0;
+  switch (len & 15) {
+    case 15: k2 ^= (uint64_t)tail[14] << 48; [[fallthrough]];
+    case 14: k2 ^= (uint64_t)tail[13] << 40; [[fallthrough]];
+    case 13: k2 ^= (uint64_t)tail[12] << 32; [[fallthrough]];
+    case 12: k2 ^= (uint64_t)tail[11] << 24; [[fallthrough]];
+    case 11: k2 ^= (uint64_t)tail[10] << 16; [[fallthrough]];
+    case 10: k2 ^= (uint64_t)tail[9] << 8; [[fallthrough]];
+    case 9:  k2 ^= (uint64_t)tail[8];
+             k2 *= c2; k2 = rotl64(k2, 33); k2 *= c1; h2 ^= k2; [[fallthrough]];
+    case 8:  k1 ^= (uint64_t)tail[7] << 56; [[fallthrough]];
+    case 7:  k1 ^= (uint64_t)tail[6] << 48; [[fallthrough]];
+    case 6:  k1 ^= (uint64_t)tail[5] << 40; [[fallthrough]];
+    case 5:  k1 ^= (uint64_t)tail[4] << 32; [[fallthrough]];
+    case 4:  k1 ^= (uint64_t)tail[3] << 24; [[fallthrough]];
+    case 3:  k1 ^= (uint64_t)tail[2] << 16; [[fallthrough]];
+    case 2:  k1 ^= (uint64_t)tail[1] << 8; [[fallthrough]];
+    case 1:  k1 ^= (uint64_t)tail[0];
+             k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2; h1 ^= k1;
+  }
+
+  h1 ^= (uint64_t)len; h2 ^= (uint64_t)len;
+  h1 += h2; h2 += h1;
+  h1 = fmix64(h1); h2 = fmix64(h2);
+  h1 += h2; h2 += h1;
+  *out_h1 = h1; *out_h2 = h2;
+}
+
+// Batch over n variable-length keys stored concatenated in `data`;
+// offsets[n+1] delimits key i as data[offsets[i]:offsets[i+1]].
+RTPU_EXPORT void rtpu_murmur3_x64_128_batch(const uint8_t* data,
+                                            const int64_t* offsets, int64_t n,
+                                            uint64_t seed, uint64_t* out_h1,
+                                            uint64_t* out_h2) {
+  for (int64_t i = 0; i < n; i++) {
+    murmur3_x64_128_one(data + offsets[i], offsets[i + 1] - offsets[i], seed,
+                        out_h1 + i, out_h2 + i);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// xxHash64  (spec: xxhash.com XXH64)
+// ---------------------------------------------------------------------------
+
+static const uint64_t XXP1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t XXP2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t XXP3 = 0x165667B19E3779F9ULL;
+static const uint64_t XXP4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t XXP5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t xx_round(uint64_t acc, uint64_t lane) {
+  acc += lane * XXP2;
+  acc = rotl64(acc, 31);
+  return acc * XXP1;
+}
+
+static uint64_t xxhash64_one(const uint8_t* p, int64_t len, uint64_t seed) {
+  const uint8_t* end = p + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + XXP1 + XXP2, v2 = seed + XXP2, v3 = seed,
+             v4 = seed - XXP1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = xx_round(v1, load_le64(p)); p += 8;
+      v2 = xx_round(v2, load_le64(p)); p += 8;
+      v3 = xx_round(v3, load_le64(p)); p += 8;
+      v4 = xx_round(v4, load_le64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = (h ^ xx_round(0, v1)) * XXP1 + XXP4;
+    h = (h ^ xx_round(0, v2)) * XXP1 + XXP4;
+    h = (h ^ xx_round(0, v3)) * XXP1 + XXP4;
+    h = (h ^ xx_round(0, v4)) * XXP1 + XXP4;
+  } else {
+    h = seed + XXP5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h ^= xx_round(0, load_le64(p));
+    h = rotl64(h, 27) * XXP1 + XXP4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    h ^= (uint64_t)v * XXP1;
+    h = rotl64(h, 23) * XXP2 + XXP3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (uint64_t)(*p) * XXP5;
+    h = rotl64(h, 11) * XXP1;
+    p++;
+  }
+  h ^= h >> 33; h *= XXP2; h ^= h >> 29; h *= XXP3; h ^= h >> 32;
+  return h;
+}
+
+RTPU_EXPORT void rtpu_xxhash64_batch(const uint8_t* data,
+                                     const int64_t* offsets, int64_t n,
+                                     uint64_t seed, uint64_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = xxhash64_one(data + offsets[i], offsets[i + 1] - offsets[i], seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CRC16 — Redis key-slot polynomial (CCITT, poly 0x1021), lookup table.
+// Matches /root/reference connection/CRC16.java.
+// ---------------------------------------------------------------------------
+
+struct Crc16Table {
+  uint16_t tab[256];
+  Crc16Table() {
+    for (int i = 0; i < 256; i++) {
+      uint16_t crc = (uint16_t)(i << 8);
+      for (int j = 0; j < 8; j++)
+        crc = (crc & 0x8000) ? (uint16_t)((crc << 1) ^ 0x1021)
+                             : (uint16_t)(crc << 1);
+      tab[i] = crc;
+    }
+  }
+};
+static const Crc16Table crc16_table;  // built at load time: no init race
+static const uint16_t* const crc16_tab = crc16_table.tab;
+
+static uint16_t crc16_one(const uint8_t* p, int64_t len) {
+  uint16_t crc = 0;
+  for (int64_t i = 0; i < len; i++)
+    crc = (uint16_t)((crc << 8) ^ crc16_tab[((crc >> 8) ^ p[i]) & 0xFF]);
+  return crc;
+}
+
+RTPU_EXPORT uint16_t rtpu_crc16(const uint8_t* p, int64_t len) {
+  return crc16_one(p, len);
+}
+
+// Slot calc with {hashtag} extraction: if the key contains a non-empty
+// brace-delimited section, only that section is hashed (Redis cluster rule).
+RTPU_EXPORT void rtpu_keyslot_batch(const uint8_t* data, const int64_t* offsets,
+                                    int64_t n, int32_t* out_slots) {
+  for (int64_t i = 0; i < n; i++) {
+    const uint8_t* p = data + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    int64_t start = -1;
+    for (int64_t j = 0; j < len; j++) {
+      if (p[j] == '{') { start = j + 1; break; }
+    }
+    if (start >= 0) {
+      for (int64_t j = start; j < len; j++) {
+        if (p[j] == '}') {
+          if (j > start) { p += start; len = j - start; }
+          break;
+        }
+      }
+    }
+    out_slots[i] = crc16_one(p, len) & 16383;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// RESP2 pipeline encoder.
+//
+// Input: nargs byte strings (concatenated + offsets) per command, ncmds
+// commands delimited by cmd_arg_counts. Output: a single malloc'd buffer the
+// caller frees with rtpu_free. Layout mirrors the reference CommandEncoder
+// (*N\r\n then $len\r\n<arg>\r\n per arg) and CommandBatchEncoder
+// (concatenation).
+// ---------------------------------------------------------------------------
+
+RTPU_EXPORT void rtpu_free(void* p) { std::free(p); }
+
+RTPU_EXPORT uint8_t* rtpu_resp_encode_pipeline(const uint8_t* args,
+                                               const int64_t* offsets,
+                                               const int32_t* cmd_arg_counts,
+                                               int64_t ncmds,
+                                               int64_t* out_len) {
+  std::string out;
+  out.reserve(256 * (size_t)ncmds);
+  char head[32];
+  int64_t a = 0;
+  for (int64_t c = 0; c < ncmds; c++) {
+    int n = std::snprintf(head, sizeof(head), "*%d\r\n", cmd_arg_counts[c]);
+    out.append(head, n);
+    for (int32_t k = 0; k < cmd_arg_counts[c]; k++, a++) {
+      int64_t len = offsets[a + 1] - offsets[a];
+      n = std::snprintf(head, sizeof(head), "$%lld\r\n", (long long)len);
+      out.append(head, n);
+      out.append((const char*)(args + offsets[a]), (size_t)len);
+      out.append("\r\n", 2);
+    }
+  }
+  uint8_t* buf = (uint8_t*)std::malloc(out.size() ? out.size() : 1);
+  std::memcpy(buf, out.data(), out.size());
+  *out_len = (int64_t)out.size();
+  return buf;
+}
+
+// ---------------------------------------------------------------------------
+// RESP2 incremental parser.
+//
+// Streaming, reentrant across partial reads — the C++ analogue of the
+// reference's ReplayingDecoder checkpoint machine
+// (client/handler/CommandDecoder.java State/StateLevel). Completed replies
+// are serialized into a flat little-endian stream Python unpacks:
+//   [u8 type][i64 payload]
+//     type '+' / '-' / '$': payload = byte length, followed by the bytes
+//                           ($ with length -1 = null bulk, no bytes)
+//     type ':'            : payload = integer value, no bytes
+//     type '*'            : payload = element count (-1 = null array);
+//                           elements follow recursively, pre-order
+// ---------------------------------------------------------------------------
+
+struct RespParser {
+  std::string buf;      // unconsumed wire bytes
+  size_t pos = 0;       // parse cursor into buf
+  std::string out;      // flattened completed replies
+  int64_t nready = 0;   // completed top-level replies in `out`
+};
+
+RTPU_EXPORT RespParser* rtpu_resp_parser_new() { return new RespParser(); }
+RTPU_EXPORT void rtpu_resp_parser_free(RespParser* p) { delete p; }
+
+static void emit_header(std::string& out, uint8_t type, int64_t payload) {
+  out.push_back((char)type);
+  out.append((const char*)&payload, 8);
+}
+
+// Try to parse one reply at `pos`; append flattened form to `out`.
+// Returns true and advances pos past the reply on success; false (pos
+// untouched, out possibly partially longer — caller rolls back) if the
+// buffer holds only a prefix.
+static bool parse_one(RespParser* p, size_t& pos, std::string& out) {
+  const std::string& b = p->buf;
+  if (pos >= b.size()) return false;
+  char t = b[pos];
+  size_t eol = b.find("\r\n", pos + 1);
+  if (eol == std::string::npos) return false;
+  std::string line = b.substr(pos + 1, eol - pos - 1);
+  size_t after = eol + 2;
+  switch (t) {
+    case '+': case '-': {
+      emit_header(out, (uint8_t)t, (int64_t)line.size());
+      out.append(line);
+      pos = after;
+      return true;
+    }
+    case ':': {
+      emit_header(out, ':', std::strtoll(line.c_str(), nullptr, 10));
+      pos = after;
+      return true;
+    }
+    case '$': {
+      int64_t len = std::strtoll(line.c_str(), nullptr, 10);
+      if (len < 0) {  // null bulk
+        emit_header(out, '$', -1);
+        pos = after;
+        return true;
+      }
+      if (b.size() < after + (size_t)len + 2) return false;
+      emit_header(out, '$', len);
+      out.append(b, after, (size_t)len);
+      pos = after + (size_t)len + 2;
+      return true;
+    }
+    case '*': {
+      int64_t count = std::strtoll(line.c_str(), nullptr, 10);
+      emit_header(out, '*', count);
+      pos = after;
+      for (int64_t i = 0; i < count; i++) {
+        if (!parse_one(p, pos, out)) return false;
+      }
+      return true;
+    }
+    default:
+      // Protocol violation: surface as an error reply so the client can
+      // tear down the connection instead of spinning.
+      emit_header(out, '-', 14);
+      out.append("ERR bad header");
+      pos = b.size();
+      return true;
+  }
+}
+
+// Feed wire bytes; returns the number of COMPLETE top-level replies now
+// buffered (cumulative, decremented by take).
+RTPU_EXPORT int64_t rtpu_resp_parser_feed(RespParser* p, const uint8_t* data,
+                                          int64_t len) {
+  p->buf.append((const char*)data, (size_t)len);
+  for (;;) {
+    size_t pos = p->pos;
+    std::string piece;
+    if (!parse_one(p, pos, piece)) break;
+    p->out.append(piece);
+    p->pos = pos;
+    p->nready++;
+  }
+  // Compact consumed prefix occasionally to bound memory.
+  if (p->pos > (1u << 16) && p->pos * 2 > p->buf.size()) {
+    p->buf.erase(0, p->pos);
+    p->pos = 0;
+  }
+  return p->nready;
+}
+
+// Size of the pending flattened-reply stream (bytes).
+RTPU_EXPORT int64_t rtpu_resp_parser_pending(RespParser* p) {
+  return (int64_t)p->out.size();
+}
+
+// Copy out the flattened stream of all completed replies and reset it.
+// Returns bytes written; caller sizes the buffer via _pending first.
+RTPU_EXPORT int64_t rtpu_resp_parser_take(RespParser* p, uint8_t* dst,
+                                          int64_t cap) {
+  int64_t n = (int64_t)p->out.size();
+  if (n > cap) return -1;
+  std::memcpy(dst, p->out.data(), (size_t)n);
+  p->out.clear();
+  p->nready = 0;
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Host-side HLL fold: hash keys and fold bucket-max ranks into 16384
+// uint8 registers in one pass. Used by the durability path and as a CPU
+// fallback engine; the TPU path does the same fold on-device.
+// p=14 geometry matches ops/hll.py (Redis default, antirez HLL).
+// ---------------------------------------------------------------------------
+
+RTPU_EXPORT void rtpu_hll_fold_batch(const uint8_t* data,
+                                     const int64_t* offsets, int64_t n,
+                                     uint64_t seed, uint8_t* regs /*16384*/) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    murmur3_x64_128_one(data + offsets[i], offsets[i + 1] - offsets[i], seed,
+                        &h1, &h2);
+    uint32_t bucket = (uint32_t)(h1 & 16383);
+    uint64_t rest = h1 >> 14;
+    // rank = leading-zero count of the remaining 50 bits + 1, capped.
+    int rank = 1;
+    while (rank <= 50 && !(rest & 1)) { rest >>= 1; rank++; }
+    if ((uint8_t)rank > regs[bucket]) regs[bucket] = (uint8_t)rank;
+  }
+}
+
+RTPU_EXPORT const char* rtpu_version() { return "redisson-tpu-native 1.0"; }

@@ -1,8 +1,6 @@
-"""Pure-python golden implementations used to validate the JAX kernels.
-
-Written independently from the canonical algorithm specs (smhasher for
-MurmurHash3 x64 128, the xxHash spec for xxh64) — slow, scalar, obvious.
-"""
+"""Pure-python scalar hash implementations — fallback when the native
+library cannot be built. Algorithm specs: smhasher MurmurHash3_x64_128,
+xxhash.com XXH64 (same contracts as native/redisson_native.cpp)."""
 
 MASK64 = (1 << 64) - 1
 
@@ -24,12 +22,11 @@ def murmur3_x64_128(data: bytes, seed: int = 0):
     c1 = 0x87C37B91114253D5
     c2 = 0x4CF5AD432745937F
     length = len(data)
-    nblocks = length // 16
     h1 = h2 = seed & MASK64
-
+    nblocks = length // 16
     for i in range(nblocks):
-        k1 = int.from_bytes(data[16 * i : 16 * i + 8], "little")
-        k2 = int.from_bytes(data[16 * i + 8 : 16 * i + 16], "little")
+        k1 = int.from_bytes(data[i * 16:i * 16 + 8], "little")
+        k2 = int.from_bytes(data[i * 16 + 8:i * 16 + 16], "little")
         k1 = (k1 * c1) & MASK64
         k1 = _rotl64(k1, 31)
         k1 = (k1 * c2) & MASK64
@@ -44,25 +41,22 @@ def murmur3_x64_128(data: bytes, seed: int = 0):
         h2 = _rotl64(h2, 31)
         h2 = (h2 + h1) & MASK64
         h2 = (h2 * 5 + 0x38495AB5) & MASK64
-
-    tail = data[nblocks * 16 :]
+    tail = data[nblocks * 16:]
     k1 = k2 = 0
-    for i in range(len(tail)):
-        if i < 8:
-            k1 |= tail[i] << (8 * i)
-        else:
-            k2 |= tail[i] << (8 * (i - 8))
+    for j in range(len(tail) - 1, 7, -1):
+        k2 |= tail[j] << (8 * (j - 8))
     if len(tail) > 8:
         k2 = (k2 * c2) & MASK64
         k2 = _rotl64(k2, 33)
         k2 = (k2 * c1) & MASK64
         h2 ^= k2
+    for j in range(min(len(tail), 8) - 1, -1, -1):
+        k1 |= tail[j] << (8 * j)
     if len(tail) > 0:
         k1 = (k1 * c1) & MASK64
         k1 = _rotl64(k1, 31)
         k1 = (k1 * c2) & MASK64
         h1 ^= k1
-
     h1 ^= length
     h2 ^= length
     h1 = (h1 + h2) & MASK64
@@ -87,48 +81,38 @@ def _xx_round(acc, lane):
     return (acc * _P1) & MASK64
 
 
-def xxhash64(data: bytes, seed: int = 0):
+def xxhash64(data: bytes, seed: int = 0) -> int:
     length = len(data)
-    p = 0
+    pos = 0
     if length >= 32:
         v1 = (seed + _P1 + _P2) & MASK64
         v2 = (seed + _P2) & MASK64
         v3 = seed & MASK64
         v4 = (seed - _P1) & MASK64
-        while p + 32 <= length:
-            for i, v in enumerate((v1, v2, v3, v4)):
-                lane = int.from_bytes(data[p + 8 * i : p + 8 * i + 8], "little")
-                nv = _xx_round(v, lane)
-                if i == 0:
-                    v1 = nv
-                elif i == 1:
-                    v2 = nv
-                elif i == 2:
-                    v3 = nv
-                else:
-                    v4 = nv
-            p += 32
+        while pos + 32 <= length:
+            v1 = _xx_round(v1, int.from_bytes(data[pos:pos + 8], "little"))
+            v2 = _xx_round(v2, int.from_bytes(data[pos + 8:pos + 16], "little"))
+            v3 = _xx_round(v3, int.from_bytes(data[pos + 16:pos + 24], "little"))
+            v4 = _xx_round(v4, int.from_bytes(data[pos + 24:pos + 32], "little"))
+            pos += 32
         h = (_rotl64(v1, 1) + _rotl64(v2, 7) + _rotl64(v3, 12) + _rotl64(v4, 18)) & MASK64
         for v in (v1, v2, v3, v4):
-            h ^= _xx_round(0, v)
-            h = (h * _P1 + _P4) & MASK64
+            h = ((h ^ _xx_round(0, v)) * _P1 + _P4) & MASK64
     else:
         h = (seed + _P5) & MASK64
     h = (h + length) & MASK64
-    while p + 8 <= length:
-        lane = int.from_bytes(data[p : p + 8], "little")
-        h ^= _xx_round(0, lane)
+    while pos + 8 <= length:
+        h ^= _xx_round(0, int.from_bytes(data[pos:pos + 8], "little"))
         h = (_rotl64(h, 27) * _P1 + _P4) & MASK64
-        p += 8
-    if p + 4 <= length:
-        lane = int.from_bytes(data[p : p + 4], "little")
-        h ^= (lane * _P1) & MASK64
+        pos += 8
+    if pos + 4 <= length:
+        h ^= (int.from_bytes(data[pos:pos + 4], "little") * _P1) & MASK64
         h = (_rotl64(h, 23) * _P2 + _P3) & MASK64
-        p += 4
-    while p < length:
-        h ^= (data[p] * _P5) & MASK64
+        pos += 4
+    while pos < length:
+        h ^= (data[pos] * _P5) & MASK64
         h = (_rotl64(h, 11) * _P1) & MASK64
-        p += 1
+        pos += 1
     h ^= h >> 33
     h = (h * _P2) & MASK64
     h ^= h >> 29

@@ -83,7 +83,7 @@ def _mm_body(h1: U64, h2: U64, k1: U64, k2: U64):
     h1 = u.xor(h1, _mm_mix_k1(k1))
     h1 = u.rotl(h1, 27)
     h1 = u.add(h1, h2)
-    h1 = u.add(u.mul(h1, u.const(5)), u.const(0x52DCFB2F))
+    h1 = u.add(u.mul(h1, u.const(5)), u.const(0x52DCE729))
     h2 = u.xor(h2, _mm_mix_k2(k2))
     h2 = u.rotl(h2, 31)
     h2 = u.add(h2, h1)

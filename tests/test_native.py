@@ -1,0 +1,183 @@
+"""Native C++ runtime tests: hashes vs golden, RESP codec round-trips,
+keyslot vs reference CRC16 semantics, HLL fold vs the JAX kernel."""
+
+import os
+
+import numpy as np
+import pytest
+
+from redisson_tpu import native
+from tests import golden
+
+
+KEYS = [
+    b"",
+    b"a",
+    b"hello",
+    b"0123456789abcde",      # 15 (full tail)
+    b"0123456789abcdef",     # 16 (exact block)
+    b"0123456789abcdef0",    # 17
+    b"The quick brown fox jumps over the lazy dog",
+    bytes(range(256)),
+    b"x" * 1000,
+]
+
+
+def test_native_compiles():
+    # This image has g++; the native path must be live here (the python
+    # fallback exists for toolchain-less hosts, not for CI).
+    assert native.available(), "native library failed to build"
+    assert "native" in native.version()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 0xDEADBEEF])
+def test_murmur3_matches_golden(seed):
+    h1, h2 = native.murmur3_x64_128(KEYS, seed)
+    for i, k in enumerate(KEYS):
+        g1, g2 = golden.murmur3_x64_128(k, seed)
+        assert int(h1[i]) == g1, f"h1 mismatch key={k!r}"
+        assert int(h2[i]) == g2, f"h2 mismatch key={k!r}"
+
+
+@pytest.mark.parametrize("seed", [0, 7, 2**64 - 1])
+def test_xxhash64_matches_golden(seed):
+    out = native.xxhash64(KEYS, seed)
+    for i, k in enumerate(KEYS):
+        assert int(out[i]) == golden.xxhash64(k, seed), f"key={k!r}"
+
+
+def test_pyfallback_matches_golden():
+    from redisson_tpu.native import _pyfallback
+    for k in KEYS:
+        assert _pyfallback.murmur3_x64_128(k, 3) == golden.murmur3_x64_128(k, 3)
+        assert _pyfallback.xxhash64(k, 3) == golden.xxhash64(k, 3)
+
+
+def test_crc16_known_vectors():
+    # "123456789" -> 0x31C3 is the published check value for the Redis
+    # (XMODEM) CRC16 variant, cited in the cluster spec.
+    assert native.crc16(b"123456789") == 0x31C3
+    assert native.crc16(b"") == 0
+
+
+def test_keyslot_hashtag_rules():
+    # {hashtag} extraction per cluster spec (ClusterConnectionManager.java:543-558).
+    assert native.keyslot("foo") == native.crc16(b"foo") % 16384
+    assert native.keyslot("{user1000}.following") == native.keyslot("{user1000}.followers")
+    assert native.keyslot("foo{}{bar}") == native.crc16(b"foo{}{bar}") % 16384  # empty tag -> whole key
+    assert native.keyslot("foo{{bar}}zap") == native.crc16(b"{bar") % 16384
+    assert native.keyslot("foo{bar}{zap}") == native.crc16(b"bar") % 16384
+
+
+def test_keyslot_batch_agrees_with_store():
+    from redisson_tpu.ops import crc16
+    keys = [f"key:{i}".encode() for i in range(200)] + [b"{tag}a", b"{tag}b"]
+    slots = native.keyslot_batch(keys)
+    for k, s in zip(keys, slots):
+        assert int(s) == crc16.key_slot(k.decode())
+
+
+def test_resp_encode_single():
+    assert native.resp_encode("PING") == b"*1\r\n$4\r\nPING\r\n"
+    assert (native.resp_encode("SET", "k", b"\x00\xff") ==
+            b"*3\r\n$3\r\nSET\r\n$1\r\nk\r\n$2\r\n\x00\xff\r\n")
+    assert native.resp_encode("EXPIRE", "k", 30) == b"*3\r\n$6\r\nEXPIRE\r\n$1\r\nk\r\n$2\r\n30\r\n"
+
+
+def test_resp_encode_pipeline_is_concatenation():
+    one = native.resp_encode("GET", "a")
+    two = native.resp_encode("GET", "b")
+    assert native.resp_encode_pipeline([("GET", "a"), ("GET", "b")]) == one + two
+
+
+def _roundtrip(wire, chunk=None):
+    p = native.RespParser()
+    try:
+        if chunk is None:
+            return p.feed(wire)
+        out = []
+        for i in range(0, len(wire), chunk):
+            out.extend(p.feed(wire[i:i + chunk]))
+        return out
+    finally:
+        p.close()
+
+
+@pytest.mark.parametrize("chunk", [None, 1, 3, 7])
+def test_resp_parser_all_types(chunk):
+    wire = (b"+OK\r\n"
+            b"-ERR nope\r\n"
+            b":42\r\n"
+            b"$5\r\nhello\r\n"
+            b"$-1\r\n"
+            b"*3\r\n:1\r\n$2\r\nab\r\n*2\r\n+x\r\n:-7\r\n"
+            b"*-1\r\n"
+            b"*0\r\n")
+    got = _roundtrip(wire, chunk)
+    assert got[0] == b"OK"
+    assert isinstance(got[1], native.RespError) and "nope" in str(got[1])
+    assert got[2] == 42
+    assert got[3] == b"hello"
+    assert got[4] is None
+    assert got[5] == [1, b"ab", [b"x", -7]]
+    assert got[6] is None
+    assert got[7] == []
+    assert len(got) == 8
+
+
+def test_resp_parser_binary_safe_bulk():
+    payload = bytes(range(256)) * 4
+    wire = b"$%d\r\n" % len(payload) + payload + b"\r\n"
+    assert _roundtrip(wire, 13) == [payload]
+
+
+def test_resp_parser_partial_then_complete():
+    p = native.RespParser()
+    assert p.feed(b"*2\r\n$3\r\nfo") == []
+    assert p.feed(b"o\r\n:9\r") == []
+    assert p.feed(b"\n") == [[b"foo", 9]]
+    p.close()
+
+
+def test_resp_roundtrip_encode_parse():
+    cmds = [("SET", f"k{i}", f"v{i}") for i in range(50)]
+    wire = native.resp_encode_pipeline(cmds)
+    # Parse our own encoding back (commands are themselves RESP arrays).
+    got = _roundtrip(wire, 11)
+    assert got == [[b"SET", b"k%d" % i, b"v%d" % i] for i in range(50)]
+
+
+def test_hll_fold_matches_jax_kernel():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from redisson_tpu.ops import hashing, hll
+    from redisson_tpu.ops.u64 import U64
+
+    keys = [f"user:{i}".encode() for i in range(5000)]
+    regs = np.zeros(16384, np.uint8)
+    native.hll_fold(keys, regs)
+
+    # Same fold on the JAX path: hash 8-byte-LE? No — the JAX ingest hashes
+    # raw byte keys; use the native murmur3 as the hash and the kernel's
+    # bucket/rank + scatter for the fold.
+    h1, _ = native.murmur3_x64_128(keys)
+    hi = (h1 >> np.uint64(32)).astype(np.uint32)
+    lo = (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    bucket, rank = hll.bucket_rank(U64(jnp.asarray(hi), jnp.asarray(lo)))
+    jregs = hll.insert_scatter(hll.make(), bucket, rank)
+    np.testing.assert_array_equal(regs.astype(np.int32), np.asarray(jregs))
+
+
+def test_hll_fold_estimate_sane():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from redisson_tpu.ops import hll
+
+    n = 200_000
+    keys = [b"k%d" % i for i in range(n)]
+    regs = np.zeros(16384, np.uint8)
+    native.hll_fold(keys, regs)
+    est = float(hll.count(jnp.asarray(regs.astype(np.int32))))
+    assert abs(est - n) / n < 0.02
