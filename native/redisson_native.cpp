@@ -297,11 +297,17 @@ static void emit_header(std::string& out, uint8_t type, int64_t payload) {
   out.append((const char*)&payload, 8);
 }
 
+// RESP arrays nest one C-stack frame per level; real replies nest a
+// handful deep. Cap to keep hostile/corrupt streams from overflowing the
+// stack (the error path below tears the stream down).
+static const int kMaxRespDepth = 64;
+
 // Try to parse one reply at `pos`; append flattened form to `out`.
 // Returns true and advances pos past the reply on success; false (pos
 // untouched, out possibly partially longer — caller rolls back) if the
 // buffer holds only a prefix.
-static bool parse_one(RespParser* p, size_t& pos, std::string& out) {
+static bool parse_one(RespParser* p, size_t& pos, std::string& out,
+                      int depth = 0) {
   const std::string& b = p->buf;
   if (pos >= b.size()) return false;
   char t = b[pos];
@@ -335,11 +341,17 @@ static bool parse_one(RespParser* p, size_t& pos, std::string& out) {
       return true;
     }
     case '*': {
+      if (depth >= kMaxRespDepth) {
+        emit_header(out, '-', 20);
+        out.append("ERR nesting too deep", 20);
+        pos = b.size();
+        return true;
+      }
       int64_t count = std::strtoll(line.c_str(), nullptr, 10);
       emit_header(out, '*', count);
       pos = after;
       for (int64_t i = 0; i < count; i++) {
-        if (!parse_one(p, pos, out)) return false;
+        if (!parse_one(p, pos, out, depth + 1)) return false;
       }
       return true;
     }

@@ -1,0 +1,100 @@
+"""Local checkpoint / resume of the sketch store.
+
+The reference has no client-side checkpointing (SURVEY.md §5 — durability
+is the Redis server's job); for a framework that OWNS its state in HBM,
+snapshots are first-class. Format: one directory per checkpoint,
+
+    manifest.json   {"version": 1, "objects": {name: {otype, meta, version,
+                     dtype, shape}}, "written_at": ...}
+    state.npz       name -> array (numpy, host copy)
+
+Writes are atomic (tmp dir + rename). `save` reads consistent per-object
+snapshots (jax arrays are immutable — a handle IS a consistent snapshot);
+`load` device_puts back and bumps versions. Works for any backend exposing
+a SketchStore; the structure tier persists separately via its engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from redisson_tpu.store import SketchStore
+
+MANIFEST = "manifest.json"
+STATE = "state.npz"
+FORMAT_VERSION = 1
+_KEY_PREFIX = "obj:"
+
+
+def save(store: SketchStore, path: str,
+         names: Optional[List[str]] = None) -> int:
+    """Snapshot the named objects (default all) into `path`. Returns count."""
+    if names is None:
+        names = store.keys()
+    objs = {}
+    arrays: Dict[str, np.ndarray] = {}
+    for name in names:
+        obj = store.get(name)
+        if obj is None:
+            continue
+        host = np.asarray(obj.state)
+        arrays[name] = host
+        objs[name] = {
+            "otype": obj.otype,
+            "meta": obj.meta,
+            "version": obj.version,
+            "dtype": str(host.dtype),
+            "shape": list(host.shape),
+        }
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    with open(os.path.join(tmp, MANIFEST), "w") as f:
+        json.dump({"version": FORMAT_VERSION, "written_at": time.time(),
+                   "objects": objs}, f, indent=1)
+    # Prefix array keys: a sketch literally named "file" would collide with
+    # savez's first positional parameter if passed as a bare kwarg.
+    np.savez_compressed(os.path.join(tmp, STATE),
+                        **{_KEY_PREFIX + k: v for k, v in arrays.items()})
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    os.replace(tmp, path)
+    return len(objs)
+
+
+def load(store: SketchStore, path: str,
+         names: Optional[List[str]] = None) -> int:
+    """Restore objects from a checkpoint into the store (overwriting
+    same-named objects). Returns the number restored."""
+    import jax
+
+    with open(os.path.join(path, MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("version") != FORMAT_VERSION:
+        raise ValueError(f"unsupported checkpoint version {manifest.get('version')}")
+    with np.load(os.path.join(path, STATE)) as z:
+        count = 0
+        for name, info in manifest["objects"].items():
+            if names is not None and name not in names:
+                continue
+            host = z[_KEY_PREFIX + name]
+            arr = jax.device_put(host, store.device)
+            obj = store.get_or_create(name, info["otype"], lambda: arr,
+                                      info.get("meta") or {})
+            store.swap(name, arr)
+            obj.meta.update(info.get("meta") or {})
+            count += 1
+    return count
+
+
+def info(path: str) -> Dict:
+    """Read a checkpoint's manifest without loading state."""
+    with open(os.path.join(path, MANIFEST)) as f:
+        return json.load(f)

@@ -87,6 +87,66 @@ class RedissonTPU:
         self._watchdog = LockWatchdog(self._executor)
         self._eviction = EvictionScheduler(self._executor)
 
+        # Durability tier: redis config alongside tpu/pod wires the flush
+        # path (SURVEY.md §7 step 6); flush_interval_s > 0 starts the
+        # periodic flusher.
+        self._durability = None
+        self._resp = None
+        if self.config.redis is not None and mode != "redis":
+            try:
+                self._connect_durability()
+            except Exception:
+                # Startup must not leak the already-running background
+                # threads when the first dial fails.
+                self.shutdown()
+                raise
+
+    def _connect_durability(self):
+        from urllib.parse import urlparse
+
+        from redisson_tpu.interop.durability import DurabilityManager
+        from redisson_tpu.interop.resp_client import SyncRespClient
+
+        rcfg = self.config.redis
+        u = urlparse(rcfg.address)
+        self._resp = SyncRespClient(
+            host=u.hostname or "127.0.0.1",
+            port=u.port or 6379,
+            password=rcfg.password,
+            db=rcfg.database,
+            timeout=rcfg.timeout_ms / 1000.0,
+            retry_attempts=rcfg.retry_attempts,
+            retry_interval=rcfg.retry_interval_ms / 1000.0,
+        )
+        self._resp.connect()
+        self._durability = DurabilityManager(self._store, self._resp)
+        if self.config.flush_interval_s > 0:
+            self._durability.start_periodic(self.config.flush_interval_s)
+
+    # -- durability / checkpoint --------------------------------------------
+
+    @property
+    def durability(self):
+        """The DurabilityManager when a redis tier is configured, else None."""
+        return self._durability
+
+    def flush_to_redis(self, names=None) -> int:
+        if self._durability is None:
+            raise RuntimeError("no redis durability tier configured")
+        return self._durability.flush(names)
+
+    def save_checkpoint(self, path: str, names=None) -> int:
+        """Snapshot sketch state to a local checkpoint directory."""
+        from redisson_tpu import checkpoint
+
+        return checkpoint.save(self._store, path, names)
+
+    def load_checkpoint(self, path: str, names=None) -> int:
+        """Restore sketch state from a local checkpoint directory."""
+        from redisson_tpu import checkpoint
+
+        return checkpoint.load(self._store, path, names)
+
     @classmethod
     def create(cls, config: Optional[Config] = None) -> "RedissonTPU":
         return cls(config)
@@ -218,6 +278,20 @@ class RedissonTPU:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self):
+        if self._durability is not None:
+            self._durability.stop_periodic()
+            try:
+                self._durability.flush()  # final flush on clean shutdown
+            except Exception:
+                pass
+            self._durability = None
+        if self._resp is not None:
+            try:
+                self._resp.close()
+            except Exception:
+                # A wedged IO loop must not abort the rest of shutdown.
+                pass
+            self._resp = None
         self._eviction.shutdown()
         self._watchdog.shutdown()
         self._executor.shutdown()

@@ -78,9 +78,12 @@ class Config:
     def mode(self) -> str:
         """The single active backend mode (validated)."""
         active = [m for m in self._MODES if getattr(self, m) is not None]
-        if len(active) > 1 and not (active == ["tpu", "redis"] or active == ["pod", "redis"]):
-            # redis may coexist as the durability tier behind tpu/pod.
+        # redis may coexist with any compute mode as the durability tier.
+        compute = [m for m in active if m != "redis"]
+        if len(compute) > 1:
             raise ValueError(f"multiple backend modes configured: {active}")
+        if compute:
+            return compute[0]
         if not active:
             return "local"
         return active[0]

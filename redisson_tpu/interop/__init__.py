@@ -1,0 +1,10 @@
+"""Redis interop tier: wire client, blob codecs, durability flush/import.
+
+The reference delegates durability entirely to the Redis server (SURVEY.md
+§5 "Checkpoint/resume: none client-side"). In the TPU framework the roles
+invert: sketches live in HBM and this package is the boundary that flushes
+them to / imports them from a real Redis — plus local snapshot files when
+no server is around (see redisson_tpu.checkpoint).
+"""
+
+from redisson_tpu.interop import hyll  # noqa: F401

@@ -1,0 +1,195 @@
+"""Durability: flush HBM-resident sketches to Redis and import them back.
+
+The reference's durability story is "Redis persists, client is stateless";
+ours inverts it (SURVEY.md §5 checkpoint/resume): the TPU owns the live
+state and this manager periodically writes it out. Wire formats are chosen
+so a real Redis can read the flushed values natively:
+
+  hll     -> SET name <dense HYLL blob>          (hyll.encode_dense; a real
+             server's PFCOUNT/PFMERGE work on it directly)
+  bitset  -> SET name <packed bytes, Redis SETBIT bit order>
+  bloom   -> SET name <packed bit array> + HSET name__config size/
+             hashIterations/expectedInsertions/falseProbability — the same
+             sidecar-hash convention as RedissonBloomFilter.java:254-256.
+
+Import reverses each mapping. The periodic flusher runs on a daemon thread
+with an adaptive interval floor, mirroring EvictionScheduler's pacing idea
+(EvictionScheduler.java:47-115) without the Lua.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from redisson_tpu.interop import hyll
+from redisson_tpu.interop.resp_client import SyncRespClient
+from redisson_tpu.store import ObjectType, SketchStore
+
+BLOOM_CONFIG_SUFFIX = "__config"
+
+
+class DurabilityManager:
+    def __init__(self, store: SketchStore, client: SyncRespClient,
+                 prefix: str = ""):
+        self.store = store
+        self.client = client
+        self.prefix = prefix
+        self._timer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.flushes = 0
+        self.last_flush_s: float = 0.0
+        # name -> store version at last flush: periodic runs skip objects
+        # whose version hasn't moved (the store bumps it on every mutation).
+        self._flushed_versions: Dict[str, int] = {}
+
+    # -- flush --------------------------------------------------------------
+
+    def _export_one(self, name: str) -> List[List]:
+        """Commands that persist object `name` (empty if unknown type)."""
+        obj = self.store.get(name)
+        if obj is None:
+            return []
+        key = self.prefix + name
+        if obj.otype == ObjectType.HLL:
+            regs = np.asarray(obj.state).astype(np.uint8)
+            return [["SET", key, hyll.encode_dense(regs)]]
+        if obj.otype == ObjectType.BITSET:
+            packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
+            return [["SET", key, packed.tobytes()]]
+        if obj.otype == ObjectType.BLOOM:
+            packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
+            meta = obj.meta or {}
+            cfg: List = ["HSET", key + BLOOM_CONFIG_SUFFIX]
+            # snake_case store meta -> the reference's camelCase hash fields
+            # ({name}__config, RedissonBloomFilter.java:254-256)
+            for field, wire in (("size", "size"),
+                                ("hash_iterations", "hashIterations"),
+                                ("expected_insertions", "expectedInsertions"),
+                                ("false_probability", "falseProbability")):
+                if field in meta:
+                    cfg += [wire, str(meta[field])]
+            cmds = [["SET", key, packed.tobytes()]]
+            if len(cfg) > 2:
+                cmds.append(cfg)
+            return cmds
+        return []
+
+    def flush(self, names: Optional[List[str]] = None,
+              only_dirty: bool = False) -> int:
+        """Write the named objects (default: all) in one pipeline.
+        Returns the number of objects persisted. With only_dirty, objects
+        whose store version hasn't changed since the last flush are skipped
+        (the periodic flusher uses this)."""
+        if names is None:
+            names = self.store.keys()
+        cmds: List[List] = []
+        counted = 0
+        written: List[tuple] = []  # (name, version) to record AFTER the write
+        for n in names:
+            obj = self.store.get(n)
+            if obj is None:
+                continue
+            if only_dirty and self._flushed_versions.get(n) == obj.version:
+                continue
+            version = obj.version  # read before export: racing mutations re-flush
+            c = self._export_one(n)
+            if c:
+                counted += 1
+                cmds.extend(c)
+                written.append((n, version))
+        if cmds:
+            t0 = time.monotonic()
+            self.client.pipeline(cmds)
+            self.last_flush_s = time.monotonic() - t0
+        # Only mark clean once the pipeline write succeeded — a failed write
+        # must leave objects dirty so the periodic flusher retries them.
+        for n, version in written:
+            self._flushed_versions[n] = version
+        self.flushes += 1
+        return counted
+
+    # -- import -------------------------------------------------------------
+
+    def load_hll(self, name: str) -> bool:
+        blob = self.client.execute("GET", self.prefix + name)
+        if blob is None:
+            return False
+        regs = hyll.decode(bytes(blob)).astype(np.int32)
+        self._put(name, ObjectType.HLL, regs)
+        return True
+
+    def load_bitset(self, name: str, nbits: Optional[int] = None) -> bool:
+        raw = self.client.execute("GET", self.prefix + name)
+        if raw is None:
+            return False
+        bits = np.unpackbits(np.frombuffer(bytes(raw), np.uint8))
+        if nbits is not None:
+            out = np.zeros(nbits, np.uint8)
+            out[:min(nbits, bits.size)] = bits[:nbits]
+            bits = out
+        self._put(name, ObjectType.BITSET, bits.astype(np.uint8))
+        return True
+
+    def load_bloom(self, name: str) -> bool:
+        key = self.prefix + name
+        raw = self.client.execute("GET", key)
+        if raw is None:
+            return False
+        cfg_pairs = self.client.execute("HGETALL", key + BLOOM_CONFIG_SUFFIX)
+        wire_to_meta = {"size": "size", "hashIterations": "hash_iterations",
+                        "expectedInsertions": "expected_insertions",
+                        "falseProbability": "false_probability"}
+        meta: Dict[str, object] = {}
+        for i in range(0, len(cfg_pairs or []), 2):
+            f = bytes(cfg_pairs[i]).decode()
+            v = bytes(cfg_pairs[i + 1]).decode()
+            if f in wire_to_meta:
+                meta[wire_to_meta[f]] = (
+                    float(v) if f == "falseProbability" else int(v))
+        bits = np.unpackbits(np.frombuffer(bytes(raw), np.uint8))
+        size = int(meta.get("size", bits.size))
+        out = np.zeros(size, np.uint8)
+        out[:min(size, bits.size)] = bits[:size]
+        self._put(name, ObjectType.BLOOM, out, meta)
+        return True
+
+    def _put(self, name: str, otype: str, state: np.ndarray,
+             meta: Optional[Dict] = None) -> None:
+        import jax
+
+        arr = jax.device_put(state, self.store.device)
+        obj = self.store.get_or_create(name, otype, lambda: arr, meta or {})
+        # get_or_create returns the existing object on name collision; force
+        # the imported state either way (imports overwrite).
+        self.store.swap(name, arr)
+        if meta:
+            obj.meta.update(meta)
+
+    # -- periodic -----------------------------------------------------------
+
+    def start_periodic(self, interval: float = 30.0) -> None:
+        if self._timer is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.flush(only_dirty=True)
+                except Exception:  # keep the flusher alive across hiccups
+                    pass
+
+        self._timer = threading.Thread(target=loop, name="rtpu-durability",
+                                       daemon=True)
+        self._timer.start()
+
+    def stop_periodic(self) -> None:
+        if self._timer is None:
+            return
+        self._stop.set()
+        self._timer.join(timeout=5)
+        self._timer = None

@@ -1,0 +1,352 @@
+"""Embedded in-process Redis look-alike (asyncio RESP2 server).
+
+The reference's test oracle is a real redis-server spawned per test class
+(RedisRunner.java, SURVEY.md §4); this image has no redis binary, and the
+survey explicitly calls for an in-process fake as the improvement. This
+server speaks enough RESP2 for the durability/interop tier and its tests:
+
+  strings:  SET GET DEL EXISTS STRLEN APPEND FLUSHALL KEYS TYPE
+  bits:     SETBIT GETBIT BITCOUNT BITOP
+  hashes:   HSET HGET HGETALL HDEL
+  hll:      PFADD PFCOUNT PFMERGE (registers via redisson_tpu.interop.hyll,
+            hashing via the native murmur3 — self-consistent with the TPU
+            sketches, see hyll.py docstring)
+  admin:    PING AUTH SELECT ECHO DBSIZE
+  fault injection: DROPCONN (closes the socket mid-stream, for watchdog
+            tests — the in-process analogue of RedisRunner's process kill)
+
+State is a plain dict per server; binary-safe; single-threaded asyncio.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from redisson_tpu import native
+from redisson_tpu.interop import hyll
+
+
+def _ok() -> bytes:
+    return b"+OK\r\n"
+
+
+def _err(msg: str) -> bytes:
+    return f"-ERR {msg}\r\n".encode()
+
+
+def _int(v: int) -> bytes:
+    return b":%d\r\n" % v
+
+
+def _bulk(v: Optional[bytes]) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    return b"$%d\r\n" % len(v) + v + b"\r\n"
+
+
+def _array(items: List[bytes]) -> bytes:
+    return b"*%d\r\n" % len(items) + b"".join(items)
+
+
+class FakeRedisServer:
+    """asyncio RESP server over an in-memory dict. start()/stop(); the
+    listening port is self.port (0 -> ephemeral)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 password: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.password = password
+        self.data: Dict[bytes, object] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections = 0
+        self._writers: set = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Force-close live client connections: wait_closed() blocks until
+            # every handler returns, and handlers only return on client EOF.
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        self.connections += 1
+        self._writers.add(writer)
+        parser = native.RespParser()
+        authed = self.password is None
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                for cmd in parser.feed(data):
+                    if not isinstance(cmd, list) or not cmd:
+                        writer.write(_err("protocol"))
+                        continue
+                    name = bytes(cmd[0]).upper().decode()
+                    args = cmd[1:]
+                    if name == "AUTH":
+                        authed = args and args[0].decode() == self.password
+                        writer.write(_ok() if authed else _err("invalid password"))
+                        continue
+                    if not authed:
+                        writer.write(_err("NOAUTH Authentication required"))
+                        continue
+                    if name == "DROPCONN":
+                        writer.close()
+                        return
+                    try:
+                        writer.write(self._dispatch(name, args))
+                    except Exception as e:  # noqa: BLE001
+                        writer.write(_err(str(e)))
+                await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            parser.close()
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    # -- command handlers ---------------------------------------------------
+
+    def _dispatch(self, name: str, a: List[bytes]) -> bytes:
+        h = getattr(self, "_cmd_" + name.lower(), None)
+        if h is None:
+            return _err(f"unknown command '{name}'")
+        return h(a)
+
+    def _cmd_ping(self, a):
+        return _bulk(a[0]) if a else b"+PONG\r\n"
+
+    def _cmd_echo(self, a):
+        return _bulk(a[0])
+
+    def _cmd_select(self, a):
+        return _ok()
+
+    def _cmd_dbsize(self, a):
+        return _int(len(self.data))
+
+    def _cmd_flushall(self, a):
+        self.data.clear()
+        return _ok()
+
+    def _cmd_set(self, a):
+        self.data[bytes(a[0])] = bytes(a[1])
+        return _ok()
+
+    def _cmd_get(self, a):
+        v = self.data.get(bytes(a[0]))
+        if v is not None and not isinstance(v, bytes):
+            raise ValueError("WRONGTYPE")
+        return _bulk(v)
+
+    def _cmd_append(self, a):
+        k = bytes(a[0])
+        v = self.data.get(k, b"") + bytes(a[1])
+        self.data[k] = v
+        return _int(len(v))
+
+    def _cmd_strlen(self, a):
+        v = self.data.get(bytes(a[0]), b"")
+        return _int(len(v) if isinstance(v, bytes) else 0)
+
+    def _cmd_del(self, a):
+        n = 0
+        for k in a:
+            n += 1 if self.data.pop(bytes(k), None) is not None else 0
+        return _int(n)
+
+    def _cmd_exists(self, a):
+        return _int(sum(1 for k in a if bytes(k) in self.data))
+
+    def _cmd_keys(self, a):
+        import fnmatch
+        pat = bytes(a[0]).decode("utf-8", "replace")
+        ks = [k for k in self.data
+              if fnmatch.fnmatchcase(k.decode("utf-8", "replace"), pat)]
+        return _array([_bulk(k) for k in sorted(ks)])
+
+    def _cmd_type(self, a):
+        v = self.data.get(bytes(a[0]))
+        if v is None:
+            return b"+none\r\n"
+        return b"+hash\r\n" if isinstance(v, dict) else b"+string\r\n"
+
+    # bits
+
+    def _cmd_setbit(self, a):
+        k, off, val = bytes(a[0]), int(a[1]), int(a[2])
+        buf = bytearray(self.data.get(k, b""))
+        byte, bit = off >> 3, 7 - (off & 7)
+        if len(buf) <= byte:
+            buf.extend(b"\x00" * (byte + 1 - len(buf)))
+        old = (buf[byte] >> bit) & 1
+        if val:
+            buf[byte] |= 1 << bit
+        else:
+            buf[byte] &= ~(1 << bit)
+        self.data[k] = bytes(buf)
+        return _int(old)
+
+    def _cmd_getbit(self, a):
+        k, off = bytes(a[0]), int(a[1])
+        buf = self.data.get(k, b"")
+        byte, bit = off >> 3, 7 - (off & 7)
+        return _int((buf[byte] >> bit) & 1 if byte < len(buf) else 0)
+
+    def _cmd_bitcount(self, a):
+        buf = self.data.get(bytes(a[0]), b"")
+        return _int(int(np.unpackbits(np.frombuffer(buf, np.uint8)).sum()))
+
+    def _cmd_bitop(self, a):
+        op = bytes(a[0]).upper()
+        dest = bytes(a[1])
+        srcs = [self.data.get(bytes(k), b"") for k in a[2:]]
+        width = max((len(s) for s in srcs), default=0)
+        arrs = [np.frombuffer(s.ljust(width, b"\x00"), np.uint8).astype(np.uint8)
+                for s in srcs]
+        if op == b"NOT":
+            out = ~arrs[0]
+        else:
+            out = arrs[0].copy()
+            for x in arrs[1:]:
+                if op == b"AND":
+                    out &= x
+                elif op == b"OR":
+                    out |= x
+                elif op == b"XOR":
+                    out ^= x
+                else:
+                    raise ValueError(f"bad BITOP {op!r}")
+        self.data[dest] = out.tobytes()
+        return _int(width)
+
+    # hashes
+
+    def _hash(self, k: bytes) -> dict:
+        v = self.data.setdefault(k, {})
+        if not isinstance(v, dict):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_hset(self, a):
+        h = self._hash(bytes(a[0]))
+        added = 0
+        for i in range(1, len(a) - 1, 2):
+            added += 0 if bytes(a[i]) in h else 1
+            h[bytes(a[i])] = bytes(a[i + 1])
+        return _int(added)
+
+    def _cmd_hget(self, a):
+        v = self.data.get(bytes(a[0]))
+        if v is None:
+            return _bulk(None)
+        if not isinstance(v, dict):
+            raise ValueError("WRONGTYPE")
+        return _bulk(v.get(bytes(a[1])))
+
+    def _cmd_hgetall(self, a):
+        v = self.data.get(bytes(a[0]), {})
+        if not isinstance(v, dict):
+            raise ValueError("WRONGTYPE")
+        out = []
+        for k, val in v.items():
+            out.append(_bulk(k))
+            out.append(_bulk(val))
+        return _array(out)
+
+    def _cmd_hdel(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, dict):
+            return _int(0)
+        n = 0
+        for f in a[1:]:
+            n += 1 if v.pop(bytes(f), None) is not None else 0
+        return _int(n)
+
+    # HLL (registers via our codec; hash = native murmur3 low half — the
+    # same family the TPU sketches use, so PFCOUNT here agrees with the
+    # framework's estimates on identical key sets)
+
+    def _regs(self, k: bytes) -> np.ndarray:
+        v = self.data.get(k)
+        if v is None:
+            return np.zeros(hyll.M, np.uint8)
+        if not isinstance(v, bytes):
+            raise ValueError("WRONGTYPE")
+        return hyll.decode(v)
+
+    def _cmd_pfadd(self, a):
+        k = bytes(a[0])
+        existed = k in self.data
+        regs = self._regs(k)
+        before = regs.copy()
+        keys = [bytes(x) for x in a[1:]]
+        if keys:
+            native.hll_fold(keys, regs)
+        self.data[k] = hyll.encode_dense(regs)
+        return _int(1 if (regs != before).any() or not existed else 0)
+
+    def _cmd_pfcount(self, a):
+        regs = np.zeros(hyll.M, np.uint8)
+        for k in a:
+            regs = np.maximum(regs, self._regs(bytes(k)))
+        # Pure-numpy estimator: the server thread must never touch a device
+        # (a first-compile stall here would blow client response timeouts).
+        return _int(int(round(hyll.estimate(regs))))
+
+    def _cmd_pfmerge(self, a):
+        dest = bytes(a[0])
+        regs = self._regs(dest)
+        for k in a[1:]:
+            regs = np.maximum(regs, self._regs(bytes(k)))
+        self.data[dest] = hyll.encode_dense(regs)
+        return _ok()
+
+
+class EmbeddedRedis:
+    """Run a FakeRedisServer on a background event-loop thread — the
+    test fixture analogue of RedisRunner.startDefaultRedisServerInstance."""
+
+    def __init__(self, password: Optional[str] = None):
+        import threading
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        name="rtpu-fake-redis", daemon=True)
+        self._thread.start()
+        self.server = FakeRedisServer(password=password)
+        asyncio.run_coroutine_threadsafe(self.server.start(), self._loop).result(10)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def stop(self) -> None:
+        asyncio.run_coroutine_threadsafe(self.server.stop(), self._loop).result(10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+        self._loop.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,185 @@
+"""Redis HLL ("HYLL") blob codec: dense encode/decode, sparse decode.
+
+Wire format (redis hyperloglog.c, struct hllhdr):
+
+    bytes 0-3   magic "HYLL"
+    byte  4     encoding: 0 = dense, 1 = sparse
+    bytes 5-7   reserved (zero)
+    bytes 8-15  cached cardinality, little-endian 64-bit; MSB of byte 15
+                set = cache invalid (server recomputes on next PFCOUNT)
+
+Dense body: 16384 6-bit registers packed little-endian across bytes
+(register r occupies bits [6r, 6r+6) of the body bitstream) — 12288 bytes.
+
+Sparse body opcodes (decode support; we always emit dense):
+    00xxxxxx            ZERO:  run of x+1 zero registers
+    01xxxxxx yyyyyyyy   XZERO: run of ((x<<8)|y)+1 zero registers
+    1vvvvvdd            VAL:   register value v+1 repeated d+1 times
+
+A blob we export carries OUR register values (our hash family is MurmurHash3
+x64 128 low-half, Redis' is MurmurHash64A — see ops/hll.py); Redis PFCOUNT
+on an imported blob reproduces our estimate envelope because estimation only
+reads registers. Round-tripping through a real server is therefore lossless.
+Reference pass-through being replaced: RedissonHyperLogLog.java:40-97.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+MAGIC = b"HYLL"
+DENSE = 0
+SPARSE = 1
+M = 16384
+DENSE_BODY = M * 6 // 8  # 12288
+HDR = 16
+
+
+def encode_dense(regs: np.ndarray, cached_card: int | None = None) -> bytes:
+    """Pack a [16384] register array (values 0..63) into a dense HYLL blob."""
+    regs = np.asarray(regs)
+    if regs.shape != (M,):
+        raise ValueError(f"expected ({M},) registers, got {regs.shape}")
+    r = regs.astype(np.uint8)
+    if (regs > 63).any() or (regs < 0).any():
+        raise ValueError("register values must be in [0, 63]")
+    bits = ((r[:, None] >> np.arange(6, dtype=np.uint8)) & 1).reshape(-1)
+    body = np.packbits(bits, bitorder="little").tobytes()
+    assert len(body) == DENSE_BODY
+    if cached_card is None:
+        card = struct.pack("<Q", 1 << 63)  # invalid flag -> server recomputes
+    else:
+        card = struct.pack("<Q", cached_card & ((1 << 63) - 1))
+    return MAGIC + bytes([DENSE]) + b"\x00\x00\x00" + card + body
+
+
+def decode(blob: bytes) -> np.ndarray:
+    """Decode a dense or sparse HYLL blob into a [16384] uint8 register array."""
+    if len(blob) < HDR or blob[:4] != MAGIC:
+        raise ValueError("not a HYLL blob")
+    enc = blob[4]
+    body = blob[HDR:]
+    if enc == DENSE:
+        if len(body) < DENSE_BODY:
+            raise ValueError(f"dense body too short: {len(body)}")
+        bits = np.unpackbits(
+            np.frombuffer(body[:DENSE_BODY], np.uint8), bitorder="little")
+        return (
+            bits.reshape(M, 6).astype(np.uint8)
+            << np.arange(6, dtype=np.uint8)
+        ).sum(axis=1, dtype=np.uint8)
+    if enc == SPARSE:
+        regs = np.zeros(M, np.uint8)
+        pos = 0
+        i = 0
+        n = len(body)
+        while i < n:
+            op = body[i]
+            if op < 0x40:  # ZERO
+                pos += (op & 0x3F) + 1
+                i += 1
+            elif op < 0x80:  # XZERO
+                if i + 1 >= n:
+                    raise ValueError("truncated XZERO")
+                pos += (((op & 0x3F) << 8) | body[i + 1]) + 1
+                i += 2
+            else:  # VAL
+                val = ((op >> 2) & 0x1F) + 1
+                run = (op & 3) + 1
+                if pos + run > M:
+                    raise ValueError("sparse overflow")
+                regs[pos:pos + run] = val
+                pos += run
+                i += 1
+        if pos > M:
+            raise ValueError("sparse overflow")
+        return regs
+    raise ValueError(f"unknown HYLL encoding {enc}")
+
+
+def estimate(regs: np.ndarray) -> float:
+    """Ertl cardinality estimator (tau/sigma), pure numpy — the host twin of
+    ops/hll.py count() for consumers that must not touch a device (e.g. the
+    embedded fake server). Same math, same result envelope."""
+    regs = np.asarray(regs).astype(np.int64)
+    m = regs.size
+    q = 64 - int(np.log2(m))
+    counts = np.bincount(regs, minlength=q + 2)
+
+    def _sigma(x: float) -> float:
+        if x == 1.0:
+            return np.inf
+        y, z = 1.0, x
+        while True:
+            x = x * x
+            z_prev = z
+            z += x * y
+            y += y
+            if z == z_prev:
+                return z
+
+    def _tau(x: float) -> float:
+        if x == 0.0 or x == 1.0:
+            return 0.0
+        y, z = 1.0, 1.0 - x
+        while True:
+            x = np.sqrt(x)
+            z_prev = z
+            y *= 0.5
+            z -= (1.0 - x) ** 2 * y
+            if z == z_prev:
+                return z / 3.0
+
+    z = m * _tau(1.0 - counts[q + 1] / m)
+    for k in range(q, 0, -1):
+        z = 0.5 * (z + counts[k])
+    z += m * _sigma(counts[0] / m)
+    alpha_inf = 0.5 / np.log(2.0)
+    return alpha_inf * m * m / z
+
+
+def cached_cardinality(blob: bytes) -> int | None:
+    """The header's cached estimate, or None if marked stale."""
+    (card,) = struct.unpack("<Q", blob[8:16])
+    if card >> 63:
+        return None
+    return card
+
+
+def encode_sparse(regs: np.ndarray) -> bytes:
+    """Sparse-encode (only valid while all registers <= 32); raises otherwise.
+
+    Emitted for parity with the server's small-sketch representation; the
+    durability path prefers dense (fixed shape, vectorized pack).
+    """
+    regs = np.asarray(regs).astype(np.int64)
+    if (regs > 32).any():
+        raise ValueError("sparse encoding caps register values at 32")
+    out = bytearray()
+    i = 0
+    while i < M:
+        v = regs[i]
+        j = i
+        while j < M and regs[j] == v and j - i < (1 << 14):
+            j += 1
+        run = j - i
+        if v == 0:
+            while run > 0:
+                if run <= 64:
+                    out.append(run - 1)
+                    run = 0
+                else:
+                    chunk = min(run, 1 << 14)
+                    out.append(0x40 | ((chunk - 1) >> 8))
+                    out.append((chunk - 1) & 0xFF)
+                    run -= chunk
+        else:
+            while run > 0:
+                chunk = min(run, 4)
+                out.append(0x80 | ((int(v) - 1) << 2) | (chunk - 1))
+                run -= chunk
+        i = j
+    card = struct.pack("<Q", 1 << 63)
+    return MAGIC + bytes([SPARSE]) + b"\x00\x00\x00" + card + bytes(out)

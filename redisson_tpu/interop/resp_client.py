@@ -1,0 +1,285 @@
+"""Async RESP2 client — the durability/interop transport.
+
+A deliberately small analogue of the reference's L0+L2 for the flush path:
+
+  * strict in-order request/response correlation over one connection —
+    the futures deque plays the role of the reference's per-connection
+    CommandsQueue correlator (client/handler/CommandsQueue.java:40-95),
+    generalized to n in-flight commands (RESP2 replies are ordered);
+  * pipelining: one writer call for many commands, one future each
+    (command/CommandBatchService.java semantics);
+  * reconnect watchdog with exponential backoff 2<<attempt (capped),
+    modeled on client/handler/ConnectionWatchdog.java:48-114;
+  * per-command retry (retry_attempts x retry_interval) + response timeout,
+    modeled on command/CommandAsyncService.java:378-512.
+
+Wire encode/parse runs in the native C++ codec (redisson_tpu.native); this
+module is orchestration only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import threading
+from typing import Any, Deque, List, Optional, Sequence, Tuple
+
+from redisson_tpu import native
+from redisson_tpu.native import RespError
+
+
+class ConnectionClosed(ConnectionError):
+    pass
+
+
+class RespClient:
+    """One logical Redis connection with auto-reconnect and retries."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6379,
+        *,
+        password: Optional[str] = None,
+        db: int = 0,
+        timeout: float = 3.0,
+        retry_attempts: int = 3,
+        retry_interval: float = 1.0,
+        reconnect_backoff_cap: int = 5,
+    ):
+        self.host = host
+        self.port = port
+        self.password = password
+        self.db = db
+        self.timeout = timeout
+        self.retry_attempts = retry_attempts
+        self.retry_interval = retry_interval
+        self.reconnect_backoff_cap = reconnect_backoff_cap
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._parser: Optional[native.RespParser] = None
+        self._pending: Deque[asyncio.Future] = collections.deque()
+        self._read_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._conn_lock = asyncio.Lock()
+        self.reconnects = 0  # observability: completed reconnect cycles
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def connect(self) -> None:
+        async with self._conn_lock:
+            if self.connected or self._closed:
+                return
+            await self._dial()
+
+    async def _dial(self) -> None:
+        # Tear down any previous connection first: a stale read loop must
+        # never share _pending with the new one or touch a closed parser.
+        await self._teardown_connection()
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout)
+        parser = native.RespParser()
+        self._reader, self._writer, self._parser = reader, writer, parser
+        self._read_task = asyncio.ensure_future(
+            self._read_loop(reader, writer, parser))
+        try:
+            if self.password is not None:
+                await self._roundtrip("AUTH", self.password)
+            if self.db:
+                await self._roundtrip("SELECT", str(self.db))
+        except Exception:
+            await self._teardown_connection()
+            raise
+
+    async def _teardown_connection(self) -> None:
+        task, self._read_task = self._read_task, None
+        writer, self._writer = self._writer, None
+        self._reader = None
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if writer is not None:
+            try:
+                writer.close()
+            except Exception:
+                pass
+        if self._parser is not None:
+            self._parser.close()
+            self._parser = None
+        self._fail_pending(ConnectionClosed("connection lost"))
+
+    async def _read_loop(self, reader, writer, parser) -> None:
+        """Owns exactly the (reader, writer, parser) triple it was started
+        with; never touches self's current-connection fields directly."""
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                for reply in parser.feed(data):
+                    if self._pending:
+                        fut = self._pending.popleft()
+                        if not fut.done():
+                            if isinstance(reply, RespError):
+                                fut.set_exception(reply)
+                            else:
+                                fut.set_result(reply)
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+        finally:
+            # Only clear shared state if we are still the live connection.
+            if self._writer is writer:
+                self._writer = None
+                self._reader = None
+                self._fail_pending(ConnectionClosed("connection lost"))
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _fail_pending(self, exc: Exception) -> None:
+        while self._pending:
+            fut = self._pending.popleft()
+            if not fut.done():
+                fut.set_exception(exc)
+
+    async def _reconnect(self) -> None:
+        """Exponential backoff dial loop (ConnectionWatchdog semantics)."""
+        async with self._conn_lock:
+            if self.connected or self._closed:
+                return
+            attempt = 0
+            while not self._closed:
+                try:
+                    await self._dial()
+                    self.reconnects += 1
+                    return
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    delay = min(2 << attempt, 2 << self.reconnect_backoff_cap) / 1000.0
+                    attempt += 1
+                    await asyncio.sleep(delay)
+                    if attempt > 12:  # watchdog cap (ConnectionWatchdog.java:48)
+                        raise ConnectionClosed(
+                            f"reconnect to {self.host}:{self.port} failed after {attempt} attempts")
+
+    async def _roundtrip(self, *args) -> Any:
+        """Send one command on the current connection, no retry."""
+        if not self.connected:
+            raise ConnectionClosed("not connected")
+        fut = asyncio.get_event_loop().create_future()
+        self._pending.append(fut)
+        self._writer.write(native.resp_encode(*args))
+        await self._writer.drain()
+        return await asyncio.wait_for(fut, self.timeout)
+
+    async def execute(self, *args) -> Any:
+        """Send with the retry policy; reconnects between attempts."""
+        last: Exception = ConnectionClosed("never connected")
+        for attempt in range(self.retry_attempts + 1):
+            if attempt:
+                await asyncio.sleep(self.retry_interval)
+            try:
+                if not self.connected:
+                    await self._reconnect()
+                return await self._roundtrip(*args)
+            except RespError:
+                raise  # server-side errors are not retryable
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last = e
+        raise last
+
+    async def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
+        """Send a batch as ONE write; per-command results, in order.
+
+        Redirect-free version of CommandBatchService.executeAsync: results
+        come back ordered by the wire (the global index re-sort is a no-op
+        on a single connection).
+        """
+        if not commands:
+            return []
+        if not self.connected:
+            await self._reconnect()
+            if not self.connected:  # closed client: _reconnect is a no-op
+                raise ConnectionClosed("client is closed")
+        loop = asyncio.get_event_loop()
+        futs = [loop.create_future() for _ in commands]
+        self._pending.extend(futs)
+        self._writer.write(native.resp_encode_pipeline(commands))
+        await self._writer.drain()
+        results = await asyncio.wait_for(
+            asyncio.gather(*futs, return_exceptions=True),
+            self.timeout * max(1, len(commands) // 1000 + 1))
+        out: List[Any] = []
+        for r in results:
+            if isinstance(r, Exception) and not isinstance(r, RespError):
+                raise r
+            out.append(r)
+        return out
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._read_task is not None:
+            self._read_task.cancel()
+            try:
+                await self._read_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        if self._parser is not None:
+            self._parser.close()
+            self._parser = None
+
+
+class SyncRespClient:
+    """Blocking facade over RespClient on a private event-loop thread —
+    the analogue of CommandSyncService wrapping CommandAsyncService."""
+
+    def __init__(self, *args, **kwargs):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="rtpu-resp-io", daemon=True)
+        self._thread.start()
+        self._client = RespClient(*args, **kwargs)
+
+    def _run(self, coro, extra_timeout: float = 30.0):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        # The coroutine has its own response timeouts; this outer bound only
+        # guards against a wedged/dead IO loop thread.
+        return fut.result(self._client.timeout + extra_timeout)
+
+    def connect(self) -> None:
+        self._run(self._client.connect())
+
+    def execute(self, *args) -> Any:
+        return self._run(self._client.execute(*args))
+
+    def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
+        # Match the inner pipeline timeout scaling so the outer guard never
+        # fires first on large batches.
+        scale = self._client.timeout * max(1, len(commands) // 1000 + 1)
+        return self._run(self._client.pipeline(commands), extra_timeout=30.0 + scale)
+
+    def close(self) -> None:
+        try:
+            self._run(self._client.close())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            self._loop.close()
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

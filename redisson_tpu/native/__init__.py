@@ -42,12 +42,12 @@ def _build() -> Optional[str]:
     if os.path.exists(_SO_PATH) and os.path.getmtime(_SO_PATH) >= os.path.getmtime(src):
         return _SO_PATH
     try:
+        # The Makefile is the single source of compile flags.
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
-             "-fvisibility=hidden", "-o", _SO_PATH, src],
+            ["make", "-C", _SRC_DIR, "librtpu.so"],
             check=True, capture_output=True, timeout=120,
         )
-        return _SO_PATH
+        return _SO_PATH if os.path.exists(_SO_PATH) else None
     except (OSError, subprocess.SubprocessError):
         return None
 
@@ -268,6 +268,8 @@ class RespParser:
     def feed(self, data: bytes) -> List:
         if self._lib is None:
             return self._feed_py(data)
+        if self._h is None:
+            raise ValueError("RespParser is closed")
         buf = np.frombuffer(data, np.uint8)
         n = self._lib.rtpu_resp_parser_feed(
             self._h, _u8p(np.ascontiguousarray(buf)), len(data))
@@ -321,7 +323,9 @@ class RespParser:
             self._pypos = 0
         return out
 
-    def _parse_py(self, b: bytes, pos: int):
+    _MAX_DEPTH = 64  # mirror the native parser's nesting cap
+
+    def _parse_py(self, b: bytes, pos: int, depth: int = 0):
         if pos >= len(b):
             return None, 0
         eol = b.find(b"\r\n", pos + 1)
@@ -344,13 +348,15 @@ class RespParser:
                 return None, 0
             return bytes(b[after:after + n]), after - pos + n + 2
         if t == b"*":
+            if depth >= self._MAX_DEPTH:
+                raise ValueError("RESP nesting too deep")
             n = int(line)
             if n < 0:
                 return None, after - pos
             items = []
             cur = after
             for _ in range(n):
-                item, consumed = self._parse_py(b, cur)
+                item, consumed = self._parse_py(b, cur, depth + 1)
                 if consumed == 0:
                     return None, 0
                 items.append(item)

@@ -1,0 +1,428 @@
+"""Interop tier tests: HYLL codec, RESP client vs the embedded fake server,
+durability flush/import round-trips, local checkpoint/resume."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from redisson_tpu import checkpoint, native
+from redisson_tpu.interop import hyll
+from redisson_tpu.interop.durability import DurabilityManager
+from redisson_tpu.interop.fake_server import EmbeddedRedis, FakeRedisServer
+from redisson_tpu.interop.resp_client import (ConnectionClosed, RespClient,
+                                              SyncRespClient)
+
+# ---------------------------------------------------------------------------
+# HYLL codec
+# ---------------------------------------------------------------------------
+
+
+def test_hyll_dense_roundtrip():
+    rng = np.random.default_rng(1)
+    regs = rng.integers(0, 52, 16384).astype(np.uint8)
+    blob = hyll.encode_dense(regs)
+    assert blob[:4] == b"HYLL" and blob[4] == 0
+    assert len(blob) == 16 + 12288
+    np.testing.assert_array_equal(hyll.decode(blob), regs)
+
+
+def test_hyll_cached_cardinality_flag():
+    regs = np.zeros(16384, np.uint8)
+    assert hyll.cached_cardinality(hyll.encode_dense(regs)) is None
+    assert hyll.cached_cardinality(hyll.encode_dense(regs, cached_card=123)) == 123
+
+
+def test_hyll_sparse_roundtrip():
+    regs = np.zeros(16384, np.uint8)
+    regs[0] = 5
+    regs[1] = 5
+    regs[100] = 32
+    regs[16383] = 1
+    blob = hyll.encode_sparse(regs)
+    assert blob[4] == 1
+    np.testing.assert_array_equal(hyll.decode(blob), regs)
+
+
+def test_hyll_sparse_rejects_large_values():
+    regs = np.zeros(16384, np.uint8)
+    regs[7] = 33
+    with pytest.raises(ValueError):
+        hyll.encode_sparse(regs)
+
+
+def test_hyll_decode_rejects_garbage():
+    with pytest.raises(ValueError):
+        hyll.decode(b"NOPE" + b"\x00" * 20)
+    with pytest.raises(ValueError):
+        hyll.decode(b"HYLL\x00\x00\x00\x00" + b"\x00" * 8)  # dense, short body
+
+
+def test_hyll_blob_matches_native_fold_estimate():
+    # encode registers produced by the native fold; decode; estimate intact
+    import jax.numpy as jnp
+
+    from redisson_tpu.ops import hll as hll_ops
+    regs = np.zeros(16384, np.uint8)
+    native.hll_fold([b"k%d" % i for i in range(50000)], regs)
+    back = hyll.decode(hyll.encode_dense(regs))
+    est = float(hll_ops.count(jnp.asarray(back.astype(np.int32))))
+    assert abs(est - 50000) / 50000 < 0.02
+
+
+# ---------------------------------------------------------------------------
+# RESP client against the fake server
+# ---------------------------------------------------------------------------
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_client_basic_and_pipeline():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        c = RespClient(port=srv.port, retry_interval=0.01)
+        await c.connect()
+        assert await c.execute("PING") == b"PONG"
+        assert await c.execute("SET", "a", "1") == b"OK"
+        assert await c.execute("GET", "a") == b"1"
+        assert await c.execute("GET", "missing") is None
+        res = await c.pipeline([("SET", f"k{i}", f"v{i}") for i in range(100)]
+                               + [("DBSIZE",)])
+        assert res[-1] == 101  # 100 k's + a
+        assert await c.execute("EXISTS", "k0", "k99", "nope") == 2
+        await c.close()
+        await srv.stop()
+    run(go())
+
+
+def test_client_error_replies_raise():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        c = RespClient(port=srv.port)
+        await c.connect()
+        with pytest.raises(native.RespError):
+            await c.execute("NOSUCHCMD")
+        await c.close()
+        await srv.stop()
+    run(go())
+
+
+def test_client_auth():
+    async def go():
+        srv = FakeRedisServer(password="sekrit")
+        await srv.start()
+        bad = RespClient(port=srv.port)
+        await bad.connect()
+        with pytest.raises(native.RespError):
+            await bad.execute("GET", "x")
+        await bad.close()
+        good = RespClient(port=srv.port, password="sekrit")
+        await good.connect()
+        assert await good.execute("SET", "x", "1") == b"OK"
+        await good.close()
+        await srv.stop()
+    run(go())
+
+
+def test_client_reconnects_after_drop():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        c = RespClient(port=srv.port, retry_attempts=3, retry_interval=0.01)
+        await c.connect()
+        await c.execute("SET", "a", "1")
+        # Server drops the connection mid-stream (fault injection).
+        with pytest.raises((ConnectionClosed, asyncio.TimeoutError, ConnectionError)):
+            await c._roundtrip("DROPCONN")
+        # Retry path dials a fresh connection; state survives server-side.
+        assert await c.execute("GET", "a") == b"1"
+        assert c.reconnects >= 1
+        await c.close()
+        await srv.stop()
+    run(go())
+
+
+def test_sync_client_facade():
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as c:
+            assert c.execute("PING") == b"PONG"
+            c.execute("SET", "s", b"\x00\xff")
+            assert c.execute("GET", "s") == b"\x00\xff"
+            got = c.pipeline([("SET", "p1", "a"), ("GET", "p1")])
+            assert got == [b"OK", b"a"]
+
+
+def test_fake_server_pfadd_pfcount_consistency():
+    # The fake's PFCOUNT must agree with the framework's estimator since it
+    # uses the same registers + hash.
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as c:
+            keys = [b"u%d" % i for i in range(20000)]
+            c.pipeline([["PFADD", "sketch"] + keys[i:i + 1000]
+                        for i in range(0, len(keys), 1000)])
+            est = c.execute("PFCOUNT", "sketch")
+            assert abs(est - 20000) / 20000 < 0.02
+            # merge two sketches
+            c.execute("PFADD", "s2", *[b"v%d" % i for i in range(1000)])
+            c.execute("PFMERGE", "dest", "sketch", "s2")
+            est2 = c.execute("PFCOUNT", "dest")
+            assert est2 > est
+
+
+# ---------------------------------------------------------------------------
+# Durability flush / import (TPU store <-> fake redis)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def local_client():
+    from redisson_tpu.client import RedissonTPU
+    c = RedissonTPU.create()
+    yield c
+    c.shutdown()
+
+
+def test_durability_hll_roundtrip(local_client):
+    h = local_client.get_hyper_log_log("d:hll")
+    h.add_all([b"k%d" % i for i in range(30000)])
+    est_before = h.count()
+
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            assert dm.flush(["d:hll"]) == 1
+            # A "real" server can PFCOUNT the flushed blob directly.
+            server_est = rc.execute("PFCOUNT", "d:hll")
+            assert abs(server_est - est_before) / max(est_before, 1) < 0.01
+
+            # Wipe local state, import back, estimate preserved exactly.
+            local_client._store.delete("d:hll")
+            assert dm.load_hll("d:hll")
+            h2 = local_client.get_hyper_log_log("d:hll")
+            assert abs(h2.count() - est_before) / max(est_before, 1) < 0.005
+
+
+def test_durability_bitset_roundtrip(local_client):
+    bs = local_client.get_bit_set("d:bits")
+    idx = [1, 7, 8, 100, 4095]
+    for i in idx:
+        bs.set(i)
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            dm.flush(["d:bits"])
+            # Server-side GETBIT agrees bit-for-bit (Redis SETBIT order).
+            for i in idx:
+                assert rc.execute("GETBIT", "d:bits", i) == 1
+            assert rc.execute("GETBIT", "d:bits", 2) == 0
+            assert rc.execute("BITCOUNT", "d:bits") == len(idx)
+
+            local_client._store.delete("d:bits")
+            assert dm.load_bitset("d:bits")
+            bs2 = local_client.get_bit_set("d:bits")
+            for i in idx:
+                assert bs2.get(i)
+            assert not bs2.get(2)
+
+
+def test_durability_bloom_roundtrip(local_client):
+    bf = local_client.get_bloom_filter("d:bloom")
+    bf.try_init(expected_insertions=5000, false_probability=0.01)
+    bf.add_all([b"item%d" % i for i in range(2000)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            dm.flush(["d:bloom"])
+            cfg = rc.execute("HGETALL", "d:bloom__config")
+            cfgmap = {bytes(cfg[i]): bytes(cfg[i + 1]) for i in range(0, len(cfg), 2)}
+            assert b"size" in cfgmap and b"hashIterations" in cfgmap
+
+            local_client._store.delete("d:bloom")
+            assert dm.load_bloom("d:bloom")
+            bf2 = local_client.get_bloom_filter("d:bloom")
+            hits = bf2.contains_all([b"item%d" % i for i in range(2000)])
+            assert all(hits), "false negatives after import"
+
+
+def test_durability_periodic_flush(local_client):
+    h = local_client.get_hyper_log_log("d:p")
+    h.add_all([b"x%d" % i for i in range(100)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            dm.start_periodic(interval=0.05)
+            import time
+            deadline = time.time() + 5
+            while time.time() < deadline and dm.flushes == 0:
+                time.sleep(0.05)
+            dm.stop_periodic()
+            assert dm.flushes >= 1
+            assert rc.execute("EXISTS", "d:p") == 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint / resume
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip(tmp_path, local_client):
+    h = local_client.get_hyper_log_log("c:hll")
+    h.add_all([b"k%d" % i for i in range(10000)])
+    bs = local_client.get_bit_set("c:bits")
+    bs.set(42)
+    est = h.count()
+
+    path = str(tmp_path / "ckpt")
+    n = checkpoint.save(local_client._store, path)
+    assert n == 2
+    meta = checkpoint.info(path)
+    assert set(meta["objects"]) == {"c:hll", "c:bits"}
+
+    local_client.flushall()
+    assert local_client.get_hyper_log_log("c:hll").count() == 0
+
+    assert checkpoint.load(local_client._store, path) == 2
+    assert local_client.get_hyper_log_log("c:hll").count() == est
+    assert local_client.get_bit_set("c:bits").get(42)
+
+
+def test_checkpoint_atomic_overwrite(tmp_path, local_client):
+    local_client.get_bit_set("c2:b").set(1)
+    path = str(tmp_path / "ck")
+    checkpoint.save(local_client._store, path)
+    local_client.get_bit_set("c2:b").set(9)
+    checkpoint.save(local_client._store, path)  # overwrite in place
+    local_client.flushall()
+    checkpoint.load(local_client._store, path)
+    assert local_client.get_bit_set("c2:b").get(9)
+
+
+# ---------------------------------------------------------------------------
+# Facade wiring: Config.redis as durability tier
+# ---------------------------------------------------------------------------
+
+
+def test_client_facade_durability_wiring():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_local()
+        rc = cfg.use_redis()
+        rc.address = f"redis://127.0.0.1:{er.port}"
+        cfg.flush_interval_s = 0.05
+
+        client = RedissonTPU.create(cfg)
+        try:
+            assert client.durability is not None
+            h = client.get_hyper_log_log("w:hll")
+            h.add_all([b"k%d" % i for i in range(5000)])
+            est = h.count()
+            n = client.flush_to_redis()
+            assert n >= 1
+        finally:
+            client.shutdown()  # also runs the final flush
+
+        # the flushed blob is server-readable
+        with SyncRespClient(port=er.port) as probe:
+            got = probe.execute("PFCOUNT", "w:hll")
+            assert abs(got - est) / max(est, 1) < 0.01
+
+
+def test_config_roundtrip_with_redis_tier(tmp_path):
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_tpu()
+    r = cfg.use_redis()
+    r.address = "redis://10.0.0.1:6380"
+    r.password = "pw"
+    cfg.flush_interval_s = 12.5
+    text = cfg.to_json()
+    back = Config.from_json(text)
+    assert back.redis.address == "redis://10.0.0.1:6380"
+    assert back.redis.password == "pw"
+    assert back.flush_interval_s == 12.5
+    assert back.mode() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Review regressions
+# ---------------------------------------------------------------------------
+
+
+def test_resp_parser_depth_cap():
+    # A hostile stream of deeply nested arrays must not overflow the stack.
+    p = native.RespParser()
+    try:
+        got = p.feed(b"*1\r\n" * 500 + b":1\r\n")
+        assert len(got) == 1
+        # The cap fires at depth 64: outer levels already emitted, the
+        # innermost element is the 'nesting too deep' error (no crash).
+        inner = got[0]
+        while isinstance(inner, list):
+            assert len(inner) == 1
+            inner = inner[0]
+        assert isinstance(inner, native.RespError)
+    finally:
+        p.close()
+
+
+def test_resp_parser_feed_after_close_raises():
+    p = native.RespParser()
+    p.close()
+    with pytest.raises(ValueError):
+        p.feed(b"+OK\r\n")
+
+
+def test_pipeline_on_closed_client_raises():
+    async def go():
+        srv = FakeRedisServer()
+        await srv.start()
+        c = RespClient(port=srv.port)
+        await c.connect()
+        await c.close()
+        with pytest.raises(ConnectionClosed):
+            await c.pipeline([("PING",)])
+        await srv.stop()
+    run(go())
+
+
+def test_periodic_flush_skips_clean_objects(local_client):
+    h = local_client.get_hyper_log_log("dirty:h")
+    h.add_all([b"a%d" % i for i in range(100)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            assert dm.flush(only_dirty=True) == 1   # first flush writes
+            assert dm.flush(only_dirty=True) == 0   # nothing changed
+            h.add(b"new-key")
+            assert dm.flush(only_dirty=True) == 1   # mutation re-flushes
+            assert dm.flush() == 1                  # full flush ignores tracking
+
+
+def test_failed_flush_keeps_objects_dirty(local_client):
+    h = local_client.get_hyper_log_log("dirty:fail")
+    h.add_all([b"q%d" % i for i in range(50)])
+    with EmbeddedRedis() as er:
+        rc = SyncRespClient(port=er.port)
+        rc.connect()
+        dm = DurabilityManager(local_client._store, rc)
+        rc.close()  # write will fail
+        with pytest.raises(Exception):
+            dm.flush(only_dirty=True)
+        # Object must still be dirty: a fresh client flushes it.
+        rc2 = SyncRespClient(port=er.port)
+        rc2.connect()
+        dm.client = rc2
+        assert dm.flush(only_dirty=True) == 1
+        rc2.close()
