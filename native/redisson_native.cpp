@@ -287,6 +287,7 @@ struct RespParser {
   size_t pos = 0;       // parse cursor into buf
   std::string out;      // flattened completed replies
   int64_t nready = 0;   // completed top-level replies in `out`
+  bool poisoned = false;  // unrecoverable protocol violation seen
 };
 
 RTPU_EXPORT RespParser* rtpu_resp_parser_new() { return new RespParser(); }
@@ -342,10 +343,11 @@ static bool parse_one(RespParser* p, size_t& pos, std::string& out,
     }
     case '*': {
       if (depth >= kMaxRespDepth) {
-        emit_header(out, '-', 20);
-        out.append("ERR nesting too deep", 20);
-        pos = b.size();
-        return true;
+        // Unrecoverable: request/response framing is lost. Poison the
+        // parser; feed() surfaces one top-level error reply and the
+        // client tears the connection down.
+        p->poisoned = true;
+        return false;
       }
       int64_t count = std::strtoll(line.c_str(), nullptr, 10);
       emit_header(out, '*', count);
@@ -356,12 +358,9 @@ static bool parse_one(RespParser* p, size_t& pos, std::string& out,
       return true;
     }
     default:
-      // Protocol violation: surface as an error reply so the client can
-      // tear down the connection instead of spinning.
-      emit_header(out, '-', 14);
-      out.append("ERR bad header");
-      pos = b.size();
-      return true;
+      // Protocol violation: framing is lost for good — poison.
+      p->poisoned = true;
+      return false;
   }
 }
 
@@ -369,6 +368,10 @@ static bool parse_one(RespParser* p, size_t& pos, std::string& out,
 // buffered (cumulative, decremented by take).
 RTPU_EXPORT int64_t rtpu_resp_parser_feed(RespParser* p, const uint8_t* data,
                                           int64_t len) {
+  if (p->poisoned) {
+    // One error reply was already surfaced; drop everything after it.
+    return p->nready;
+  }
   p->buf.append((const char*)data, (size_t)len);
   for (;;) {
     size_t pos = p->pos;
@@ -377,6 +380,15 @@ RTPU_EXPORT int64_t rtpu_resp_parser_feed(RespParser* p, const uint8_t* data,
     p->out.append(piece);
     p->pos = pos;
     p->nready++;
+  }
+  if (p->poisoned) {
+    static const char kMsg[] = "ERR protocol violation (bad header or nesting)";
+    emit_header(p->out, '-', (int64_t)(sizeof(kMsg) - 1));
+    p->out.append(kMsg, sizeof(kMsg) - 1);
+    p->nready++;
+    p->buf.clear();
+    p->pos = 0;
+    return p->nready;
   }
   // Compact consumed prefix occasionally to bound memory.
   if (p->pos > (1u << 16) && p->pos * 2 > p->buf.size()) {

@@ -52,29 +52,47 @@ def save(store: SketchStore, path: str,
             "dtype": str(host.dtype),
             "shape": list(host.shape),
         }
-    tmp = path + ".tmp"
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    with open(os.path.join(tmp, MANIFEST), "w") as f:
-        json.dump({"version": FORMAT_VERSION, "written_at": time.time(),
-                   "objects": objs}, f, indent=1)
-    # Prefix array keys: a sketch literally named "file" would collide with
-    # savez's first positional parameter if passed as a bare kwarg.
-    np.savez_compressed(os.path.join(tmp, STATE),
-                        **{_KEY_PREFIX + k: v for k, v in arrays.items()})
-    if os.path.exists(path):
-        shutil.rmtree(path)
-    os.replace(tmp, path)
+    import tempfile
+
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(parent, exist_ok=True)
+    # Unique tmp dir: concurrent save() calls never clobber each other.
+    tmp = tempfile.mkdtemp(prefix=os.path.basename(path) + ".tmp.", dir=parent)
+    try:
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump({"version": FORMAT_VERSION, "written_at": time.time(),
+                       "objects": objs}, f, indent=1)
+        # Prefix array keys: a sketch literally named "file" would collide
+        # with savez's first positional parameter as a bare kwarg.
+        np.savez_compressed(os.path.join(tmp, STATE),
+                            **{_KEY_PREFIX + k: v for k, v in arrays.items()})
+        # Exchange-style swap: the previous good checkpoint survives (as
+        # `.old`) through every crash point; load() falls back to it.
+        old = path + ".old"
+        if os.path.exists(old):
+            shutil.rmtree(old)
+        if os.path.exists(path):
+            os.replace(path, old)
+        os.replace(tmp, path)
+        if os.path.exists(old):
+            shutil.rmtree(old)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
     return len(objs)
 
 
 def load(store: SketchStore, path: str,
          names: Optional[List[str]] = None) -> int:
     """Restore objects from a checkpoint into the store (overwriting
-    same-named objects). Returns the number restored."""
+    same-named objects). Returns the number restored. Falls back to the
+    `.old` sibling if a crash interrupted the last save's swap."""
     import jax
 
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        old = path + ".old"
+        if os.path.exists(os.path.join(old, MANIFEST)):
+            path = old
     with open(os.path.join(path, MANIFEST)) as f:
         manifest = json.load(f)
     if manifest.get("version") != FORMAT_VERSION:

@@ -90,6 +90,7 @@ class RedissonTPU:
         # Durability tier: redis config alongside tpu/pod wires the flush
         # path (SURVEY.md §7 step 6); flush_interval_s > 0 starts the
         # periodic flusher.
+        self._remote_services = {}
         self._durability = None
         self._resp = None
         if self.config.redis is not None and mode != "redis":
@@ -259,6 +260,24 @@ class RedissonTPU:
     def get_count_down_latch(self, name: str) -> RCountDownLatch:
         return RCountDownLatch(name, self._executor, self._pubsub)
 
+    # -- services (L5b) -----------------------------------------------------
+
+    def get_remote_service(self, name: str = "remote_service"):
+        """RPC service registry/proxy factory (RRemoteService analogue).
+        One cached instance per name; shut down with the client."""
+        from redisson_tpu.services.remote import RRemoteService
+
+        rs = self._remote_services.get(name)
+        if rs is None:
+            rs = self._remote_services[name] = RRemoteService(self, name)
+        return rs
+
+    def get_cache_manager(self, configs=None):
+        """Spring-cache-manager analogue over RMap/RMapCache."""
+        from redisson_tpu.services.cache_manager import CacheManager
+
+        return CacheManager(self, configs)
+
     # -- keys facade (RKeys analogue) ---------------------------------------
 
     def get_keys(self) -> RKeys:
@@ -278,6 +297,12 @@ class RedissonTPU:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self):
+        for rs in self._remote_services.values():
+            try:
+                rs.shutdown(wait=False)
+            except Exception:
+                pass
+        self._remote_services.clear()
         if self._durability is not None:
             self._durability.stop_periodic()
             try:

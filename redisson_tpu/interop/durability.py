@@ -27,6 +27,7 @@ import numpy as np
 
 from redisson_tpu.interop import hyll
 from redisson_tpu.interop.resp_client import SyncRespClient
+from redisson_tpu.native import RespError
 from redisson_tpu.store import ObjectType, SketchStore
 
 BLOOM_CONFIG_SUFFIX = "__config"
@@ -103,8 +104,13 @@ class DurabilityManager:
                 written.append((n, version))
         if cmds:
             t0 = time.monotonic()
-            self.client.pipeline(cmds)
+            results = self.client.pipeline(cmds)
             self.last_flush_s = time.monotonic() - t0
+            errors = [r for r in results if isinstance(r, RespError)]
+            if errors:
+                # Server-side per-command failures (OOM, WRONGTYPE, ...):
+                # nothing is marked clean, the periodic flusher retries all.
+                raise errors[0]
         # Only mark clean once the pipeline write succeeded — a failed write
         # must leave objects dirty so the periodic flusher retries them.
         for n, version in written:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import threading
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Deque, List, Optional, Sequence, Tuple
 
 from redisson_tpu import native
@@ -251,11 +252,24 @@ class SyncRespClient:
         self._thread.start()
         self._client = RespClient(*args, **kwargs)
 
+    def _worst_case_s(self) -> float:
+        """Upper bound on one execute()'s retry/reconnect schedule: per
+        attempt up to 13 backoff dials of `timeout` each plus the response
+        wait, times (retry_attempts + 1) tries with retry_interval between."""
+        c = self._client
+        per_attempt = 13 * c.timeout + c.timeout + c.retry_interval
+        return (c.retry_attempts + 1) * per_attempt
+
     def _run(self, coro, extra_timeout: float = 30.0):
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         # The coroutine has its own response timeouts; this outer bound only
-        # guards against a wedged/dead IO loop thread.
-        return fut.result(self._client.timeout + extra_timeout)
+        # guards against a wedged/dead IO loop thread, so it must sit above
+        # the worst-case legitimate schedule.
+        try:
+            return fut.result(self._worst_case_s() + extra_timeout)
+        except FuturesTimeoutError:
+            fut.cancel()  # don't leave the coroutine running to write later
+            raise
 
     def connect(self) -> None:
         self._run(self._client.connect())

@@ -112,6 +112,10 @@ class RMap(RExpirable):
     def size(self) -> int:
         return self._executor.execute_sync(self.name, "hlen", None)
 
+    def clear(self) -> bool:
+        """java.util.Map.clear — drop every entry (DEL of the hash)."""
+        return self.delete()
+
     def key_set(self) -> List[Any]:
         return [self._dk(f) for f in self._executor.execute_sync(self.name, "hkeys", None)]
 

@@ -253,6 +253,7 @@ class RespParser:
         self._h = lib.rtpu_resp_parser_new() if lib is not None else None
         self._pybuf = bytearray()  # fallback path buffer
         self._pypos = 0  # parse cursor into _pybuf (avoids O(N^2) re-slicing)
+        self._poisoned = False  # fallback protocol-violation latch
 
     def close(self):
         if self._h is not None:
@@ -310,14 +311,25 @@ class RespParser:
 
     # Pure-python incremental parser (fallback).
     def _feed_py(self, data: bytes) -> List:
+        if self._poisoned:
+            return []
         self._pybuf += data
         out = []
-        while True:
-            item, consumed = self._parse_py(self._pybuf, self._pypos)
-            if consumed == 0:
-                break
-            out.append(item)
-            self._pypos += consumed
+        try:
+            while True:
+                item, consumed = self._parse_py(self._pybuf, self._pypos)
+                if consumed == 0:
+                    break
+                out.append(item)
+                self._pypos += consumed
+        except ValueError:
+            # Framing lost: surface one in-band error (matching the native
+            # parser's poisoning) and drop the rest of the stream.
+            self._poisoned = True
+            self._pybuf = bytearray()
+            self._pypos = 0
+            out.append(RespError("ERR protocol violation (bad header or nesting)"))
+            return out
         if self._pypos > (1 << 16) and self._pypos * 2 > len(self._pybuf):
             del self._pybuf[:self._pypos]
             self._pypos = 0

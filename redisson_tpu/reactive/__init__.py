@@ -1,0 +1,248 @@
+"""Reactive (asyncio) API — the async mirror of every object.
+
+The reference ships a full reactive tier: `RedissonReactive` + 25 wrappers
+adapting each object's `*Async` methods into reactor-stream Publishers via
+`NettyFuturePublisher` (reference `reactive/`, `api/`, SURVEY.md §2 L4/L5).
+Python's Publisher is the awaitable, so our adapter is:
+
+  * every sync-object method with an `*_async` twin becomes a coroutine
+    awaiting the executor future (`asyncio.wrap_future` bridges the
+    `concurrent.futures.Future` from the L2 executor into the caller's
+    event loop — the NettyFuturePublisher role);
+  * methods without an async twin (blocking ops like `lock()`, `take()`,
+    or host-side conveniences) run in a worker thread via
+    `asyncio.to_thread`, keeping the event loop unblocked;
+  * non-callable attributes (`.name`, …) pass through.
+
+`RedissonTPUReactive` mirrors the facade getters; typed wrapper classes add
+the async-native affordances (async context-manager locks, async iteration)
+on top of the generic proxy.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+from concurrent.futures import Future as _CFuture
+from typing import Any, AsyncIterator, Optional
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+
+__all__ = ["RedissonTPUReactive", "AsyncProxy", "create_reactive"]
+
+
+class AsyncProxy:
+    """Generic async mirror of one sync object."""
+
+    __slots__ = ("_sync",)
+
+    def __init__(self, sync_obj: Any):
+        object.__setattr__(self, "_sync", sync_obj)
+
+    @property
+    def sync(self) -> Any:
+        """The underlying synchronous object."""
+        return self._sync
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        sync = self._sync
+        async_impl = getattr(sync, name + "_async", None)
+        if callable(async_impl):
+
+            @functools.wraps(async_impl)
+            async def via_future(*args, **kwargs):
+                out = async_impl(*args, **kwargs)
+                if isinstance(out, _CFuture):
+                    return await asyncio.wrap_future(out)
+                if isinstance(out, (list, tuple)) and out and all(
+                        isinstance(f, _CFuture) for f in out):
+                    return type(out)(
+                        await asyncio.gather(*(asyncio.wrap_future(f) for f in out)))
+                return out  # already a plain value
+
+            return via_future
+        attr = getattr(sync, name)
+        if callable(attr):
+
+            @functools.wraps(attr)
+            async def via_thread(*args, **kwargs):
+                return await asyncio.to_thread(attr, *args, **kwargs)
+
+            return via_thread
+        return attr
+
+    def __repr__(self) -> str:
+        return f"Async({self._sync!r})"
+
+
+class AsyncLock(AsyncProxy):
+    """Adds `async with` acquire/release on top of the proxy."""
+
+    async def __aenter__(self):
+        await asyncio.to_thread(self._sync.lock)
+        return self
+
+    async def __aexit__(self, *exc):
+        await asyncio.to_thread(self._sync.unlock)
+
+
+class AsyncIterableProxy(AsyncProxy):
+    """Adds `async for` over the sync object's iterator (driven off-loop)."""
+
+    def __aiter__(self) -> AsyncIterator:
+        it = iter(self._sync)
+        sentinel = object()
+
+        async def gen():
+            while True:
+                item = await asyncio.to_thread(next, it, sentinel)
+                if item is sentinel:
+                    return
+                yield item
+
+        return gen()
+
+
+class RedissonTPUReactive:
+    """The RedissonReactiveClient analogue: same getters, async objects.
+
+    Construct via `create_reactive(config)` or wrap an existing sync client:
+    `RedissonTPUReactive(client)`. The sync client remains fully usable; the
+    reactive facade shares its executor, store and pub/sub (mirroring how
+    the reference's reactive wrappers delegate to the same command services).
+    """
+
+    def __init__(self, client: RedissonTPU):
+        self._client = client
+
+    # -- sketch tier --------------------------------------------------------
+
+    def get_hyper_log_log(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_hyper_log_log(name, codec))
+
+    def get_bit_set(self, name: str) -> AsyncProxy:
+        return AsyncProxy(self._client.get_bit_set(name))
+
+    def get_bloom_filter(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_bloom_filter(name, codec))
+
+    def create_batch(self) -> AsyncProxy:
+        return AsyncProxy(self._client.create_batch())
+
+    # -- structures ---------------------------------------------------------
+
+    def get_bucket(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_bucket(name, codec))
+
+    def get_buckets(self, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_buckets(codec))
+
+    def get_atomic_long(self, name: str) -> AsyncProxy:
+        return AsyncProxy(self._client.get_atomic_long(name))
+
+    def get_atomic_double(self, name: str) -> AsyncProxy:
+        return AsyncProxy(self._client.get_atomic_double(name))
+
+    def get_map(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_map(name, codec))
+
+    def get_map_cache(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_map_cache(name, codec))
+
+    def get_set(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_set(name, codec))
+
+    def get_set_cache(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_set_cache(name, codec))
+
+    def get_list(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_list(name, codec))
+
+    def get_queue(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_queue(name, codec))
+
+    def get_deque(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_deque(name, codec))
+
+    def get_blocking_queue(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_blocking_queue(name, codec))
+
+    def get_blocking_deque(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_blocking_deque(name, codec))
+
+    def get_sorted_set(self, name: str, codec=None, key=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_sorted_set(name, codec, key))
+
+    def get_scored_sorted_set(self, name: str, codec=None) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_scored_sorted_set(name, codec))
+
+    def get_lex_sorted_set(self, name: str) -> AsyncIterableProxy:
+        return AsyncIterableProxy(self._client.get_lex_sorted_set(name))
+
+    def get_set_multimap(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_set_multimap(name, codec))
+
+    def get_list_multimap(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_list_multimap(name, codec))
+
+    def get_geo(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_geo(name, codec))
+
+    def get_topic(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_topic(name, codec))
+
+    def get_pattern_topic(self, pattern: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_pattern_topic(pattern, codec))
+
+    # -- coordination -------------------------------------------------------
+
+    def get_lock(self, name: str) -> AsyncLock:
+        return AsyncLock(self._client.get_lock(name))
+
+    def get_fair_lock(self, name: str) -> AsyncLock:
+        return AsyncLock(self._client.get_fair_lock(name))
+
+    def get_read_write_lock(self, name: str) -> AsyncProxy:
+        rw = self._client.get_read_write_lock(name)
+        return AsyncProxy(rw)
+
+    def get_semaphore(self, name: str) -> AsyncProxy:
+        return AsyncProxy(self._client.get_semaphore(name))
+
+    def get_count_down_latch(self, name: str) -> AsyncProxy:
+        return AsyncProxy(self._client.get_count_down_latch(name))
+
+    # -- keys / lifecycle ---------------------------------------------------
+
+    def get_keys(self) -> AsyncProxy:
+        return AsyncProxy(self._client.get_keys())
+
+    async def keys(self, pattern: str = "*"):
+        return await asyncio.to_thread(self._client.keys, pattern)
+
+    async def flushall(self):
+        await asyncio.to_thread(self._client.flushall)
+
+    async def delete(self, name: str) -> bool:
+        return await asyncio.to_thread(self._client.delete, name)
+
+    @property
+    def sync(self) -> RedissonTPU:
+        return self._client
+
+    async def shutdown(self):
+        await asyncio.to_thread(self._client.shutdown)
+
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.shutdown()
+
+
+def create_reactive(config: Optional[Config] = None) -> RedissonTPUReactive:
+    """Build a reactive client (creates the underlying sync client)."""
+    return RedissonTPUReactive(RedissonTPU.create(config))

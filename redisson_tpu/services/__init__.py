@@ -1,0 +1,13 @@
+"""Higher services tier (the reference's L5b): RPC + cache manager."""
+
+from redisson_tpu.services.remote import (RemoteInvocationOptions,
+                                          RemoteServiceAckTimeoutError,
+                                          RemoteServiceTimeoutError,
+                                          RRemoteService)
+from redisson_tpu.services.cache_manager import CacheConfig, CacheManager
+
+__all__ = [
+    "RRemoteService", "RemoteInvocationOptions",
+    "RemoteServiceTimeoutError", "RemoteServiceAckTimeoutError",
+    "CacheConfig", "CacheManager",
+]

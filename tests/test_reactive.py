@@ -1,0 +1,148 @@
+"""Reactive (asyncio) API tests — the async mirror of the object surface.
+Mirrors the reference's Base*ReactiveTest suites (SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from redisson_tpu.reactive import (AsyncProxy, RedissonTPUReactive,
+                                   create_reactive)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture()
+def rx():
+    client = create_reactive()
+    yield client
+    client.sync.shutdown()
+
+
+def test_hll_async(rx):
+    async def go():
+        h = rx.get_hyper_log_log("rx:hll")
+        assert isinstance(h, AsyncProxy)
+        await h.add_all([b"k%d" % i for i in range(5000)])
+        est = await h.count()
+        assert abs(est - 5000) / 5000 < 0.05
+        await h.add(b"one-more")
+        h2 = rx.get_hyper_log_log("rx:hll2")
+        await h2.add_all([b"x%d" % i for i in range(100)])
+        union = await h.count_with("rx:hll2")
+        assert union >= est
+    run(go())
+
+
+def test_bitset_bloom_async(rx):
+    async def go():
+        bs = rx.get_bit_set("rx:bits")
+        await bs.set(5)
+        assert await bs.get(5)
+        assert not await bs.get(6)
+        assert await bs.cardinality() == 1
+
+        bf = rx.get_bloom_filter("rx:bloom")
+        await bf.try_init(expected_insertions=1000, false_probability=0.01)
+        await bf.add(b"hello")
+        assert await bf.contains(b"hello")
+    run(go())
+
+
+def test_map_and_iteration(rx):
+    async def go():
+        m = rx.get_map("rx:map")
+        await m.put("a", 1)
+        await m.put("b", 2)
+        assert await m.get("a") == 1
+        assert await m.size() == 2
+        keys = set()
+        async for k in m:
+            keys.add(k)
+        assert keys == {"a", "b"}
+    run(go())
+
+
+def test_concurrent_ops_interleave(rx):
+    async def go():
+        # Many concurrent coroutines against one object: all complete,
+        # totals add up (per-object FIFO order preserved by the executor).
+        counter = rx.get_atomic_long("rx:ctr")
+        await asyncio.gather(*(counter.increment_and_get() for _ in range(50)))
+        assert await counter.get() == 50
+    run(go())
+
+
+def test_async_lock_context_manager(rx):
+    async def go():
+        lock = rx.get_lock("rx:lock")
+        async with lock:
+            assert await lock.is_locked()
+        assert not await lock.is_locked()
+    run(go())
+
+
+def test_blocking_queue_producer_consumer(rx):
+    async def go():
+        q = rx.get_blocking_queue("rx:bq")
+
+        async def producer():
+            await asyncio.sleep(0.05)
+            await q.offer("payload")
+
+        async def consumer():
+            return await q.take()  # runs off-loop; must not block the loop
+
+        got, _ = await asyncio.gather(consumer(), producer())
+        assert got == "payload"
+    run(go())
+
+
+def test_topic_pubsub_async(rx):
+    async def go():
+        topic = rx.get_topic("rx:topic")
+        seen = []
+        await topic.add_listener(lambda ch, msg: seen.append(msg))
+        receivers = await topic.publish("hello")
+        assert receivers == 1
+        deadline = asyncio.get_event_loop().time() + 2
+        while not seen and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.01)
+        assert seen == ["hello"]
+    run(go())
+
+
+def test_facade_keys_flushall(rx):
+    async def go():
+        await rx.get_bucket("rx:b1").set(1)
+        await rx.get_bucket("rx:b2").set(2)
+        ks = await rx.keys("rx:b*")
+        assert set(ks) == {"rx:b1", "rx:b2"}
+        assert await rx.delete("rx:b1")
+        await rx.flushall()
+        assert await rx.keys() == []
+    run(go())
+
+
+def test_sync_escape_hatch(rx):
+    h = rx.get_hyper_log_log("rx:sync")
+    h.sync.add(b"v")  # the underlying sync object stays usable
+    assert h.sync.count() == 1
+
+
+def test_batch_async(rx):
+    async def go():
+        b = rx.create_batch()
+        sb = b.sync
+        # Staging is async-only (like the reference's RBatch *Async clones).
+        sb.get_hyper_log_log("rx:bt").add_all_async([b"a", b"b", b"c"])
+        sb.get_bit_set("rx:bb").set_bits_async([7])
+        results = await b.execute()
+        assert len(results) == 2
+        assert await rx.get_bit_set("rx:bb").get(7)
+    run(go())

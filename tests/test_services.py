@@ -1,0 +1,196 @@
+"""Service-tier tests: RPC remote service + cache manager.
+Models the reference's RedissonRemoteServiceTest / spring cache tests."""
+
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.services import (CacheConfig, CacheManager,
+                                   RemoteInvocationOptions,
+                                   RemoteServiceAckTimeoutError,
+                                   RemoteServiceTimeoutError, RRemoteService)
+from redisson_tpu.services.remote import RemoteServiceError
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTPU.create()
+    yield c
+    c.shutdown()
+
+
+class Calculator:
+    def add(self, a, b):
+        return a + b
+
+    def fail(self):
+        raise ValueError("boom")
+
+    def slow(self):
+        time.sleep(3)
+        return "late"
+
+    def echo_kwargs(self, **kw):
+        return dict(sorted(kw.items()))
+
+
+def test_rpc_roundtrip(client):
+    rs = client.get_remote_service()
+    rs.register("Calculator", Calculator(), workers=2)
+    try:
+        calc = rs.get("Calculator")
+        assert calc.add(2, 3) == 5
+        assert calc.echo_kwargs(b=2, a=1) == {"a": 1, "b": 2}
+    finally:
+        rs.shutdown()
+
+
+def test_rpc_remote_exception_propagates(client):
+    rs = client.get_remote_service()
+    rs.register("Calculator", Calculator())
+    try:
+        calc = rs.get("Calculator")
+        with pytest.raises(RemoteServiceError, match="ValueError: boom"):
+            calc.fail()
+    finally:
+        rs.shutdown()
+
+
+def test_rpc_ack_timeout_when_no_worker(client):
+    rs = client.get_remote_service()
+    # nothing registered: ack must time out quickly
+    calc = rs.get("Calculator",
+                  RemoteInvocationOptions(ack_timeout_s=0.2,
+                                          execution_timeout_s=1.0))
+    with pytest.raises(RemoteServiceAckTimeoutError):
+        calc.add(1, 2)
+    rs.shutdown()
+
+
+def test_rpc_execution_timeout(client):
+    rs = client.get_remote_service()
+    rs.register("Calculator", Calculator())
+    try:
+        calc = rs.get("Calculator",
+                      RemoteInvocationOptions(ack_timeout_s=1.0,
+                                              execution_timeout_s=0.3))
+        with pytest.raises(RemoteServiceTimeoutError):
+            calc.slow()
+    finally:
+        rs.shutdown()
+
+
+def test_rpc_fire_and_forget(client):
+    hits = []
+
+    class Sink:
+        def record(self, x):
+            hits.append(x)
+
+    rs = client.get_remote_service()
+    rs.register("Sink", Sink())
+    try:
+        sink = rs.get("Sink", RemoteInvocationOptions().no_result())
+        assert sink.record("a") is None  # returns immediately
+        deadline = time.time() + 2
+        while not hits and time.time() < deadline:
+            time.sleep(0.01)
+        assert hits == ["a"]
+    finally:
+        rs.shutdown()
+
+
+def test_rpc_async_proxy(client):
+    rs = client.get_remote_service()
+    rs.register("Calculator", Calculator(), workers=2)
+    try:
+        calc = rs.get_async("Calculator")
+        futs = [calc.add(i, i) for i in range(10)]
+        assert [f.result(timeout=5) for f in futs] == [2 * i for i in range(10)]
+    finally:
+        rs.shutdown()
+
+
+def test_rpc_separate_service_instances_share_structures(client):
+    # A second RRemoteService instance over the same engine (the reference's
+    # in-JVM server+client pair) reaches the same queues. The facade getter
+    # itself caches per name.
+    assert client.get_remote_service() is client.get_remote_service()
+    rs_server = client.get_remote_service()
+    rs_server.register("Calculator", Calculator())
+    rs_client = RRemoteService(client)  # independent instance, same queues
+    try:
+        assert rs_client.get("Calculator").add(10, 5) == 15
+    finally:
+        rs_server.shutdown()
+        rs_client.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cache manager
+# ---------------------------------------------------------------------------
+
+
+def test_cache_basic(client):
+    cm = client.get_cache_manager({"users": {"ttl_s": None}})
+    cache = cm.get_cache("users")
+    cache.put("u1", {"name": "ada"})
+    assert cache.get("u1") == {"name": "ada"}
+    assert cache.get("nope", "dflt") == "dflt"
+    cache.evict("u1")
+    assert cache.get("u1") is None
+
+
+def test_cache_ttl_expiry(client):
+    cm = CacheManager(client, {"short": {"ttl_s": 0.2}})
+    cache = cm.get_cache("short")
+    cache.put("k", "v")
+    assert cache.get("k") == "v"
+    time.sleep(0.4)
+    assert cache.get("k") is None
+
+
+def test_cache_put_if_absent_and_clear(client):
+    cache = client.get_cache_manager().get_cache("pia")
+    assert cache.put_if_absent("k", 1) is None
+    assert cache.put_if_absent("k", 2) == 1
+    assert cache.size() == 1
+    cache.clear()
+    assert cache.size() == 0
+
+
+def test_cached_decorator(client):
+    cm = client.get_cache_manager()
+    calls = []
+
+    @cm.cached("memo")
+    def expensive(x):
+        calls.append(x)
+        return x * 10
+
+    assert expensive(3) == 30
+    assert expensive(3) == 30
+    assert calls == [3]  # second call served from cache
+    assert expensive(4) == 40
+    assert calls == [3, 4]
+
+
+def test_cache_manager_from_json(client):
+    cm = CacheManager.from_json(client, '{"a": {"ttl_s": 5}, "b": {}}')
+    assert cm.cache_names() == ["a", "b"]
+    assert cm.get_cache("a")._config.ttl_s == 5
+
+
+def test_cached_decorator_caches_none(client):
+    cm = client.get_cache_manager()
+    calls = []
+
+    @cm.cached("memo_none")
+    def maybe(x):
+        calls.append(x)
+        return None
+
+    assert maybe(1) is None
+    assert maybe(1) is None
+    assert calls == [1]  # None results are cached, not recomputed
