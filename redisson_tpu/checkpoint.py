@@ -26,10 +26,23 @@ import numpy as np
 
 from redisson_tpu.store import SketchStore
 
+import threading
+from collections import defaultdict
+
 MANIFEST = "manifest.json"
 STATE = "state.npz"
 FORMAT_VERSION = 1
 _KEY_PREFIX = "obj:"
+
+# In-process serialization of the swap per target path; cross-process
+# concurrent saves to one path are NOT supported (callers coordinate).
+_path_locks: dict = defaultdict(threading.Lock)
+_path_locks_guard = threading.Lock()
+
+
+def _swap_lock(path: str) -> threading.Lock:
+    with _path_locks_guard:
+        return _path_locks[os.path.abspath(path)]
 
 
 def save(store: SketchStore, path: str,
@@ -68,14 +81,16 @@ def save(store: SketchStore, path: str,
                             **{_KEY_PREFIX + k: v for k, v in arrays.items()})
         # Exchange-style swap: the previous good checkpoint survives (as
         # `.old`) through every crash point; load() falls back to it.
-        old = path + ".old"
-        if os.path.exists(old):
-            shutil.rmtree(old)
-        if os.path.exists(path):
-            os.replace(path, old)
-        os.replace(tmp, path)
-        if os.path.exists(old):
-            shutil.rmtree(old)
+        # In-process concurrent saves serialize here.
+        with _swap_lock(path):
+            old = path + ".old"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            if os.path.exists(path):
+                os.replace(path, old)
+            os.replace(tmp, path)
+            if os.path.exists(old):
+                shutil.rmtree(old)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise

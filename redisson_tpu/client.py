@@ -80,9 +80,14 @@ class RedissonTPU:
         self._routing = RoutingBackend(sketch)
         self._backend = self._routing
         self._widths = tuple(tcfg.key_width_buckets)
+        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+
+        self.metrics = MetricsRegistry()
         self._executor = CommandExecutor(
-            self._routing, max_batch_keys=tcfg.max_batch_keys
+            self._routing, max_batch_keys=tcfg.max_batch_keys,
+            metrics=ExecutorMetrics(self.metrics),
         )
+        self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
         self._pubsub = self._routing.pubsub
         self._watchdog = LockWatchdog(self._executor)
         self._eviction = EvictionScheduler(self._executor)
@@ -259,6 +264,26 @@ class RedissonTPU:
 
     def get_count_down_latch(self, name: str) -> RCountDownLatch:
         return RCountDownLatch(name, self._executor, self._pubsub)
+
+    # -- observability ------------------------------------------------------
+
+    def get_nodes_group(self):
+        """Health/ping surface over compute devices + the redis tier
+        (reference NodesGroup.pingAll, RedisNodes.java)."""
+        from redisson_tpu.observability import NodesGroup
+
+        return NodesGroup(self)
+
+    def get_topology_manager(self, scan_interval_s: float = 1.0,
+                             failed_attempts: int = 3):
+        """Failure-detection poller pre-registered with this client's nodes
+        (sentinel/cluster monitor analogue). Caller starts/stops it."""
+        from redisson_tpu.parallel.topology import TopologyManager
+
+        tm = TopologyManager(scan_interval_s, failed_attempts)
+        for node in self.get_nodes_group().nodes():
+            tm.add_node(node.ident, node.ping)
+        return tm
 
     # -- services (L5b) -----------------------------------------------------
 

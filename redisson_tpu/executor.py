@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
@@ -60,9 +61,10 @@ class CommandExecutor:
     same-kind ops; others receive singletons.
     """
 
-    def __init__(self, backend, max_batch_keys: int = 1 << 21):
+    def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None):
         self._backend = backend
         self._max_batch_keys = max_batch_keys
+        self._metrics = metrics  # ExecutorMetrics or None (zero-cost when off)
         # Kinds the backend coalesces across *different* targets (e.g. the
         # pod backend's bank insert, where the device call carries a per-key
         # target row). Per-target FIFO is preserved: only queue heads join.
@@ -95,6 +97,11 @@ class CommandExecutor:
 
     def execute_sync(self, target: str, kind: str, payload: Any, nkeys: int = 0):
         return self.execute_async(target, kind, payload, nkeys).result()
+
+    def queue_depth(self) -> int:
+        """Total ops waiting across all object queues (locked snapshot)."""
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
 
     # -- dispatcher ---------------------------------------------------------
 
@@ -140,9 +147,17 @@ class CommandExecutor:
                     self._ready.append(target)
                 else:
                     del self._queues[target]
+            m = self._metrics
+            t0 = time.monotonic() if m else 0.0
             try:
                 self._backend.run(kind, target, run)
+                if m:
+                    m.record_batch(kind, len(run),
+                                   sum(op.nkeys for op in run),
+                                   time.monotonic() - t0)
             except Exception as exc:  # complete, never kill the loop
+                if m:
+                    m.record_error(kind)
                 for op in run:
                     if not op.future.done():
                         op.future.set_exception(exc)

@@ -106,11 +106,20 @@ class RMapCache(RExpirable):
         """One eviction sweep (what the scheduler runs)."""
         return self._executor.execute_sync(self.name, "mc_evict_expired", {"limit": limit})
 
+    def clear(self) -> bool:
+        """java.util.Map.clear — drop every entry (and its TTL metadata).
+        Keeps the eviction schedule: the cache object stays live, unlike
+        delete()."""
+        return super().delete()
+
     def __len__(self) -> int:
         return self.size()
 
     def __contains__(self, key: Any) -> bool:
         return self.contains_key(key)
+
+    def __iter__(self):
+        return iter(self.read_all_map().keys())
 
 
 class RSetCache(RExpirable):
@@ -151,8 +160,15 @@ class RSetCache(RExpirable):
     def evict_expired(self, limit: int = 300) -> int:
         return self._executor.execute_sync(self.name, "mc_evict_expired", {"limit": limit})
 
+    def clear(self) -> bool:
+        """Drop every member, keeping the eviction schedule live."""
+        return super().delete()
+
     def __len__(self) -> int:
         return self.size()
 
     def __contains__(self, value: Any) -> bool:
         return self.contains(value)
+
+    def __iter__(self):
+        return iter(self.read_all())

@@ -1,0 +1,270 @@
+"""Observability: metrics registry, executor instrumentation, profiler
+hooks, and the nodes/health API.
+
+The reference has NO metrics or tracing (SURVEY.md §5 — slf4j logs only);
+its only health surface is `NodesGroup.pingAll`/`Node.ping`
+(RedisNodes.java, core/Node.java) and the connect/disconnect callbacks of
+`ConnectionEventsHub`. For a framework that owns device state, first-class
+metrics and an XLA profiler hook are required new design, not a port:
+
+  * MetricsRegistry — thread-safe counters / gauges / histograms with a
+    prometheus-text renderer and a dict snapshot;
+  * executor instrumentation — per-kind op counts, coalesced batch-size and
+    dispatch-latency histograms, live queue depth (wired by the executor
+    when a registry is attached);
+  * profile() — context manager around jax.profiler.trace for capturing
+    device traces of a workload section;
+  * NodesGroup — ping of every compute node (device micro-kernel
+    round-trip) and the redis durability tier (RESP PING), plus
+    connect/disconnect listener fan-out (the ConnectionEventsHub role).
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+_DEFAULT_BUCKETS = (
+    1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, float("inf"))
+
+
+class Histogram:
+    __slots__ = ("buckets", "counts", "total", "sum", "min", "max", "_lock")
+
+    def __init__(self, buckets=_DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * len(self.buckets)
+        self.total = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            i = bisect.bisect_left(self.buckets, value)
+            self.counts[min(i, len(self.counts) - 1)] += 1
+            self.total += 1
+            self.sum += value
+            self.min = min(self.min, value)
+            self.max = max(self.max, value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": self.total,
+                "sum": self.sum,
+                "min": self.min if self.total else None,
+                "max": self.max if self.total else None,
+                "mean": (self.sum / self.total) if self.total else None,
+                "buckets": dict(zip(map(str, self.buckets), self.counts)),
+            }
+
+
+class MetricsRegistry:
+    """Names are dotted strings; labels are a frozen kwargs suffix."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram()
+            return h
+
+    def observe(self, name: str, value: float) -> None:
+        self.histogram(name).observe(value)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = {k: fn for k, fn in self._gauges.items()}
+            hists = dict(self._histograms)
+        out: Dict[str, Any] = {"counters": counters, "gauges": {}, "histograms": {}}
+        for k, fn in gauges.items():
+            try:
+                out["gauges"][k] = fn()
+            except Exception:
+                out["gauges"][k] = None
+        for k, h in hists.items():
+            out["histograms"][k] = h.snapshot()
+        return out
+
+    def render_prometheus(self) -> str:
+        """Text exposition format (counters + gauges + histogram buckets)."""
+        snap = self.snapshot()
+        lines: List[str] = []
+
+        def sanitize(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        for k, v in sorted(snap["counters"].items()):
+            lines.append(f"# TYPE {sanitize(k)} counter")
+            lines.append(f"{sanitize(k)} {v}")
+        for k, v in sorted(snap["gauges"].items()):
+            lines.append(f"# TYPE {sanitize(k)} gauge")
+            lines.append(f"{sanitize(k)} {v if v is not None else 'NaN'}")
+        for k, h in sorted(snap["histograms"].items()):
+            base = sanitize(k)
+            lines.append(f"# TYPE {base} histogram")
+            cumulative = 0
+            for le, c in h["buckets"].items():
+                cumulative += c
+                lines.append(f'{base}_bucket{{le="{le}"}} {cumulative}')
+            lines.append(f"{base}_sum {h['sum']}")
+            lines.append(f"{base}_count {h['count']}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Profiler hook
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def profile(logdir: str):
+    """Capture an XLA device trace of the enclosed block (view with
+    tensorboard / xprof). No-op if the profiler is unavailable."""
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        pass
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Nodes / health (NodesGroup + ConnectionEventsHub analogue)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Node:
+    """One compute or durability node."""
+
+    kind: str  # "device" | "redis"
+    ident: str
+    _pinger: Callable[[], bool] = field(repr=False, default=None)
+
+    def ping(self) -> bool:
+        try:
+            return bool(self._pinger())
+        except Exception:
+            return False
+
+
+class NodesGroup:
+    """client.get_nodes_group(): enumerate + ping nodes, listen to
+    connect/disconnect events from the durability tier."""
+
+    def __init__(self, client):
+        self._client = client
+        self._listeners: List[Callable[[str, str], None]] = []
+
+    def nodes(self) -> List[Node]:
+        import jax
+
+        out: List[Node] = []
+        for d in jax.devices():
+            out.append(Node("device", str(d), _device_pinger(d)))
+        if getattr(self._client, "_resp", None) is not None:
+            resp = self._client._resp
+
+            def ping_redis() -> bool:
+                return resp.execute("PING") in (b"PONG", b"pong")
+
+            out.append(Node("redis",
+                            f"{resp._client.host}:{resp._client.port}",
+                            ping_redis))
+        return out
+
+    def ping_all(self) -> bool:
+        return all(n.ping() for n in self.nodes())
+
+    def add_connection_listener(self, fn: Callable[[str, str], None]) -> None:
+        """fn(event, ident) with event in {'connect', 'disconnect'}."""
+        self._listeners.append(fn)
+
+    def fire(self, event: str, ident: str) -> None:
+        for fn in list(self._listeners):
+            try:
+                fn(event, ident)
+            except Exception:
+                pass
+
+
+def _device_pinger(device) -> Callable[[], bool]:
+    def ping() -> bool:
+        import jax.numpy as jnp
+
+        x = jnp.zeros((8,), jnp.int32)
+        import jax
+
+        y = jax.device_put(x, device) + 1
+        return int(y.sum()) == 8
+
+    return ping
+
+
+# ---------------------------------------------------------------------------
+# Executor instrumentation helper
+# ---------------------------------------------------------------------------
+
+
+class ExecutorMetrics:
+    """Attached to a CommandExecutor: the dispatcher reports op/batch/latency
+    stats here. Cheap enough for the hot path (a few dict ops per BATCH,
+    not per key)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+
+    def record_batch(self, kind: str, nops: int, nkeys: int,
+                     latency_s: float) -> None:
+        r = self.registry
+        r.inc(f"executor.ops.{kind}", nops)
+        r.inc("executor.ops_total", nops)
+        r.inc("executor.keys_total", nkeys)
+        r.inc("executor.batches_total")
+        r.observe("executor.batch_ops", nops)
+        r.observe("executor.batch_keys", nkeys)
+        r.observe(f"executor.latency_s.{kind}", latency_s)
+
+    def record_error(self, kind: str) -> None:
+        self.registry.inc(f"executor.errors.{kind}")
+        self.registry.inc("executor.errors_total")

@@ -53,16 +53,41 @@ class PodBackend:
         if row is None:
             if self._free_rows:
                 row = self._free_rows.pop()
-            elif self._next_row < self.bank_capacity:
+            else:
+                if self._next_row >= self.bank_capacity:
+                    # Elastic repartitioning (the live-slot-migration
+                    # analogue, ClusterConnectionManager.java:457-541):
+                    # double the bank in place instead of failing.
+                    self._grow_bank(self.bank_capacity * 2)
                 row = self._next_row
                 self._next_row += 1
-            else:
-                raise RuntimeError(
-                    f"sketch bank full ({self.bank_capacity} rows); raise "
-                    "PodConfig.bank_capacity"
-                )
             self._rows[name] = row
         return row
+
+    def _grow_bank(self, new_capacity: int) -> None:
+        """Re-lay the bank onto a larger [S', m] allocation, keeping shard
+        layout; old rows keep their indices (no routing churn)."""
+        ndev = self.mesh.devices.size
+        if new_capacity % ndev:
+            new_capacity += ndev - new_capacity % ndev
+        self.bank = sharded.grow_bank(self.bank, new_capacity, self.mesh)
+        self.bank_capacity = new_capacity
+
+    def reshard(self, num_shards: int) -> None:
+        """Migrate the bank onto a mesh of `num_shards` devices — the
+        topology-change path (master failover / shard add+remove in the
+        reference becomes a re-device_put under a new sharding here)."""
+        new_mesh = build_mesh(num_shards)
+        cap = self.bank_capacity
+        ndev = new_mesh.devices.size
+        if cap % ndev:
+            cap += ndev - cap % ndev
+        bank = self.bank
+        if cap != self.bank_capacity:
+            bank = sharded.grow_bank(bank, cap, self.mesh)
+        self.bank = sharded.migrate_bank(bank, new_mesh)
+        self.mesh = new_mesh
+        self.bank_capacity = cap
 
     def run(self, kind: str, target: str, ops: List[Op]) -> None:
         handler = getattr(self, "_op_" + kind, None)

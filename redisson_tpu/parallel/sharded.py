@@ -132,6 +132,31 @@ def zero_row(bank, row: int) -> jax.Array:
     return bank.at[row].set(0)
 
 
+def grow_bank(bank, new_capacity: int, mesh: Mesh) -> jax.Array:
+    """Enlarge [S, m] -> [S', m] keeping row indices and shard layout —
+    elastic capacity (the slot-add analogue). Row data round-trips through
+    the sharding machinery, not the host."""
+    s, m = bank.shape
+    if new_capacity < s:
+        raise ValueError(f"cannot shrink {s} -> {new_capacity}")
+    if new_capacity == s:
+        return bank
+    pad = jnp.zeros((new_capacity - s, m), bank.dtype)
+    return jax.device_put(
+        jnp.concatenate([bank, pad], axis=0), bank_sharding(mesh))
+
+
+def migrate_bank(bank, new_mesh: Mesh) -> jax.Array:
+    """Re-shard the bank onto a different mesh (topology change): the
+    reference's live slot migration becomes one resharding device_put
+    (XLA emits the all-to-all over ICI)."""
+    if bank.shape[0] % new_mesh.devices.size:
+        raise ValueError(
+            f"bank rows {bank.shape[0]} not divisible by "
+            f"{new_mesh.devices.size} devices")
+    return jax.device_put(bank, bank_sharding(new_mesh))
+
+
 def full_step(bank, hi, lo, row, valid, mesh: Mesh, seed: int = 0):
     """One complete 'training step': sharded insert + global merge-count.
 

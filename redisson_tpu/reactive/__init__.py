@@ -79,24 +79,69 @@ class AsyncProxy:
 
 
 class AsyncLock(AsyncProxy):
-    """Adds `async with` acquire/release on top of the proxy."""
+    """Adds `async with` acquire/release on top of the proxy.
+
+    Lock ownership is `client_id:thread_id` (models/lock.py — the
+    reference's uuid:threadId), so every operation of one AsyncLock must
+    run on the SAME thread: a shared to_thread pool would acquire on one
+    worker and try to release on another. Each AsyncLock therefore owns a
+    single-thread executor (the analogue of the reference passing an
+    explicit threadId through lockAsync/unlockAsync)."""
+
+    __slots__ = ("_pinned",)
+
+    def __init__(self, sync_obj):
+        super().__init__(sync_obj)
+        from concurrent.futures import ThreadPoolExecutor
+
+        object.__setattr__(self, "_pinned", ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rtpu-async-lock"))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self._sync, name)
+        if callable(attr):
+            pinned = self._pinned
+
+            @functools.wraps(attr)
+            async def via_pinned(*args, **kwargs):
+                loop = asyncio.get_event_loop()
+                return await loop.run_in_executor(
+                    pinned, functools.partial(attr, *args, **kwargs))
+
+            return via_pinned
+        return attr
 
     async def __aenter__(self):
-        await asyncio.to_thread(self._sync.lock)
+        await self.lock()
         return self
 
     async def __aexit__(self, *exc):
-        await asyncio.to_thread(self._sync.unlock)
+        await self.unlock()
+
+    def close(self) -> None:
+        """Release the pinned executor thread."""
+        self._pinned.shutdown(wait=False)
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self._pinned.shutdown(wait=False)
+        except Exception:
+            pass
 
 
 class AsyncIterableProxy(AsyncProxy):
-    """Adds `async for` over the sync object's iterator (driven off-loop)."""
+    """Adds `async for` over the sync object's iterator (driven off-loop,
+    including iterator construction — iter() itself does an executor
+    round-trip for most objects)."""
 
     def __aiter__(self) -> AsyncIterator:
-        it = iter(self._sync)
+        sync = self._sync
         sentinel = object()
 
         async def gen():
+            it = await asyncio.to_thread(iter, sync)
             while True:
                 item = await asyncio.to_thread(next, it, sentinel)
                 if item is sentinel:
@@ -117,6 +162,9 @@ class RedissonTPUReactive:
 
     def __init__(self, client: RedissonTPU):
         self._client = client
+        # AsyncLocks own a pinned executor thread; cache per (kind, name)
+        # so repeated getters reuse one thread, reclaimed at shutdown.
+        self._locks: dict = {}
 
     # -- sketch tier --------------------------------------------------------
 
@@ -200,10 +248,16 @@ class RedissonTPUReactive:
     # -- coordination -------------------------------------------------------
 
     def get_lock(self, name: str) -> AsyncLock:
-        return AsyncLock(self._client.get_lock(name))
+        key = ("lock", name)
+        if key not in self._locks:
+            self._locks[key] = AsyncLock(self._client.get_lock(name))
+        return self._locks[key]
 
     def get_fair_lock(self, name: str) -> AsyncLock:
-        return AsyncLock(self._client.get_fair_lock(name))
+        key = ("fair", name)
+        if key not in self._locks:
+            self._locks[key] = AsyncLock(self._client.get_fair_lock(name))
+        return self._locks[key]
 
     def get_read_write_lock(self, name: str) -> AsyncProxy:
         rw = self._client.get_read_write_lock(name)
@@ -234,6 +288,9 @@ class RedissonTPUReactive:
         return self._client
 
     async def shutdown(self):
+        for lock in self._locks.values():
+            lock.close()
+        self._locks.clear()
         await asyncio.to_thread(self._client.shutdown)
 
     async def __aenter__(self):

@@ -2,12 +2,13 @@
 
 from redisson_tpu.services.remote import (RemoteInvocationOptions,
                                           RemoteServiceAckTimeoutError,
+                                          RemoteServiceError,
                                           RemoteServiceTimeoutError,
                                           RRemoteService)
 from redisson_tpu.services.cache_manager import CacheConfig, CacheManager
 
 __all__ = [
-    "RRemoteService", "RemoteInvocationOptions",
+    "RRemoteService", "RemoteInvocationOptions", "RemoteServiceError",
     "RemoteServiceTimeoutError", "RemoteServiceAckTimeoutError",
     "CacheConfig", "CacheManager",
 ]

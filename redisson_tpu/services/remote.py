@@ -147,8 +147,12 @@ class RRemoteService:
                     "error": f"{type(e).__name__}: {e}",
                     "traceback": traceback.format_exc()}
         if req.get("want_result", True):
-            self._client.get_blocking_queue(
-                f"{self._name}:resp:{rid}").offer(resp)
+            rq = self._client.get_blocking_queue(f"{self._name}:resp:{rid}")
+            rq.offer(resp)
+            # TTL the response (reference RemoteInvocationOptions response
+            # timeToLive): a client that already gave up never drains it,
+            # so it must expire rather than leak.
+            rq.expire(60.0)
 
     # -- client side --------------------------------------------------------
 
@@ -185,12 +189,16 @@ class RRemoteService:
                 if time.monotonic() - t0 > deadline:
                     # Withdraw the request so a worker that appears later
                     # does not execute a call the caller saw fail (the
-                    # reference removes it the same way,
-                    # RedissonRemoteService.java ack-timeout Lua); if a
-                    # worker raced us and took it, its response/ack keys
-                    # are cleaned up too.
+                    # reference's ack-timeout Lua removal). If a worker
+                    # already dequeued it, win or lose the ack atomically:
+                    # our tombstone try_set vs the worker's ack try_set —
+                    # exactly one succeeds, so the worker either never
+                    # executes (we won) or executes with a TTL'd response
+                    # (it won; bounded leak, same as the reference).
                     req_queue.remove(req)
-                    self._cleanup(rid, want_ack)
+                    tombstoned = ack_bucket.try_set("cancelled", ttl_s=60.0)
+                    if not tombstoned:
+                        self._cleanup(rid, want_ack)
                     raise RemoteServiceAckTimeoutError(
                         f"no worker acked {iface}.{method} within {deadline}s")
                 time.sleep(0.005)

@@ -146,3 +146,40 @@ def test_batch_async(rx):
         assert len(results) == 2
         assert await rx.get_bit_set("rx:bb").get(7)
     run(go())
+
+
+def test_async_lock_thread_affinity(rx):
+    # Saturate the default to_thread pool with other work while locking:
+    # lock/unlock must still pair on one thread (pinned executor).
+    async def go():
+        async def churn(i):
+            b = rx.get_bucket(f"rx:churn{i}")
+            await b.set(i)
+            return await b.get()
+
+        lock = rx.get_lock("rx:aff")
+        for _ in range(5):
+            results, _ = await asyncio.gather(
+                asyncio.gather(*(churn(i) for i in range(16))),
+                lock.lock())
+            assert await lock.is_locked()
+            await lock.unlock()
+            assert not await lock.is_locked()
+    run(go())
+
+
+def test_map_cache_async_iteration(rx):
+    async def go():
+        mc = rx.get_map_cache("rx:mc")
+        await mc.put("x", 1)
+        await mc.put("y", 2)
+        seen = set()
+        async for k in mc:
+            seen.add(k)
+        assert seen == {"x", "y"}
+    run(go())
+
+
+def test_get_lock_reuses_instance(rx):
+    assert rx.get_lock("same") is rx.get_lock("same")
+    assert rx.get_lock("same") is not rx.get_fair_lock("same")

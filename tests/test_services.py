@@ -194,3 +194,15 @@ def test_cached_decorator_caches_none(client):
     assert maybe(1) is None
     assert maybe(1) is None
     assert calls == [1]  # None results are cached, not recomputed
+
+
+def test_cache_clear_with_ttl_policy(client):
+    cm = client.get_cache_manager({"t": {"ttl_s": 60}})
+    cache = cm.get_cache("t")
+    cache.put("a", 1)
+    cache.put("b", 2)
+    assert cache.size() == 2
+    cache.clear()  # RMapCache backing must support clear
+    assert cache.size() == 0
+    cache.put("c", 3)  # still usable (eviction schedule intact)
+    assert cache.get("c") == 3

@@ -1,0 +1,150 @@
+"""Failure detection + elastic recovery tests: topology manager, bank
+growth, resharding on the virtual 8-device CPU mesh."""
+
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.parallel.topology import TopologyManager
+
+
+class FlakyNode:
+    def __init__(self):
+        self.ok = True
+
+    def ping(self):
+        return self.ok
+
+
+def test_freeze_after_failed_attempts_and_unfreeze():
+    tm = TopologyManager(failed_attempts=3)
+    node = FlakyNode()
+    events = []
+    tm.add_node("n1", node.ping)
+    tm.add_listener(lambda e, i: events.append((e, i)))
+
+    node.ok = False
+    assert not tm.scan_once()  # 1st failure: still up
+    assert not tm.scan_once()  # 2nd
+    assert tm.is_up("n1")
+    assert tm.scan_once()      # 3rd: freeze
+    assert not tm.is_up("n1")
+    assert events == [("node_down", "n1")]
+
+    node.ok = True
+    assert tm.scan_once()      # one success unfreezes
+    assert tm.is_up("n1")
+    assert events == [("node_down", "n1"), ("node_up", "n1")]
+
+
+def test_transient_blip_does_not_freeze():
+    tm = TopologyManager(failed_attempts=3)
+    node = FlakyNode()
+    tm.add_node("n1", node.ping)
+    node.ok = False
+    tm.scan_once()
+    tm.scan_once()
+    node.ok = True
+    tm.scan_once()  # consecutive counter resets
+    node.ok = False
+    tm.scan_once()
+    tm.scan_once()
+    assert tm.is_up("n1")
+
+
+def test_on_change_recovery_hook():
+    tm = TopologyManager(failed_attempts=1)
+    a, b = FlakyNode(), FlakyNode()
+    tm.add_node("a", a.ping)
+    tm.add_node("b", b.ping)
+    seen = []
+    tm.on_change(lambda live: seen.append(sorted(live)))
+    b.ok = False
+    tm.scan_once()
+    assert seen == [["a"]]
+    b.ok = True
+    tm.scan_once()
+    assert seen == [["a"], ["a", "b"]]
+
+
+def test_background_scanner():
+    tm = TopologyManager(scan_interval_s=0.02, failed_attempts=1)
+    node = FlakyNode()
+    tm.add_node("n", node.ping)
+    tm.start()
+    try:
+        node.ok = False
+        deadline = time.time() + 3
+        while tm.is_up("n") and time.time() < deadline:
+            time.sleep(0.02)
+        assert not tm.is_up("n")
+        assert tm.scans >= 1
+    finally:
+        tm.shutdown()
+
+
+def test_exception_in_pinger_counts_as_failure():
+    tm = TopologyManager(failed_attempts=1)
+
+    def bad():
+        raise RuntimeError("dead")
+
+    tm.add_node("x", bad)
+    tm.scan_once()
+    assert not tm.is_up("x")
+
+
+# ---------------------------------------------------------------------------
+# Elastic bank: growth + resharding (8 virtual CPU devices via conftest)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pod_client():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    pod = cfg.use_pod()
+    pod.bank_capacity = 16  # tiny: force growth quickly
+    c = RedissonTPU.create(cfg)
+    yield c
+    c.shutdown()
+
+
+def test_bank_grows_instead_of_failing(pod_client):
+    backend = pod_client._backend.sketch
+    cap0 = backend.bank_capacity
+    # Allocate more sketches than the initial capacity.
+    for i in range(cap0 + 5):
+        pod_client.get_hyper_log_log(f"grow:{i}").add_all([b"k%d" % i])
+    assert backend.bank_capacity > cap0
+    # Pre-growth rows kept their data.
+    assert pod_client.get_hyper_log_log("grow:0").count() == 1
+
+
+def test_reshard_preserves_sketches(pod_client):
+    backend = pod_client._backend.sketch
+    h = pod_client.get_hyper_log_log("rs:h")
+    h.add_all([b"v%d" % i for i in range(10000)])
+    est = h.count()
+    ndev0 = backend.mesh.devices.size
+    assert ndev0 >= 2
+    backend.reshard(ndev0 // 2)  # "half the pod went away"
+    assert backend.mesh.devices.size == ndev0 // 2
+    assert pod_client.get_hyper_log_log("rs:h").count() == est
+    backend.reshard(ndev0)  # nodes came back
+    assert pod_client.get_hyper_log_log("rs:h").count() == est
+
+
+def test_client_topology_manager_facade():
+    from redisson_tpu.client import RedissonTPU
+
+    c = RedissonTPU.create()
+    try:
+        tm = c.get_topology_manager(scan_interval_s=0.1)
+        assert tm.live_nodes()  # devices pre-registered
+        assert not tm.scan_once()  # all healthy: no change
+    finally:
+        c.shutdown()
