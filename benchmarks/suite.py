@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""The 5 BASELINE configs as a runnable suite.
+
+Each config measures BOTH execution paths where meaningful:
+  * `engine`  — the TPU-native path (this framework's device kernels);
+  * `redis`   — the reference-modeled path (same object API over the
+    embedded RESP server, standing in for `embedded redis`: every op a
+    real wire round-trip, the reference's execution model).
+
+Usage:
+    python benchmarks/suite.py --config 1          # one config
+    python benchmarks/suite.py --all               # everything
+    python benchmarks/suite.py --all --publish     # + write BASELINE.json
+
+Scale knobs default to CI-sized runs; --full uses the BASELINE sizes
+(1B-key streaming etc. — hours on CPU, minutes on a real chip).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The axon sitecustomize overrides the JAX_PLATFORMS env var and makes the
+# first jax.devices() dial the TPU tunnel; honor an explicit cpu request
+# before any backend initializes (same guard as __graft_entry__.py).
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+_TINY = bool(os.environ.get("RTPU_BENCH_TINY"))
+
+
+def _scale(n: int) -> int:
+    """CI smoke scale: RTPU_BENCH_TINY=1 shrinks every size 100x."""
+    return max(1000, n // 100) if _TINY else n
+
+
+def _mkclient(mode: str):
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    if mode == "redis":
+        from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+        er = EmbeddedRedis()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        c = RedissonTPU.create(cfg)
+        c._embedded = er  # keep alive; closed with the client
+        return c
+    cfg.use_tpu()
+    return RedissonTPU.create(cfg)
+
+
+def _close(c):
+    c.shutdown()
+    er = getattr(c, "_embedded", None)
+    if er is not None:
+        er.stop()
+
+
+def config1(full: bool):
+    """Single-key PFADD/PFCOUNT — 1M string keys through the client facade."""
+    n = _scale(1_000_000 if full else 200_000)
+    keys = [b"user:%d" % i for i in range(n)]
+    out = {}
+    for mode in ("engine", "redis"):
+        c = _mkclient(mode)
+        try:
+            h = c.get_hyper_log_log("b1:hll")
+            t0 = time.perf_counter()
+            if mode == "engine":
+                h.add_all(keys)
+            else:
+                # the wire path pipelines adds in slabs, like RBatch would
+                step = 10_000
+                for i in range(0, n, step):
+                    h.add_all(keys[i:i + step])
+            est = h.count()
+            dt = time.perf_counter() - t0
+            err = abs(est - n) / n
+            out[mode] = {"keys_per_sec": n / dt, "seconds": dt, "error": err}
+            assert err < 0.02, f"error {err} out of envelope"
+        finally:
+            _close(c)
+    return {"config": 1, "n_keys": n, **out,
+            "speedup": out["engine"]["keys_per_sec"] / out["redis"]["keys_per_sec"]}
+
+
+def config2(full: bool):
+    """Bloom k=7 / m=2^28: 10M inserts + contains() + measured FPR."""
+    n = _scale(10_000_000 if full else 1_000_000)
+    m = 1 << 28
+    c = _mkclient("engine")
+    try:
+        bf = c.get_bloom_filter("b2:bloom")
+        # Reference sizing solves (n, p) -> (m, k); pick p to land on k=7/2^28.
+        bf.try_init(expected_insertions=m // 10, false_probability=0.01)
+        size = bf.get_size()
+        k = bf.get_hash_iterations()
+        rng = np.random.default_rng(7)
+        keys = rng.integers(0, 2**63, n, np.uint64)
+        key_bytes = [k_.tobytes() for k_ in keys]
+        t0 = time.perf_counter()
+        bf.add_all(key_bytes)
+        insert_dt = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        hits = bf.contains_all(key_bytes[: n // 10])
+        contains_dt = time.perf_counter() - t0
+        assert all(hits), "false negatives!"
+
+        fresh = [b"fresh|" + k_.tobytes() for k_ in keys[: n // 10]]
+        fpr = sum(bf.contains_all(fresh)) / len(fresh)
+        return {"config": 2, "n_keys": n, "m_bits": size, "k": k,
+                "insert_keys_per_sec": n / insert_dt,
+                "contains_keys_per_sec": (n // 10) / contains_dt,
+                "measured_fpr": fpr}
+    finally:
+        _close(c)
+
+
+def config3(full: bool):
+    """RBatch pipelined PFADD across 256 sketches + PFMERGE union."""
+    sketches = 256
+    per = _scale(40_000 if full else 4_000)
+    c = _mkclient("engine")
+    try:
+        rng = np.random.default_rng(3)
+        batch = c.create_batch()
+        t0 = time.perf_counter()
+        for s in range(sketches):
+            keys = rng.integers(0, 2**63, per, np.uint64)
+            batch.get_hyper_log_log(f"b3:s{s}").add_all_async(
+                [k.tobytes() for k in keys])
+        batch.execute()
+        add_dt = time.perf_counter() - t0
+
+        dest = c.get_hyper_log_log("b3:merged")
+        t0 = time.perf_counter()
+        dest.merge_with(*[f"b3:s{s}" for s in range(sketches)])
+        union = dest.count()
+        merge_dt = time.perf_counter() - t0
+        return {"config": 3, "sketches": sketches, "keys_per_sketch": per,
+                "batched_insert_keys_per_sec": sketches * per / add_dt,
+                "merge_count_ms": merge_dt * 1000, "union_estimate": union}
+    finally:
+        _close(c)
+
+
+def config4(full: bool):
+    """Streaming cardinality: Zipf keys over 4K sharded HLLs + periodic merge.
+
+    BASELINE size is 1B keys; default trims to 32M (same code path). Keys
+    stream through the pod bank (row = key % 4096) with a merge-count every
+    8 batches.
+    """
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    total = _scale(1_000_000_000 if full else 32_000_000)
+    batch_n = 1 << (14 if _TINY else 20)
+    n_sketches = 512 if _TINY else 4096
+
+    cfg = Config()
+    pod = cfg.use_pod()
+    pod.bank_capacity = n_sketches
+    c = RedissonTPU.create(cfg)
+    try:
+        backend = c._backend.sketch
+        from redisson_tpu.parallel import sharded
+
+        rng = np.random.default_rng(4)
+        seen_estimates = []
+        t0 = time.perf_counter()
+        nbatches = total // batch_n
+        distinct_space = total // 10
+        for b in range(nbatches):
+            # Zipf-ish skew: exponential of pareto draw bounded to the space
+            raw = rng.pareto(1.1, batch_n)
+            keys = (raw / raw.max() * distinct_space).astype(np.uint64)
+            hi = (keys >> np.uint64(32)).astype(np.uint32)
+            lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            rows = (keys % np.uint64(n_sketches)).astype(np.int32)
+            valid = np.ones(batch_n, bool)
+            backend.bank, _ = sharded.bank_insert(
+                backend.bank, hi, lo, rows, valid, backend.mesh, backend.seed)
+            if b % 8 == 7:
+                seen_estimates.append(
+                    float(sharded.bank_count_all(backend.bank, backend.mesh)))
+        backend.bank.block_until_ready()
+        dt = time.perf_counter() - t0
+        return {"config": 4, "total_keys": nbatches * batch_n,
+                "sharded_hlls": n_sketches,
+                "keys_per_sec": nbatches * batch_n / dt,
+                "final_estimate": seen_estimates[-1] if seen_estimates else None,
+                "periodic_merges": len(seen_estimates)}
+    finally:
+        c.shutdown()
+
+
+def config5(full: bool):
+    """Cluster-mode count-distinct: slot-sharded HLLs, cross-slot merge via
+    the mesh allreduce (pmax over ICI on real pods; virtual mesh here)."""
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.parallel import sharded
+
+    n_sketches = 64 if _TINY else 1024
+    per = _scale(100_000 if full else 20_000)
+
+    cfg = Config()
+    pod = cfg.use_pod()
+    pod.bank_capacity = n_sketches
+    c = RedissonTPU.create(cfg)
+    try:
+        backend = c._backend.sketch
+        rng = np.random.default_rng(5)
+        keys = rng.integers(0, 2**63, n_sketches * per, np.uint64)
+        hi = (keys >> np.uint64(32)).astype(np.uint32)
+        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        rows = (np.arange(keys.size) % n_sketches).astype(np.int32)
+        valid = np.ones(keys.size, bool)
+        backend.bank, _ = sharded.bank_insert(
+            backend.bank, hi, lo, rows, valid, backend.mesh, backend.seed)
+        backend.bank.block_until_ready()
+
+        t0 = time.perf_counter()
+        est = float(sharded.bank_count_all(backend.bank, backend.mesh))
+        merge_dt = time.perf_counter() - t0
+        err = abs(est - keys.size) / keys.size
+        return {"config": 5, "sketches": n_sketches,
+                "cross_slot_merge_count_ms": merge_dt * 1000,
+                "union_estimate": est, "true_distinct": int(keys.size),
+                "error": err, "devices": int(backend.mesh.devices.size)}
+    finally:
+        c.shutdown()
+
+
+CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
+    ap.add_argument("--all", action="store_true")
+    ap.add_argument("--full", action="store_true",
+                    help="BASELINE-paper sizes (slow)")
+    ap.add_argument("--publish", action="store_true",
+                    help="write results into BASELINE.json['published']")
+    args = ap.parse_args()
+
+    which = sorted(CONFIGS) if args.all else [args.config or 1]
+    results = {}
+    for i in which:
+        print(f"# running config {i} ...", file=sys.stderr)
+        t0 = time.perf_counter()
+        results[str(i)] = CONFIGS[i](args.full)
+        results[str(i)]["wall_s"] = time.perf_counter() - t0
+        print(json.dumps(results[str(i)]), flush=True)
+
+    if args.publish:
+        path = os.path.join(REPO, "BASELINE.json")
+        with open(path) as f:
+            doc = json.load(f)
+        doc.setdefault("published", {}).update(results)
+        doc["published"]["_meta"] = {
+            "full_scale": args.full,
+            "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"# published -> {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

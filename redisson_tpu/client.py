@@ -54,10 +54,11 @@ class RedissonTPU:
         self.id = new_client_id()  # connection-manager UUID analogue
 
         if mode == "redis":
-            raise NotImplementedError(
-                "redis passthrough mode is not wired yet; configure it "
-                "alongside tpu/pod as the durability tier instead"
-            )
+            # Passthrough: every op translates to Redis commands over RESP —
+            # the reference's own execution model (server executes, client
+            # is stateless).
+            self._init_redis_mode()
+            return
         if mode == "pod":
             from redisson_tpu.parallel.backend_pod import PodBackend
 
@@ -106,6 +107,44 @@ class RedissonTPU:
                 # threads when the first dial fails.
                 self.shutdown()
                 raise
+
+    def _init_redis_mode(self):
+        from urllib.parse import urlparse
+
+        from redisson_tpu.interop.backend_redis import RedisBackend
+        from redisson_tpu.interop.resp_client import SyncRespClient
+        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+
+        rcfg = self.config.redis
+        u = urlparse(rcfg.address)
+        self._resp = SyncRespClient(
+            host=u.hostname or "127.0.0.1",
+            port=u.port or 6379,
+            password=rcfg.password,
+            db=rcfg.database,
+            timeout=rcfg.timeout_ms / 1000.0,
+            retry_attempts=rcfg.retry_attempts,
+            retry_interval=rcfg.retry_interval_ms / 1000.0,
+        )
+        try:
+            self._resp.connect()
+        except Exception:
+            self._resp.close()  # reclaim the IO-loop thread
+            raise
+        self._backend = self._routing = RedisBackend(self._resp)
+        self._store = None
+        self._widths = (16, 32, 64, 128, 256)
+        self.metrics = MetricsRegistry()
+        self._executor = CommandExecutor(
+            self._backend, metrics=ExecutorMetrics(self.metrics))
+        self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        # Coordination/pubsub/eviction tiers need the in-process engine or
+        # server-side scripts; not available over bare passthrough (v1).
+        self._pubsub = None
+        self._watchdog = None
+        self._eviction = None
+        self._remote_services = {}
+        self._durability = None
 
     def _connect_durability(self):
         from urllib.parse import urlparse
@@ -157,16 +196,21 @@ class RedissonTPU:
     def create(cls, config: Optional[Config] = None) -> "RedissonTPU":
         return cls(config)
 
+    def _resolve_codec(self, codec):
+        """Per-object codec: accepts a Codec instance or a registry name;
+        falls back to the client default (Config.codec)."""
+        return get_codec(codec) if codec is not None else self._codec
+
     # -- sketch objects (the TPU tier) --------------------------------------
 
     def get_hyper_log_log(self, name: str, codec=None) -> RHyperLogLog:
-        return RHyperLogLog(name, self._executor, codec or self._codec, self._widths)
+        return RHyperLogLog(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_bit_set(self, name: str) -> RBitSet:
         return RBitSet(name, self._executor, self._codec, self._widths)
 
     def get_bloom_filter(self, name: str, codec=None) -> RBloomFilter:
-        return RBloomFilter(name, self._executor, codec or self._codec, self._widths)
+        return RBloomFilter(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def create_batch(self) -> RBatch:
         return RBatch(self._executor, self._codec, self._widths)
@@ -174,10 +218,10 @@ class RedissonTPU:
     # -- structure objects (the long-tail tier) -----------------------------
 
     def get_bucket(self, name: str, codec=None) -> RBucket:
-        return RBucket(name, self._executor, codec or self._codec, self._widths)
+        return RBucket(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_buckets(self, codec=None) -> RBuckets:
-        return RBuckets(self._executor, codec or self._codec)
+        return RBuckets(self._executor, self._resolve_codec(codec))
 
     def get_atomic_long(self, name: str) -> RAtomicLong:
         return RAtomicLong(name, self._executor, self._codec, self._widths)
@@ -186,84 +230,103 @@ class RedissonTPU:
         return RAtomicDouble(name, self._executor, self._codec, self._widths)
 
     def get_map(self, name: str, codec=None) -> RMap:
-        return RMap(name, self._executor, codec or self._codec, self._widths)
+        return RMap(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_map_cache(self, name: str, codec=None) -> RMapCache:
         return RMapCache(
-            name, self._executor, codec or self._codec, self._widths,
+            name, self._executor, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,
         )
 
     def get_set(self, name: str, codec=None) -> RSet:
-        return RSet(name, self._executor, codec or self._codec, self._widths)
+        return RSet(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_set_cache(self, name: str, codec=None) -> RSetCache:
         return RSetCache(
-            name, self._executor, codec or self._codec, self._widths,
+            name, self._executor, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,
         )
 
     def get_list(self, name: str, codec=None) -> RList:
-        return RList(name, self._executor, codec or self._codec, self._widths)
+        return RList(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_queue(self, name: str, codec=None) -> RQueue:
-        return RQueue(name, self._executor, codec or self._codec, self._widths)
+        return RQueue(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_deque(self, name: str, codec=None) -> RDeque:
-        return RDeque(name, self._executor, codec or self._codec, self._widths)
+        return RDeque(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_blocking_queue(self, name: str, codec=None) -> RBlockingQueue:
-        return RBlockingQueue(name, self._executor, codec or self._codec, self._widths)
+        return RBlockingQueue(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_blocking_deque(self, name: str, codec=None) -> RBlockingDeque:
-        return RBlockingDeque(name, self._executor, codec or self._codec, self._widths)
+        return RBlockingDeque(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_sorted_set(self, name: str, codec=None, key: Optional[Callable] = None) -> RSortedSet:
         return RSortedSet(
-            name, self._executor, codec or self._codec, self._widths, key=key,
+            name, self._executor, self._resolve_codec(codec), self._widths, key=key,
             guard_lock=self.get_lock(name + "__sortedset_guard"),
         )
 
     def get_scored_sorted_set(self, name: str, codec=None) -> RScoredSortedSet:
-        return RScoredSortedSet(name, self._executor, codec or self._codec, self._widths)
+        return RScoredSortedSet(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_lex_sorted_set(self, name: str) -> RLexSortedSet:
         return RLexSortedSet(name, self._executor, self._codec, self._widths)
 
     def get_set_multimap(self, name: str, codec=None) -> RSetMultimap:
-        return RSetMultimap(name, self._executor, codec or self._codec, self._widths)
+        return RSetMultimap(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_list_multimap(self, name: str, codec=None) -> RListMultimap:
-        return RListMultimap(name, self._executor, codec or self._codec, self._widths)
+        return RListMultimap(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_geo(self, name: str, codec=None) -> RGeo:
-        return RGeo(name, self._executor, codec or self._codec, self._widths)
+        return RGeo(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_topic(self, name: str, codec=None) -> RTopic:
-        return RTopic(name, self._executor, codec or self._codec, self._pubsub)
+        return RTopic(name, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     def get_pattern_topic(self, pattern: str, codec=None) -> RPatternTopic:
-        return RPatternTopic(pattern, self._executor, codec or self._codec, self._pubsub)
+        return RPatternTopic(pattern, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     # -- coordination -------------------------------------------------------
 
+    def _require_pubsub(self, feature: str):
+        if self._pubsub is None:
+            raise NotImplementedError(
+                f"{feature} needs the in-process engine (locks/topics use "
+                "pub/sub wake-ups); redis passthrough mode does not support "
+                "it in v1 — use local/tpu/pod mode")
+        return self._pubsub
+
     def get_lock(self, name: str) -> RLock:
-        return RLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+        return RLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_fair_lock(self, name: str) -> RFairLock:
-        return RFairLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+        return RFairLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_read_write_lock(self, name: str) -> RReadWriteLock:
-        return RReadWriteLock(name, self._executor, self._pubsub, self.id, self._watchdog)
+        return RReadWriteLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_multi_lock(self, *locks: RLock) -> RMultiLock:
         return RMultiLock(*locks)
 
     def get_semaphore(self, name: str) -> RSemaphore:
-        return RSemaphore(name, self._executor, self._pubsub)
+        return RSemaphore(name, self._executor, self._require_pubsub("semaphores"))
 
     def get_count_down_latch(self, name: str) -> RCountDownLatch:
-        return RCountDownLatch(name, self._executor, self._pubsub)
+        return RCountDownLatch(name, self._executor, self._require_pubsub("latches"))
+
+    def get_script(self):
+        """Atomic scripting over the structure engine (RScript analogue —
+        python functions in the Lua role, see models/script.py)."""
+        from redisson_tpu.models.script import RScript
+
+        if getattr(self._routing, "structures", None) is None:
+            raise NotImplementedError(
+                "scripting runs on the in-process engine; not available in "
+                "redis passthrough mode (use server-side Lua there)")
+        return RScript(self._executor)
 
     # -- observability ------------------------------------------------------
 
@@ -342,12 +405,16 @@ class RedissonTPU:
                 # A wedged IO loop must not abort the rest of shutdown.
                 pass
             self._resp = None
-        self._eviction.shutdown()
-        self._watchdog.shutdown()
+        if self._eviction is not None:
+            self._eviction.shutdown()
+        if self._watchdog is not None:
+            self._watchdog.shutdown()
         self._executor.shutdown()
-        # Dispatcher has exited: release threads parked in blocking pops.
-        self._routing.structures.fail_waiters()
-        self._pubsub.shutdown()
+        if getattr(self._routing, "structures", None) is not None:
+            # Dispatcher has exited: release threads parked in blocking pops.
+            self._routing.structures.fail_waiters()
+        if self._pubsub is not None:
+            self._pubsub.shutdown()
 
     def __enter__(self):
         return self

@@ -95,12 +95,88 @@ class CompressionCodec(Codec):
         return self.inner.decode(zlib.decompress(data))
 
 
+class MsgPackCodec(Codec):
+    """MsgPackJacksonCodec analogue. Gated: requires the msgpack package."""
+
+    name = "msgpack"
+
+    def __init__(self):
+        import msgpack  # noqa: F401 — fail fast if unavailable
+
+        self._msgpack = msgpack
+
+    def encode(self, value: Any) -> bytes:
+        return self._msgpack.packb(value, use_bin_type=True)
+
+    def decode(self, data: bytes) -> Any:
+        return self._msgpack.unpackb(data, raw=False)
+
+
+class CborCodec(Codec):
+    """CborJacksonCodec analogue. Gated: requires the cbor2 package."""
+
+    name = "cbor"
+
+    def __init__(self):
+        import cbor2
+
+        self._cbor = cbor2
+
+    def encode(self, value: Any) -> bytes:
+        return self._cbor.dumps(value)
+
+    def decode(self, data: bytes) -> Any:
+        return self._cbor.loads(data)
+
+
+class Lz4Codec(Codec):
+    """LZ4Codec analogue over an inner codec. Gated: requires lz4."""
+
+    name = "lz4"
+
+    def __init__(self, inner: "Codec" = None):
+        import lz4.frame
+
+        self._lz4 = lz4.frame
+        self.inner = inner or JsonCodec()
+
+    def encode(self, value: Any) -> bytes:
+        return self._lz4.compress(self.inner.encode(value))
+
+    def decode(self, data: bytes) -> Any:
+        return self.inner.decode(self._lz4.decompress(data))
+
+
+class SnappyCodec(Codec):
+    """SnappyCodec analogue over an inner codec. Gated: requires snappy."""
+
+    name = "snappy"
+
+    def __init__(self, inner: "Codec" = None):
+        import snappy
+
+        self._snappy = snappy
+        self.inner = inner or JsonCodec()
+
+    def encode(self, value: Any) -> bytes:
+        return self._snappy.compress(self.inner.encode(value))
+
+    def decode(self, data: bytes) -> Any:
+        return self.inner.decode(self._snappy.decompress(data))
+
+
 _REGISTRY = {
     "json": JsonCodec,
     "string": StringCodec,
     "long": LongCodec,
     "bytes": BytesCodec,
     "pickle": PickleCodec,
+    "msgpack": MsgPackCodec,
+    "cbor": CborCodec,
+    "lz4": Lz4Codec,
+    "snappy": SnappyCodec,
+    # zlib compression wrapper defaults to json inside (stdlib, always on)
+    "zlib": lambda: CompressionCodec(JsonCodec()),
 }
 
 
@@ -108,9 +184,14 @@ def get_codec(name_or_codec) -> Codec:
     if isinstance(name_or_codec, Codec):
         return name_or_codec
     try:
-        return _REGISTRY[name_or_codec]()
+        factory = _REGISTRY[name_or_codec]
     except KeyError:
         raise ValueError(f"unknown codec '{name_or_codec}'") from None
+    try:
+        return factory()
+    except ImportError as e:
+        raise ValueError(
+            f"codec '{name_or_codec}' needs an optional package: {e}") from e
 
 
 def encode_key(value: Any, codec: Codec) -> bytes:

@@ -1,0 +1,455 @@
+"""Redis passthrough backend — the reference's own execution model.
+
+Config mode "redis": object state lives on a Redis server and every op
+translates to Redis commands over the RESP client, exactly how the
+reference executes everything (`command/CommandAsyncService.java` routing
+to `client/protocol/RedisCommands.java` descriptors). The executor seam is
+unchanged — models cannot tell this backend from the TPU or in-memory ones.
+
+Covered op surface (v1): strings/buckets, atomics, hashes, sets, lists/
+queues, scored sets (core ops), bit sets, HyperLogLog (server-side PFADD —
+the server's own hash function, not ours), admin/expiry. Ops with no
+single-command mapping that the reference implements as Lua (locks,
+map-cache TTL puts, blocking pops) raise UnsupportedInRedisMode — use
+local/tpu mode for those objects, or a future Lua path.
+
+Multi-step translations (e.g. put returning the old value = HGET then
+HSET) are sent as ONE pipeline; they are not atomic against other clients
+of the same server (the reference uses Lua there). Documented deviation
+for v1.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from redisson_tpu.executor import Op
+from redisson_tpu.interop.resp_client import SyncRespClient
+from redisson_tpu.native import RespError
+
+
+class UnsupportedInRedisMode(NotImplementedError):
+    pass
+
+
+def _b(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if isinstance(v, str):
+        return v.encode()
+    return str(v).encode()
+
+
+class RedisBackend:
+    """Backend for CommandExecutor whose run() executes via RESP."""
+
+    def __init__(self, client: SyncRespClient):
+        self.client = client
+
+    def run(self, kind: str, target: str, ops: List[Op]) -> None:
+        handler = getattr(self, "_op_" + kind, None)
+        if handler is None:
+            raise UnsupportedInRedisMode(
+                f"op '{kind}' has no redis-mode translation (use local/tpu "
+                "mode for this object type)")
+        for op in ops:
+            try:
+                handler(target, op)
+            except RespError as e:
+                op.future.set_exception(e)
+            except Exception as e:  # noqa: BLE001
+                if not op.future.done():
+                    op.future.set_exception(e)
+
+    def handles(self, kind: str) -> bool:
+        return hasattr(self, "_op_" + kind)
+
+    def names(self, pattern: str = "*") -> List[str]:
+        return sorted(
+            k.decode("utf-8", "replace")
+            for k in self.client.execute("KEYS", pattern or "*"))
+
+    # -- helpers ------------------------------------------------------------
+
+    def _x(self, *args):
+        return self.client.execute(*args)
+
+    # -- admin / expiry ------------------------------------------------------
+
+    def _op_delete(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("DEL", key) > 0)
+
+    def _op_exists(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("EXISTS", key) > 0)
+
+    def _op_flushall(self, key: str, op: Op) -> None:
+        self._x("FLUSHALL")
+        op.future.set_result(None)
+
+    def _op_keys(self, key: str, op: Op) -> None:
+        pattern = (op.payload or {}).get("pattern", "*")
+        op.future.set_result(self.names(pattern))
+
+    def _op_type(self, key: str, op: Op) -> None:
+        t = self._x("TYPE", key)
+        t = t.decode() if isinstance(t, bytes) else t
+        op.future.set_result(None if t == "none" else t)
+
+    def _op_pexpire(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("PEXPIRE", key, int(op.payload["ms"])) == 1)
+
+    def _op_pexpireat(self, key: str, op: Op) -> None:
+        op.future.set_result(
+            self._x("PEXPIREAT", key, int(op.payload["ts_ms"])) == 1)
+
+    def _op_persist(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("PERSIST", key) == 1)
+
+    def _op_pttl(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("PTTL", key))
+
+    def _op_rename(self, key: str, op: Op) -> None:
+        self._x("RENAME", key, op.payload["newkey"])
+        op.future.set_result(True)
+
+    def _op_strlen(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("STRLEN", key))
+
+    # -- strings / buckets ---------------------------------------------------
+
+    def _op_get(self, key: str, op: Op) -> None:
+        v = self._x("GET", key)
+        op.future.set_result(None if v is None else bytes(v))
+
+    def _op_set(self, key: str, op: Op) -> None:
+        ttl = op.payload.get("ttl_ms")
+        if ttl:
+            self._x("SET", key, op.payload["value"], "PX", int(ttl))
+        else:
+            self._x("SET", key, op.payload["value"])
+        op.future.set_result(None)
+
+    def _op_getset(self, key: str, op: Op) -> None:
+        v = self._x("GETSET", key, op.payload["value"])
+        op.future.set_result(None if v is None else bytes(v))
+
+    def _op_setnx(self, key: str, op: Op) -> None:
+        ttl = op.payload.get("ttl_ms")
+        ok = self._x("SETNX", key, op.payload["value"]) == 1
+        if ok and ttl:
+            self._x("PEXPIRE", key, int(ttl))
+        op.future.set_result(ok)
+
+    def _op_compare_and_set(self, key: str, op: Op) -> None:
+        # Non-atomic GET+SET in v1 (reference uses Lua CAS).
+        cur = self._x("GET", key)
+        cur = None if cur is None else bytes(cur)
+        if cur != op.payload["expect"]:
+            op.future.set_result(False)
+            return
+        self._x("SET", key, op.payload["update"])
+        op.future.set_result(True)
+
+    def _op_incr(self, key: str, op: Op) -> None:
+        if op.payload.get("float"):
+            v = float(self._x("INCRBYFLOAT", key, repr(op.payload["by"])))
+        else:
+            v = self._x("INCRBY", key, int(op.payload["by"]))
+        op.future.set_result(v)
+
+    def _op_num_get(self, key: str, op: Op) -> None:
+        v = self._x("GET", key)
+        as_float = bool(op.payload.get("float"))
+        if v is None:
+            op.future.set_result(0.0 if as_float else 0)
+        else:
+            op.future.set_result(float(v) if as_float else int(v))
+
+    def _op_num_cas(self, key: str, op: Op) -> None:
+        as_float = bool(op.payload.get("float"))
+        cur = self._x("GET", key)
+        curv = (0.0 if as_float else 0) if cur is None else (
+            float(cur) if as_float else int(cur))
+        if curv != op.payload["expect"]:
+            op.future.set_result(False)
+            return
+        u = op.payload["update"]
+        self._x("SET", key, repr(u) if as_float else str(int(u)))
+        op.future.set_result(True)
+
+    def _op_num_getandset(self, key: str, op: Op) -> None:
+        as_float = bool(op.payload.get("float"))
+        v = op.payload["value"]
+        old = self._x("GETSET", key, repr(v) if as_float else str(int(v)))
+        if old is None:
+            op.future.set_result(0.0 if as_float else 0)
+        else:
+            op.future.set_result(float(old) if as_float else int(old))
+
+    def _op_mget(self, key: str, op: Op) -> None:
+        names = op.payload["names"]
+        vals = self._x("MGET", *names) if names else []
+        op.future.set_result(
+            {n: bytes(v) for n, v in zip(names, vals) if v is not None})
+
+    def _op_mset(self, key: str, op: Op) -> None:
+        pairs = op.payload["pairs"]
+        flat: List = []
+        for n, v in pairs.items():
+            flat += [n, v]
+        if flat:
+            self._x("MSET", *flat)
+        op.future.set_result(None)
+
+    def _op_msetnx(self, key: str, op: Op) -> None:
+        pairs = op.payload["pairs"]
+        flat: List = []
+        for n, v in pairs.items():
+            flat += [n, v]
+        op.future.set_result(self._x("MSETNX", *flat) == 1 if flat else True)
+
+    # -- hash (RMap) ---------------------------------------------------------
+
+    def _op_hput(self, key: str, op: Op) -> None:
+        f, v = op.payload["field"], op.payload["value"]
+        old, _ = self.client.pipeline([("HGET", key, f), ("HSET", key, f, v)])
+        op.future.set_result(None if old is None else bytes(old))
+
+    def _op_hput_if_absent(self, key: str, op: Op) -> None:
+        f, v = op.payload["field"], op.payload["value"]
+        added = self._x("HSETNX", key, f, v)
+        if added:
+            op.future.set_result(None)
+        else:
+            cur = self._x("HGET", key, f)
+            op.future.set_result(None if cur is None else bytes(cur))
+
+    def _op_hputall(self, key: str, op: Op) -> None:
+        flat: List = []
+        for f, v in op.payload["pairs"].items():
+            flat += [f, v]
+        if flat:
+            self._x("HSET", key, *flat)
+        op.future.set_result(None)
+
+    def _op_hget(self, key: str, op: Op) -> None:
+        v = self._x("HGET", key, op.payload["field"])
+        op.future.set_result(None if v is None else bytes(v))
+
+    def _op_hmget(self, key: str, op: Op) -> None:
+        fields = op.payload["fields"]
+        vals = self._x("HMGET", key, *fields) if fields else []
+        op.future.set_result(
+            {f: bytes(v) for f, v in zip(fields, vals) if v is not None})
+
+    def _op_hgetall(self, key: str, op: Op) -> None:
+        raw = self._x("HGETALL", key)
+        op.future.set_result(
+            {bytes(raw[i]): bytes(raw[i + 1]) for i in range(0, len(raw), 2)})
+
+    def _op_hdel(self, key: str, op: Op) -> None:
+        fields = op.payload["fields"]
+        op.future.set_result(self._x("HDEL", key, *fields) if fields else 0)
+
+    def _op_hremove(self, key: str, op: Op) -> None:
+        f = op.payload["field"]
+        old, _ = self.client.pipeline([("HGET", key, f), ("HDEL", key, f)])
+        op.future.set_result(None if old is None else bytes(old))
+
+    def _op_hlen(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("HLEN", key))
+
+    def _op_hkeys(self, key: str, op: Op) -> None:
+        op.future.set_result([bytes(f) for f in self._x("HKEYS", key)])
+
+    def _op_hvals(self, key: str, op: Op) -> None:
+        op.future.set_result([bytes(v) for v in self._x("HVALS", key)])
+
+    def _op_hcontains_key(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("HEXISTS", key, op.payload["field"]) == 1)
+
+    def _op_hincr(self, key: str, op: Op) -> None:
+        f, by = op.payload["field"], op.payload["by"]
+        if isinstance(by, float):
+            op.future.set_result(float(self._x("HINCRBYFLOAT", key, f, repr(by))))
+        else:
+            op.future.set_result(self._x("HINCRBY", key, f, int(by)))
+
+    # -- set (RSet) ----------------------------------------------------------
+
+    def _op_sadd(self, key: str, op: Op) -> None:
+        members = list(op.payload["members"])
+        op.future.set_result(
+            self._x("SADD", key, *members) > 0 if members else False)
+
+    def _op_srem(self, key: str, op: Op) -> None:
+        members = list(op.payload["members"])
+        op.future.set_result(
+            self._x("SREM", key, *members) > 0 if members else False)
+
+    def _op_sismember(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("SISMEMBER", key, op.payload["member"]) == 1)
+
+    def _op_smembers(self, key: str, op: Op) -> None:
+        op.future.set_result({bytes(m) for m in self._x("SMEMBERS", key)})
+
+    def _op_scard(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("SCARD", key))
+
+    # -- list / queue --------------------------------------------------------
+
+    def _op_rpush(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("RPUSH", key, *op.payload["values"]))
+
+    def _op_lpush(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("LPUSH", key, *op.payload["values"]))
+
+    def _op_lrange(self, key: str, op: Op) -> None:
+        out = self._x("LRANGE", key, op.payload["start"], op.payload["stop"])
+        op.future.set_result([bytes(v) for v in out])
+
+    def _op_llen(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("LLEN", key))
+
+    def _op_lindex(self, key: str, op: Op) -> None:
+        v = self._x("LINDEX", key, op.payload["index"])
+        op.future.set_result(None if v is None else bytes(v))
+
+    def _op_lset(self, key: str, op: Op) -> None:
+        self._x("LSET", key, op.payload["index"], op.payload["value"])
+        op.future.set_result(None)
+
+    def _op_lrem(self, key: str, op: Op) -> None:
+        count = op.payload.get("count", 1)
+        op.future.set_result(
+            self._x("LREM", key, count, op.payload["value"]) > 0)
+
+    def _op_lpop(self, key: str, op: Op) -> None:
+        v = self._x("LPOP", key)
+        op.future.set_result(None if v is None else bytes(v))
+
+    def _op_rpop(self, key: str, op: Op) -> None:
+        v = self._x("RPOP", key)
+        op.future.set_result(None if v is None else bytes(v))
+
+    # -- zset (core) ---------------------------------------------------------
+
+    def _op_zadd(self, key: str, op: Op) -> None:
+        if not op.payload["pairs"]:
+            op.future.set_result(0)  # bare ZADD is a protocol error
+            return
+        args: List = []
+        if op.payload.get("nx"):
+            args.append("NX")
+        for member, score in op.payload["pairs"]:
+            args += [repr(float(score)), member]
+        op.future.set_result(self._x("ZADD", key, *args))
+
+    def _op_zscore(self, key: str, op: Op) -> None:
+        v = self._x("ZSCORE", key, op.payload["member"])
+        op.future.set_result(None if v is None else float(v))
+
+    def _op_zincrby(self, key: str, op: Op) -> None:
+        op.future.set_result(
+            float(self._x("ZINCRBY", key, repr(float(op.payload["by"])),
+                          op.payload["member"])))
+
+    def _op_zrem(self, key: str, op: Op) -> None:
+        members = list(op.payload["members"])
+        op.future.set_result(
+            self._x("ZREM", key, *members) > 0 if members else False)
+
+    def _op_zcard(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("ZCARD", key))
+
+    def _op_zrange(self, key: str, op: Op) -> None:
+        start, stop = op.payload["start"], op.payload["stop"]
+        if op.payload.get("rev"):
+            # Slice in DESCENDING rank space (engine reverses THEN slices):
+            # rev indices [a, b] = ascending [n-1-b, n-1-a], result reversed.
+            n = self._x("ZCARD", key)
+            a = start + n if start < 0 else start
+            b = stop + n if stop < 0 else stop
+            out = self._x("ZRANGE", key, n - 1 - b, n - 1 - a, "WITHSCORES")
+            pairs = [(bytes(out[i]), float(out[i + 1]))
+                     for i in range(0, len(out), 2)]
+            pairs.reverse()
+        else:
+            out = self._x("ZRANGE", key, start, stop, "WITHSCORES")
+            pairs = [(bytes(out[i]), float(out[i + 1]))
+                     for i in range(0, len(out), 2)]
+        if op.payload.get("withscores"):
+            op.future.set_result(pairs)
+        else:
+            op.future.set_result([m for m, _ in pairs])
+
+    # -- bitset --------------------------------------------------------------
+
+    def _op_bitset_set(self, key: str, op: Op) -> None:
+        import numpy as np
+
+        idx = op.payload["idx"]
+        cmds = [("SETBIT", key, int(i), 1) for i in idx]
+        old = self.client.pipeline(cmds)
+        op.future.set_result(np.array([int(o) for o in old], np.uint8))
+
+    def _op_bitset_clear(self, key: str, op: Op) -> None:
+        import numpy as np
+
+        idx = op.payload["idx"]
+        cmds = [("SETBIT", key, int(i), 0) for i in idx]
+        old = self.client.pipeline(cmds)
+        op.future.set_result(np.array([int(o) for o in old], np.uint8))
+
+    def _op_bitset_get(self, key: str, op: Op) -> None:
+        import numpy as np
+
+        idx = op.payload["idx"]
+        out = self.client.pipeline([("GETBIT", key, int(i)) for i in idx])
+        op.future.set_result(np.array([int(o) for o in out], np.uint8))
+
+    def _op_bitset_cardinality(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("BITCOUNT", key))
+
+    def _op_bitset_size(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("STRLEN", key) * 8)
+
+    def _op_bitset_op(self, key: str, op: Op) -> None:
+        kind = op.payload["op"]
+        names = op.payload.get("names", [])
+        if kind == "not":
+            self._x("BITOP", "NOT", key, key)
+        else:
+            self._x("BITOP", kind.upper(), key, key, *names)
+        op.future.set_result(None)
+
+    # -- HyperLogLog ---------------------------------------------------------
+
+    def _op_hll_add(self, key: str, op: Op) -> None:
+        """Server-side PFADD: the server hashes with ITS function (the
+        pass-through semantics of RedissonHyperLogLog.java:40-97)."""
+        p = op.payload
+        if "data" in p:
+            data, lengths = p["data"], p["lengths"]
+            keys = [bytes(data[i, :lengths[i]].tobytes())
+                    for i in range(data.shape[0])]
+        else:  # pre-hashed ints: feed their LE bytes
+            import numpy as np
+
+            vals = (p["hi"].astype("uint64") << np.uint64(32)) | p["lo"].astype("uint64")
+            keys = [v.tobytes() for v in vals]
+        changed = False
+        for i in range(0, len(keys), 1000):
+            if self._x("PFADD", key, *keys[i:i + 1000]) == 1:
+                changed = True
+        op.future.set_result(changed)
+
+    def _op_hll_count(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("PFCOUNT", key))
+
+    def _op_hll_count_with(self, key: str, op: Op) -> None:
+        op.future.set_result(self._x("PFCOUNT", key, *op.payload["names"]))
+
+    def _op_hll_merge_with(self, key: str, op: Op) -> None:
+        self._x("PFMERGE", key, *op.payload["names"])
+        op.future.set_result(None)

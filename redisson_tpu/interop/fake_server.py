@@ -51,6 +51,10 @@ def _array(items: List[bytes]) -> bytes:
     return b"*%d\r\n" % len(items) + b"".join(items)
 
 
+class _ZSet(dict):
+    """member -> score; its own type so TYPE can tell it from a hash."""
+
+
 class FakeRedisServer:
     """asyncio RESP server over an in-memory dict. start()/stop(); the
     listening port is self.port (0 -> ephemeral)."""
@@ -61,6 +65,7 @@ class FakeRedisServer:
         self.port = port
         self.password = password
         self.data: Dict[bytes, object] = {}
+        self.expires: Dict[bytes, int] = {}  # key -> unix ms deadline
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections = 0
         self._writers: set = set()
@@ -127,10 +132,20 @@ class FakeRedisServer:
     # -- command handlers ---------------------------------------------------
 
     def _dispatch(self, name: str, a: List[bytes]) -> bytes:
+        self._purge_expired()
         h = getattr(self, "_cmd_" + name.lower(), None)
         if h is None:
             return _err(f"unknown command '{name}'")
         return h(a)
+
+    def _purge_expired(self) -> None:
+        if not self.expires:
+            return
+        import time
+        now = int(time.time() * 1000)
+        for k in [k for k, ts in self.expires.items() if ts <= now]:
+            self.expires.pop(k, None)
+            self.data.pop(k, None)
 
     def _cmd_ping(self, a):
         return _bulk(a[0]) if a else b"+PONG\r\n"
@@ -149,7 +164,15 @@ class FakeRedisServer:
         return _ok()
 
     def _cmd_set(self, a):
-        self.data[bytes(a[0])] = bytes(a[1])
+        k = bytes(a[0])
+        self.data[k] = bytes(a[1])
+        self.expires.pop(k, None)  # SET discards any TTL
+        # optional PX ttl argument (SET k v PX ms)
+        rest = [bytes(x).upper() for x in a[2:]]
+        if b"PX" in rest:
+            import time
+            ms = int(a[2 + rest.index(b"PX") + 1])
+            self.expires[k] = int(time.time() * 1000) + ms
         return _ok()
 
     def _cmd_get(self, a):
@@ -168,10 +191,110 @@ class FakeRedisServer:
         v = self.data.get(bytes(a[0]), b"")
         return _int(len(v) if isinstance(v, bytes) else 0)
 
+    def _cmd_setnx(self, a):
+        k = bytes(a[0])
+        if k in self.data:
+            return _int(0)
+        self.data[k] = bytes(a[1])
+        return _int(1)
+
+    def _cmd_getset(self, a):
+        k = bytes(a[0])
+        old = self.data.get(k)
+        if old is not None and not isinstance(old, bytes):
+            raise ValueError("WRONGTYPE")
+        self.data[k] = bytes(a[1])
+        self.expires.pop(k, None)  # SET-family write discards TTL
+        return _bulk(old)
+
+    def _cmd_incrby(self, a):
+        k = bytes(a[0])
+        v = int(self.data.get(k, b"0")) + int(a[1])
+        self.data[k] = str(v).encode()
+        return _int(v)
+
+    def _cmd_incrbyfloat(self, a):
+        k = bytes(a[0])
+        v = float(self.data.get(k, b"0")) + float(a[1])
+        self.data[k] = repr(v).encode()
+        return _bulk(repr(v).encode())
+
+    def _cmd_incr(self, a):
+        return self._cmd_incrby([a[0], b"1"])
+
+    def _cmd_decr(self, a):
+        return self._cmd_incrby([a[0], b"-1"])
+
+    def _cmd_mget(self, a):
+        out = []
+        for k in a:
+            v = self.data.get(bytes(k))
+            out.append(_bulk(v if isinstance(v, bytes) else None))
+        return _array(out)
+
+    def _cmd_mset(self, a):
+        for i in range(0, len(a) - 1, 2):
+            k = bytes(a[i])
+            self.data[k] = bytes(a[i + 1])
+            self.expires.pop(k, None)
+        return _ok()
+
+    def _cmd_msetnx(self, a):
+        keys = [bytes(a[i]) for i in range(0, len(a) - 1, 2)]
+        if any(k in self.data for k in keys):
+            return _int(0)
+        self._cmd_mset(a)
+        return _int(1)
+
+    def _cmd_rename(self, a):
+        k, nk = bytes(a[0]), bytes(a[1])
+        if k not in self.data:
+            raise ValueError("no such key")
+        self.data[nk] = self.data.pop(k)
+        # Destination inherits the SOURCE's TTL state (Redis semantics:
+        # any previous TTL on the destination is discarded).
+        self.expires.pop(nk, None)
+        if k in self.expires:
+            self.expires[nk] = self.expires.pop(k)
+        return _ok()
+
+    def _cmd_pexpire(self, a):
+        import time
+        k = bytes(a[0])
+        if k not in self.data:
+            return _int(0)
+        self.expires[k] = int(time.time() * 1000) + int(a[1])
+        return _int(1)
+
+    def _cmd_expire(self, a):
+        return self._cmd_pexpire([a[0], str(int(a[1]) * 1000).encode()])
+
+    def _cmd_pexpireat(self, a):
+        k = bytes(a[0])
+        if k not in self.data:
+            return _int(0)
+        self.expires[k] = int(a[1])
+        return _int(1)
+
+    def _cmd_persist(self, a):
+        return _int(1 if self.expires.pop(bytes(a[0]), None) is not None else 0)
+
+    def _cmd_pttl(self, a):
+        import time
+        k = bytes(a[0])
+        if k not in self.data:
+            return _int(-2)
+        ts = self.expires.get(k)
+        if ts is None:
+            return _int(-1)
+        return _int(max(0, ts - int(time.time() * 1000)))
+
     def _cmd_del(self, a):
         n = 0
         for k in a:
-            n += 1 if self.data.pop(bytes(k), None) is not None else 0
+            kb = bytes(k)
+            self.expires.pop(kb, None)
+            n += 1 if self.data.pop(kb, None) is not None else 0
         return _int(n)
 
     def _cmd_exists(self, a):
@@ -188,7 +311,15 @@ class FakeRedisServer:
         v = self.data.get(bytes(a[0]))
         if v is None:
             return b"+none\r\n"
-        return b"+hash\r\n" if isinstance(v, dict) else b"+string\r\n"
+        if isinstance(v, _ZSet):
+            return b"+zset\r\n"
+        if isinstance(v, dict):
+            return b"+hash\r\n"
+        if isinstance(v, set):
+            return b"+set\r\n"
+        if isinstance(v, list):
+            return b"+list\r\n"
+        return b"+string\r\n"
 
     # bits
 
@@ -243,7 +374,7 @@ class FakeRedisServer:
 
     def _hash(self, k: bytes) -> dict:
         v = self.data.setdefault(k, {})
-        if not isinstance(v, dict):
+        if not isinstance(v, dict) or isinstance(v, _ZSet):
             raise ValueError("WRONGTYPE")
         return v
 
@@ -256,17 +387,13 @@ class FakeRedisServer:
         return _int(added)
 
     def _cmd_hget(self, a):
-        v = self.data.get(bytes(a[0]))
+        v = self._hash_read(bytes(a[0]))
         if v is None:
             return _bulk(None)
-        if not isinstance(v, dict):
-            raise ValueError("WRONGTYPE")
         return _bulk(v.get(bytes(a[1])))
 
     def _cmd_hgetall(self, a):
-        v = self.data.get(bytes(a[0]), {})
-        if not isinstance(v, dict):
-            raise ValueError("WRONGTYPE")
+        v = self._hash_read(bytes(a[0])) or {}
         out = []
         for k, val in v.items():
             out.append(_bulk(k))
@@ -281,6 +408,263 @@ class FakeRedisServer:
         for f in a[1:]:
             n += 1 if v.pop(bytes(f), None) is not None else 0
         return _int(n)
+
+    def _cmd_hsetnx(self, a):
+        h = self._hash(bytes(a[0]))
+        f = bytes(a[1])
+        if f in h:
+            return _int(0)
+        h[f] = bytes(a[2])
+        return _int(1)
+
+    def _hash_read(self, k: bytes):
+        """Read-side hash lookup; WRONGTYPE on zsets (dict subclasses)."""
+        v = self.data.get(k)
+        if v is not None and (not isinstance(v, dict) or isinstance(v, _ZSet)):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_hexists(self, a):
+        v = self._hash_read(bytes(a[0]))
+        return _int(1 if v is not None and bytes(a[1]) in v else 0)
+
+    def _cmd_hmget(self, a):
+        v = self._hash_read(bytes(a[0]))
+        out = []
+        for f in a[1:]:
+            item = v.get(bytes(f)) if isinstance(v, dict) else None
+            out.append(_bulk(item))
+        return _array(out)
+
+    def _cmd_hlen(self, a):
+        v = self._hash_read(bytes(a[0]))
+        return _int(len(v) if v is not None else 0)
+
+    def _cmd_hkeys(self, a):
+        v = self._hash_read(bytes(a[0])) or {}
+        return _array([_bulk(f) for f in v])
+
+    def _cmd_hvals(self, a):
+        v = self._hash_read(bytes(a[0])) or {}
+        return _array([_bulk(x) for x in v.values()])
+
+    def _cmd_hincrby(self, a):
+        h = self._hash(bytes(a[0]))
+        f = bytes(a[1])
+        v = int(h.get(f, b"0")) + int(a[2])
+        h[f] = str(v).encode()
+        return _int(v)
+
+    def _cmd_hincrbyfloat(self, a):
+        h = self._hash(bytes(a[0]))
+        f = bytes(a[1])
+        v = float(h.get(f, b"0")) + float(a[2])
+        h[f] = repr(v).encode()
+        return _bulk(repr(v).encode())
+
+    # sets
+
+    def _set(self, k: bytes) -> set:
+        v = self.data.setdefault(k, set())
+        if not isinstance(v, set):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_sadd(self, a):
+        s = self._set(bytes(a[0]))
+        n = 0
+        for m in a[1:]:
+            mb = bytes(m)
+            if mb not in s:
+                s.add(mb)
+                n += 1
+        return _int(n)
+
+    def _cmd_srem(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, set):
+            return _int(0)
+        n = 0
+        for m in a[1:]:
+            if bytes(m) in v:
+                v.discard(bytes(m))
+                n += 1
+        return _int(n)
+
+    def _cmd_sismember(self, a):
+        v = self.data.get(bytes(a[0]))
+        return _int(1 if isinstance(v, set) and bytes(a[1]) in v else 0)
+
+    def _cmd_smembers(self, a):
+        v = self.data.get(bytes(a[0]), set())
+        return _array([_bulk(m) for m in sorted(v)]) if isinstance(v, set) else _array([])
+
+    def _cmd_scard(self, a):
+        v = self.data.get(bytes(a[0]))
+        return _int(len(v) if isinstance(v, set) else 0)
+
+    # lists
+
+    def _list(self, k: bytes) -> list:
+        v = self.data.setdefault(k, [])
+        if not isinstance(v, list):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_rpush(self, a):
+        lst = self._list(bytes(a[0]))
+        lst.extend(bytes(x) for x in a[1:])
+        return _int(len(lst))
+
+    def _cmd_lpush(self, a):
+        lst = self._list(bytes(a[0]))
+        for x in a[1:]:
+            lst.insert(0, bytes(x))
+        return _int(len(lst))
+
+    def _cmd_lrange(self, a):
+        v = self.data.get(bytes(a[0]), [])
+        if not isinstance(v, list):
+            raise ValueError("WRONGTYPE")
+        start, stop = int(a[1]), int(a[2])
+        n = len(v)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        start = max(0, start)
+        if stop < start:  # Redis returns empty, incl. stop < -n
+            return _array([])
+        return _array([_bulk(x) for x in v[start:stop + 1]])
+
+    def _cmd_llen(self, a):
+        v = self.data.get(bytes(a[0]))
+        return _int(len(v) if isinstance(v, list) else 0)
+
+    def _cmd_lindex(self, a):
+        v = self.data.get(bytes(a[0]))
+        i = int(a[1])
+        if not isinstance(v, list) or not -len(v) <= i < len(v):
+            return _bulk(None)
+        return _bulk(v[i])
+
+    def _cmd_lset(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list):
+            raise ValueError("no such key")
+        v[int(a[1])] = bytes(a[2])
+        return _ok()
+
+    def _cmd_lrem(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list):
+            return _int(0)
+        count, val = int(a[1]), bytes(a[2])
+        removed = 0
+        if count >= 0:
+            limit = count if count else len(v)
+            i = 0
+            while i < len(v) and removed < limit:
+                if v[i] == val:
+                    v.pop(i)
+                    removed += 1
+                else:
+                    i += 1
+        else:
+            limit = -count
+            i = len(v) - 1
+            while i >= 0 and removed < limit:
+                if v[i] == val:
+                    v.pop(i)
+                    removed += 1
+                i -= 1
+        return _int(removed)
+
+    def _cmd_lpop(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list) or not v:
+            return _bulk(None)
+        return _bulk(v.pop(0))
+
+    def _cmd_rpop(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list) or not v:
+            return _bulk(None)
+        return _bulk(v.pop())
+
+    # zsets (score dict; order computed on read)
+
+    def _zset(self, k: bytes) -> dict:
+        v = self.data.get(k)
+        if v is None:
+            v = self.data[k] = _ZSet()
+        if not isinstance(v, _ZSet):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_zadd(self, a):
+        args = a[1:]
+        nx = False
+        if args and bytes(args[0]).upper() == b"NX":
+            nx = True
+            args = args[1:]
+        z = self._zset(bytes(a[0]))
+        added = 0
+        for i in range(0, len(args) - 1, 2):
+            score, member = float(args[i]), bytes(args[i + 1])
+            if member not in z:
+                z[member] = score
+                added += 1
+            elif not nx:
+                z[member] = score
+        return _int(added)
+
+    def _cmd_zscore(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet) or bytes(a[1]) not in v:
+            return _bulk(None)
+        return _bulk(repr(v[bytes(a[1])]).encode())
+
+    def _cmd_zincrby(self, a):
+        z = self._zset(bytes(a[0]))
+        m = bytes(a[2])
+        z[m] = z.get(m, 0.0) + float(a[1])
+        return _bulk(repr(z[m]).encode())
+
+    def _cmd_zrem(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet):
+            return _int(0)
+        n = 0
+        for m in a[1:]:
+            if v.pop(bytes(m), None) is not None:
+                n += 1
+        return _int(n)
+
+    def _cmd_zcard(self, a):
+        v = self.data.get(bytes(a[0]))
+        return _int(len(v) if isinstance(v, _ZSet) else 0)
+
+    def _cmd_zrange(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet):
+            return _array([])
+        withscores = len(a) > 3 and bytes(a[3]).upper() == b"WITHSCORES"
+        ordered = sorted(v.items(), key=lambda kv: (kv[1], kv[0]))
+        start, stop = int(a[1]), int(a[2])
+        n = len(ordered)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        start = max(0, start)
+        window = [] if stop < start else ordered[start:stop + 1]
+        out = []
+        for m, s in window:
+            out.append(_bulk(m))
+            if withscores:
+                out.append(_bulk(repr(s).encode()))
+        return _array(out)
 
     # HLL (registers via our codec; hash = native murmur3 low half — the
     # same family the TPU sketches use, so PFCOUNT here agrees with the

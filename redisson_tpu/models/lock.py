@@ -31,6 +31,29 @@ from redisson_tpu.structures.extended import (
 
 DEFAULT_LEASE_S = 30.0  # lockWatchdogTimeout (RedissonLock.java:59-61)
 
+# Per-context lock-owner override (see RLock._owner). contextvars propagate
+# through asyncio.to_thread, so an async task's identity survives the hop
+# onto a worker thread.
+import contextvars
+
+_OWNER_CTX: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "rtpu_lock_owner", default=None)
+
+
+class owner_context:
+    """Context manager pinning the lock-owner context id (async tasks)."""
+
+    def __init__(self, context_id: str):
+        self._id = context_id
+        self._token = None
+
+    def __enter__(self):
+        self._token = _OWNER_CTX.set(self._id)
+        return self
+
+    def __exit__(self, *exc):
+        _OWNER_CTX.reset(self._token)
+
 
 class LockWatchdog:
     """Client-side lease renewal (expirationRenewalMap analogue).
@@ -102,7 +125,16 @@ class RLock:
         self._watchdog = watchdog
 
     def _owner(self) -> str:
-        return f"{self._client_id}:{threading.get_ident()}"
+        """Lock owner identity: client uuid + execution-context id.
+
+        Default context id is the OS thread (the reference's uuid:threadId).
+        Async callers override it per logical task via `owner_context` —
+        the analogue of the reference passing an explicit threadId into
+        lockAsync/unlockAsync — so mutual exclusion holds between asyncio
+        tasks regardless of which worker thread runs the call."""
+        override = _OWNER_CTX.get()
+        ctx = override if override is not None else threading.get_ident()
+        return f"{self._client_id}:{ctx}"
 
     def _try_once(
         self,

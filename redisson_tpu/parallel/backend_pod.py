@@ -84,7 +84,9 @@ class PodBackend:
             cap += ndev - cap % ndev
         bank = self.bank
         if cap != self.bank_capacity:
-            bank = sharded.grow_bank(bank, cap, self.mesh)
+            # Pad rows targeting the NEW mesh: the rounded capacity need not
+            # divide the old device count.
+            bank = sharded.grow_bank(bank, cap, new_mesh)
         self.bank = sharded.migrate_bank(bank, new_mesh)
         self.mesh = new_mesh
         self.bank_capacity = cap

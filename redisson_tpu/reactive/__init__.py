@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import itertools
 from concurrent.futures import Future as _CFuture
 from typing import Any, AsyncIterator, Optional
 
@@ -78,39 +79,52 @@ class AsyncProxy:
         return f"Async({self._sync!r})"
 
 
+_task_seq = itertools.count(1)
+
+
+def _task_owner_id() -> str:
+    """A stable per-asyncio-task lock-owner context id. A monotonic token is
+    stamped on the task once — id(task) alone could be reused by a new task
+    allocated at a freed task's address, inheriting its lock ownership."""
+    task = asyncio.current_task()
+    if task is None:
+        return "loopless"
+    token = getattr(task, "_rtpu_owner_token", None)
+    if token is None:
+        token = next(_task_seq)
+        task._rtpu_owner_token = token
+    return f"task-{token}"
+
+
 class AsyncLock(AsyncProxy):
     """Adds `async with` acquire/release on top of the proxy.
 
-    Lock ownership is `client_id:thread_id` (models/lock.py — the
-    reference's uuid:threadId), so every operation of one AsyncLock must
-    run on the SAME thread: a shared to_thread pool would acquire on one
-    worker and try to release on another. Each AsyncLock therefore owns a
-    single-thread executor (the analogue of the reference passing an
-    explicit threadId through lockAsync/unlockAsync)."""
-
-    __slots__ = ("_pinned",)
-
-    def __init__(self, sync_obj):
-        super().__init__(sync_obj)
-        from concurrent.futures import ThreadPoolExecutor
-
-        object.__setattr__(self, "_pinned", ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="rtpu-async-lock"))
+    Lock ownership defaults to `client_id:thread_id` (models/lock.py, the
+    reference's uuid:threadId); a shared to_thread pool would acquire on
+    one worker thread and release on another. Instead of pinning threads,
+    every call runs under an `owner_context` carrying the calling asyncio
+    TASK's identity — the analogue of the reference passing an explicit
+    threadId through lockAsync/unlockAsync. Mutual exclusion is therefore
+    between tasks, and reentrancy works within one task."""
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
         attr = getattr(self._sync, name)
         if callable(attr):
-            pinned = self._pinned
+            from redisson_tpu.models.lock import owner_context
 
             @functools.wraps(attr)
-            async def via_pinned(*args, **kwargs):
-                loop = asyncio.get_event_loop()
-                return await loop.run_in_executor(
-                    pinned, functools.partial(attr, *args, **kwargs))
+            async def via_task_owner(*args, **kwargs):
+                oid = _task_owner_id()
 
-            return via_pinned
+                def call():
+                    with owner_context(oid):
+                        return attr(*args, **kwargs)
+
+                return await asyncio.to_thread(call)
+
+            return via_task_owner
         return attr
 
     async def __aenter__(self):
@@ -120,15 +134,15 @@ class AsyncLock(AsyncProxy):
     async def __aexit__(self, *exc):
         await self.unlock()
 
-    def close(self) -> None:
-        """Release the pinned executor thread."""
-        self._pinned.shutdown(wait=False)
 
-    def __del__(self):  # pragma: no cover
-        try:
-            self._pinned.shutdown(wait=False)
-        except Exception:
-            pass
+class AsyncReadWriteLock(AsyncProxy):
+    """read_lock()/write_lock() return AsyncLocks (task-owner semantics)."""
+
+    def read_lock(self) -> AsyncLock:
+        return AsyncLock(self._sync.read_lock())
+
+    def write_lock(self) -> AsyncLock:
+        return AsyncLock(self._sync.write_lock())
 
 
 class AsyncIterableProxy(AsyncProxy):
@@ -162,9 +176,6 @@ class RedissonTPUReactive:
 
     def __init__(self, client: RedissonTPU):
         self._client = client
-        # AsyncLocks own a pinned executor thread; cache per (kind, name)
-        # so repeated getters reuse one thread, reclaimed at shutdown.
-        self._locks: dict = {}
 
     # -- sketch tier --------------------------------------------------------
 
@@ -248,20 +259,13 @@ class RedissonTPUReactive:
     # -- coordination -------------------------------------------------------
 
     def get_lock(self, name: str) -> AsyncLock:
-        key = ("lock", name)
-        if key not in self._locks:
-            self._locks[key] = AsyncLock(self._client.get_lock(name))
-        return self._locks[key]
+        return AsyncLock(self._client.get_lock(name))
 
     def get_fair_lock(self, name: str) -> AsyncLock:
-        key = ("fair", name)
-        if key not in self._locks:
-            self._locks[key] = AsyncLock(self._client.get_fair_lock(name))
-        return self._locks[key]
+        return AsyncLock(self._client.get_fair_lock(name))
 
-    def get_read_write_lock(self, name: str) -> AsyncProxy:
-        rw = self._client.get_read_write_lock(name)
-        return AsyncProxy(rw)
+    def get_read_write_lock(self, name: str) -> AsyncReadWriteLock:
+        return AsyncReadWriteLock(self._client.get_read_write_lock(name))
 
     def get_semaphore(self, name: str) -> AsyncProxy:
         return AsyncProxy(self._client.get_semaphore(name))
@@ -288,9 +292,6 @@ class RedissonTPUReactive:
         return self._client
 
     async def shutdown(self):
-        for lock in self._locks.values():
-            lock.close()
-        self._locks.clear()
         await asyncio.to_thread(self._client.shutdown)
 
     async def __aenter__(self):

@@ -178,6 +178,7 @@ class StructureBackend(ExtendedOps):
         self._waiters: Dict[str, deque] = {}  # key -> Waiter FIFO
         self._waiter_ids = itertools.count(1)
         self._lock = threading.Lock()  # guards reads from non-dispatcher threads
+        self._scripts: Dict[str, Callable] = {}  # sha -> fn (SCRIPT cache)
 
     # -- dispatch (same contract as TpuBackend.run) --------------------------
 
@@ -291,6 +292,38 @@ class StructureBackend(ExtendedOps):
             op.future.set_result(-1)
         else:
             op.future.set_result(max(0, kv.expire_at - now_ms()))
+
+    # -- scripting (RScript / Lua-EVAL analogue) ------------------------------
+
+    def _op_script_load(self, key: str, op: Op) -> None:
+        from redisson_tpu.models.script import script_sha
+
+        fn = op.payload["fn"]
+        sha = script_sha(fn)
+        self._scripts[sha] = fn
+        op.future.set_result(sha)
+
+    def _op_script_exists(self, key: str, op: Op) -> None:
+        op.future.set_result([s in self._scripts for s in op.payload["shas"]])
+
+    def _op_script_flush(self, key: str, op: Op) -> None:
+        self._scripts.clear()
+        op.future.set_result(None)
+
+    def _op_script_eval(self, key: str, op: Op) -> None:
+        """Runs the function on the dispatcher thread — atomic against every
+        other op, the Lua-inside-Redis guarantee."""
+        from redisson_tpu.models.script import ScriptContext, script_sha
+
+        p = op.payload
+        fn = p.get("fn")
+        if fn is None:
+            fn = self._scripts.get(p["sha"])
+            if fn is None:
+                raise ValueError(f"NOSCRIPT no script with sha {p['sha']}")
+        else:
+            self._scripts.setdefault(script_sha(fn), fn)
+        op.future.set_result(fn(ScriptContext(self), p["keys"], p["args"]))
 
     def _op_rename(self, key: str, op: Op) -> None:
         kv = self._entry(key)

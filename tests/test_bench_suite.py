@@ -1,0 +1,34 @@
+"""Smoke: every BASELINE config runs end-to-end at tiny scale and meets its
+structural invariants (the full-scale numbers come from the driver run)."""
+
+import os
+import subprocess
+import sys
+import json
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("config", [1, 2, 3, 4, 5])
+def test_config_smoke(config):
+    env = dict(os.environ, RTPU_BENCH_TINY="1",
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "suite.py"),
+         "--config", str(config)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["config"] == config
+    if config == 1:
+        assert result["engine"]["error"] < 0.02
+        assert result["redis"]["error"] < 0.02
+    if config == 2:
+        assert result["measured_fpr"] < 0.02
+    if config == 5:
+        assert result["error"] < 0.05
+        assert result["devices"] == 8

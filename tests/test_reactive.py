@@ -148,9 +148,10 @@ def test_batch_async(rx):
     run(go())
 
 
-def test_async_lock_thread_affinity(rx):
-    # Saturate the default to_thread pool with other work while locking:
-    # lock/unlock must still pair on one thread (pinned executor).
+def test_async_lock_owner_is_per_task_not_per_thread(rx):
+    # Lock ops run via a shared to_thread pool; ownership must follow the
+    # asyncio TASK (owner_context), not whichever worker thread serves the
+    # call. Acquire/release inside one task while the pool churns.
     async def go():
         async def churn(i):
             b = rx.get_bucket(f"rx:churn{i}")
@@ -158,13 +159,49 @@ def test_async_lock_thread_affinity(rx):
             return await b.get()
 
         lock = rx.get_lock("rx:aff")
-        for _ in range(5):
-            results, _ = await asyncio.gather(
-                asyncio.gather(*(churn(i) for i in range(16))),
-                lock.lock())
-            assert await lock.is_locked()
-            await lock.unlock()
-            assert not await lock.is_locked()
+
+        async def lock_cycle():
+            for _ in range(5):
+                await lock.lock()
+                assert await lock.is_locked()
+                await lock.unlock()
+
+        await asyncio.gather(lock_cycle(),
+                             asyncio.gather(*(churn(i) for i in range(16))))
+        assert not await lock.is_locked()
+    run(go())
+
+
+def test_async_lock_mutual_exclusion_between_tasks(rx):
+    # Two tasks sharing ONE AsyncLock instance must exclude each other —
+    # the regression where a pinned thread gave every task the same owner.
+    async def go():
+        lock = rx.get_lock("rx:mx")
+        inside = []
+
+        async def critical(tag):
+            async with lock:
+                inside.append(tag)
+                assert len(inside) == 1, "both tasks inside the lock!"
+                await asyncio.sleep(0.05)
+                inside.remove(tag)
+
+        await asyncio.gather(critical("a"), critical("b"))
+        assert not await lock.is_locked()
+    run(go())
+
+
+def test_async_rw_lock(rx):
+    async def go():
+        rw = rx.get_read_write_lock("rx:rw")
+        r = rw.read_lock()
+        await r.lock()
+        assert await r.is_locked()
+        await r.unlock()
+        w = rw.write_lock()
+        async with w:
+            assert await w.is_locked()
+        assert not await w.is_locked()
     run(go())
 
 
@@ -180,6 +217,14 @@ def test_map_cache_async_iteration(rx):
     run(go())
 
 
-def test_get_lock_reuses_instance(rx):
-    assert rx.get_lock("same") is rx.get_lock("same")
-    assert rx.get_lock("same") is not rx.get_fair_lock("same")
+def test_lock_instances_share_ownership_by_task(rx):
+    # Fresh AsyncLock proxies over the same name still agree on ownership
+    # (owner = client:task, not instance identity).
+    async def go():
+        a = rx.get_lock("same")
+        b = rx.get_lock("same")
+        await a.lock()
+        assert await b.is_locked()
+        await b.unlock()  # same task, same owner -> valid release
+        assert not await a.is_locked()
+    run(go())

@@ -1,0 +1,172 @@
+"""Redis passthrough mode: the client executes every op via RESP against a
+server (the reference's execution model), tested against the embedded fake."""
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.backend_redis import UnsupportedInRedisMode
+from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+
+@pytest.fixture()
+def rclient():
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        c = RedissonTPU.create(cfg)
+        try:
+            yield c
+        finally:
+            c.shutdown()
+
+
+def test_bucket_over_redis(rclient):
+    b = rclient.get_bucket("rm:b")
+    assert b.get() is None
+    b.set({"x": 1})
+    assert b.get() == {"x": 1}
+    assert not b.try_set("other")      # exists
+    assert b.get_and_set(2) == {"x": 1}
+    assert b.get() == 2
+    assert b.delete()
+    assert b.get() is None
+
+
+def test_atomic_long_over_redis(rclient):
+    al = rclient.get_atomic_long("rm:ctr")
+    assert al.get() == 0
+    assert al.increment_and_get() == 1
+    assert al.add_and_get(10) == 11
+    assert al.get_and_set(5) == 11
+    assert al.get() == 5
+    assert al.compare_and_set(5, 7)
+    assert not al.compare_and_set(5, 9)
+    assert al.get() == 7
+
+
+def test_map_over_redis(rclient):
+    m = rclient.get_map("rm:map")
+    assert m.put("a", 1) is None
+    assert m.put("a", 2) == 1          # old value comes back
+    assert m.get("a") == 2
+    assert m.put_if_absent("a", 9) == 2
+    assert m.put_if_absent("b", 3) is None
+    assert m.size() == 2
+    assert sorted(m.key_set()) == ["a", "b"]
+    assert m.contains_key("a")
+    m.put_all({"c": 4, "d": 5})
+    assert m.get_all(["c", "d"]) == {"c": 4, "d": 5}
+    assert m.remove("a") == 2
+    assert m.size() == 3
+    assert m.add_and_get("n", 5) == 5
+
+
+def test_set_list_over_redis(rclient):
+    s = rclient.get_set("rm:set")
+    assert s.add("x")
+    assert not s.add("x")
+    assert s.contains("x")
+    assert s.size() == 1
+    assert s.read_all() == {"x"}
+    assert s.remove("x")
+
+    lst = rclient.get_list("rm:list")
+    lst.add("a")
+    lst.add_all(["b", "c"])
+    assert lst.size() == 3
+    assert lst.get(0) == "a"
+    assert lst.read_all() == ["a", "b", "c"]
+    lst.set(1, "B")
+    assert lst.get(1) == "B"
+    assert lst.remove("B")
+
+    q = rclient.get_queue("rm:q")
+    q.offer("1")
+    q.offer("2")
+    assert q.poll() == "1"
+    assert q.poll() == "2"
+    assert q.poll() is None
+
+
+def test_scored_sorted_set_over_redis(rclient):
+    z = rclient.get_scored_sorted_set("rm:z")
+    z.add(3.0, "c")
+    z.add(1.0, "a")
+    z.add(2.0, "b")
+    assert z.get_score("a") == 1.0
+    assert z.size() == 3
+    assert [m for m in z.value_range(0, -1)] == ["a", "b", "c"]
+    assert z.add_score("a", 5.0) == 6.0
+    assert z.remove("b")
+    assert z.size() == 2
+
+
+def test_bitset_over_redis(rclient):
+    bs = rclient.get_bit_set("rm:bits")
+    assert not bs.set(7)      # returns old value
+    assert bs.set(7)
+    assert bs.get(7)
+    assert not bs.get(8)
+    assert bs.cardinality() == 1
+    bs.set(100)
+    assert bs.cardinality() == 2
+    assert bool(bs.clear_bits([7])[0])  # old value was set
+    assert bs.cardinality() == 1
+
+
+def test_hll_over_redis(rclient):
+    h = rclient.get_hyper_log_log("rm:hll")
+    assert h.add(b"one")
+    h.add_all([b"k%d" % i for i in range(5000)])
+    est = h.count()
+    assert abs(est - 5001) / 5001 < 0.05
+    h2 = rclient.get_hyper_log_log("rm:hll2")
+    h2.add_all([b"j%d" % i for i in range(100)])
+    assert h.count_with("rm:hll2") >= est
+    h.merge_with("rm:hll2")
+    assert h.count() >= est
+
+
+def test_expiry_over_redis(rclient):
+    b = rclient.get_bucket("rm:ttl")
+    b.set("v")
+    assert b.expire(60)
+    assert 0 < b.remain_time_to_live() <= 60_000
+    assert b.clear_expire()
+    assert b.remain_time_to_live() == -1
+
+
+def test_keys_facade_over_redis(rclient):
+    rclient.get_bucket("rm:k1").set(1)
+    rclient.get_bucket("rm:k2").set(2)
+    assert set(rclient.keys("rm:k*")) == {"rm:k1", "rm:k2"}
+    assert rclient.delete("rm:k1")
+    rclient.flushall()
+    assert rclient.keys() == []
+
+
+def test_unsupported_ops_raise_cleanly(rclient):
+    with pytest.raises(NotImplementedError):
+        rclient.get_lock("rm:lock")
+    with pytest.raises(NotImplementedError):
+        rclient.get_topic("rm:topic")
+    with pytest.raises(UnsupportedInRedisMode):
+        rclient.get_blocking_queue("rm:bq").take()
+
+
+def test_metrics_work_in_redis_mode(rclient):
+    rclient.get_bucket("rm:m").set(1)
+    assert rclient.metrics.counter("executor.ops_total") >= 1
+
+
+def test_reversed_zrange_matches_engine_semantics(rclient):
+    z = rclient.get_scored_sorted_set("rm:zrev")
+    z.add(1.0, "a")
+    z.add(2.0, "b")
+    z.add(3.0, "c")
+    # engine contract: reverse THEN slice
+    assert z.value_range(0, 0, reversed=True) == ["c"]
+    assert z.value_range(0, 1, reversed=True) == ["c", "b"]
+    assert z.value_range(-1, -1, reversed=True) == ["a"]
+    assert z.add_all([]) == 0  # empty ZADD must not hit the wire

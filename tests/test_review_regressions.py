@@ -53,10 +53,14 @@ def test_clear_single_bit_overload(client):
     assert bs.get(5) is True
 
 
-def test_redis_only_mode_rejected():
+def test_redis_only_mode_unreachable_server_fails_fast():
+    # redis mode is implemented now; with nothing listening the constructor
+    # must surface a connection error, not hang or half-initialize.
     cfg = Config()
-    cfg.use_redis()
-    with pytest.raises(NotImplementedError):
+    cfg.use_redis().address = "redis://127.0.0.1:1"  # reserved port, closed
+    cfg.redis.timeout_ms = 200
+    cfg.redis.retry_attempts = 0
+    with pytest.raises((ConnectionError, OSError)):
         RedissonTPU.create(cfg)
 
 

@@ -1,0 +1,167 @@
+"""RScript atomic-scripting tests + codec matrix (RedissonScript /
+RedissonCodecTest analogues)."""
+
+import threading
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.codecs import (CompressionCodec, JsonCodec, MsgPackCodec,
+                                 PickleCodec, get_codec)
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTPU.create()
+    yield c
+    c.shutdown()
+
+
+def test_eval_basic(client):
+    script = client.get_script()
+
+    def put_and_count(ctx, keys, args):
+        ctx.set(keys[0], args[0])
+        return len(ctx.keys("s:*"))
+
+    assert script.eval(put_and_count, keys=["s:a"], args=["v1"]) == 1
+    assert script.eval(put_and_count, keys=["s:b"], args=["v2"]) == 2
+    assert client.get_bucket("s:a", codec="string").get() == "v1"
+
+
+def test_script_load_evalsha(client):
+    script = client.get_script()
+
+    def double(ctx, keys, args):
+        return ctx.incr(keys[0], int(args[0]))
+
+    sha = script.script_load(double)
+    assert script.script_exists(sha) == [True]
+    assert script.evalsha(sha, keys=["s:ctr"], args=[5]) == 5
+    assert script.evalsha(sha, keys=["s:ctr"], args=[3]) == 8
+    script.script_flush()
+    assert script.script_exists(sha) == [False]
+    with pytest.raises(ValueError, match="NOSCRIPT"):
+        script.evalsha(sha, keys=["s:ctr"], args=[1])
+
+
+def test_script_atomicity_under_concurrency(client):
+    # The classic check-then-act that data-races without atomicity: N threads
+    # transfer from one account; balance must never go negative.
+    script = client.get_script()
+    client.get_bucket("s:acct", codec="string").set("100")
+
+    def withdraw(ctx, keys, args):
+        bal = int(ctx.get(keys[0]) or 0)
+        amount = int(args[0])
+        if bal < amount:
+            return False
+        ctx.set(keys[0], str(bal - amount))
+        return True
+
+    sha = script.script_load(withdraw)
+    results = []
+
+    def worker():
+        for _ in range(10):
+            results.append(script.evalsha(sha, keys=["s:acct"], args=[7]))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = int(client.get_bucket("s:acct", codec="string").get())
+    granted = sum(1 for r in results if r)
+    assert final == 100 - 7 * granted
+    assert final >= 0
+
+
+def test_script_sees_structures(client):
+    client.get_map("s:m").put("k", "v")
+
+    def read_map(ctx, keys, args):
+        return ctx.hgetall(keys[0])
+
+    raw = client.get_script().eval(read_map, keys=["s:m"])
+    assert len(raw) == 1
+
+
+def test_script_error_propagates(client):
+    def boom(ctx, keys, args):
+        raise RuntimeError("script exploded")
+
+    with pytest.raises(RuntimeError, match="script exploded"):
+        client.get_script().eval(boom)
+
+
+def test_script_unavailable_in_redis_mode():
+    from redisson_tpu.config import Config
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        c = RedissonTPU.create(cfg)
+        try:
+            with pytest.raises(NotImplementedError):
+                c.get_script()
+        finally:
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Codec matrix
+# ---------------------------------------------------------------------------
+
+SAMPLES = [
+    {"nested": {"list": [1, 2.5, "x"], "flag": True}},
+    [1, 2, 3],
+    "plain string",
+    42,
+]
+
+
+@pytest.mark.parametrize("name", ["json", "pickle", "zlib", "msgpack"])
+def test_codec_roundtrips(name):
+    codec = get_codec(name)
+    for sample in SAMPLES:
+        assert codec.decode(codec.encode(sample)) == sample
+
+
+def test_compression_codec_shrinks():
+    codec = CompressionCodec(JsonCodec())
+    value = {"k": "abc" * 1000}
+    assert len(codec.encode(value)) < len(JsonCodec().encode(value))
+    assert codec.decode(codec.encode(value)) == value
+
+
+def test_gated_codec_clear_error():
+    # cbor2/lz4/snappy are not in this image: must raise a helpful ValueError
+    for name in ("cbor", "lz4", "snappy"):
+        with pytest.raises(ValueError, match="optional package"):
+            get_codec(name)
+
+
+def test_objects_with_custom_codec(client):
+    m = client.get_map("cdc:m", codec=MsgPackCodec())
+    m.put("k", {"a": [1, 2]})
+    assert m.get("k") == {"a": [1, 2]}
+    b = client.get_bucket("cdc:b", codec=get_codec("zlib"))
+    b.set({"big": "x" * 5000})
+    assert b.get() == {"big": "x" * 5000}
+
+
+def test_script_sha_distinguishes_closures(client):
+    script = client.get_script()
+
+    def make(n):
+        def f(ctx, keys, args):
+            return n
+        return f
+
+    sha1 = script.script_load(make(1))
+    sha2 = script.script_load(make(2))
+    assert sha1 != sha2
+    assert script.evalsha(sha1) == 1
+    assert script.evalsha(sha2) == 2

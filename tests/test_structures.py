@@ -379,9 +379,9 @@ def test_expirable_surface(client):
     m = client.get_map("exp")
     m.fast_put("a", 1)
     assert m.remain_time_to_live() == -1
-    assert m.expire(0.05)
+    assert m.expire(2.0)
     ttl = m.remain_time_to_live()
-    assert 0 < ttl <= 50
+    assert 0 < ttl <= 2000
     assert m.clear_expire()
     assert m.remain_time_to_live() == -1
     assert m.expire(0.03)
