@@ -14,7 +14,14 @@ from typing import Any, List
 
 from redisson_tpu.models.bitset import RBitSet
 from redisson_tpu.models.bloomfilter import RBloomFilter
+from redisson_tpu.models.bucket import RAtomicDouble, RAtomicLong, RBucket
+from redisson_tpu.models.collections import RList, RSet
+from redisson_tpu.models.geo import RGeo
 from redisson_tpu.models.hyperloglog import RHyperLogLog
+from redisson_tpu.models.map import RMap
+from redisson_tpu.models.multimap import RListMultimap, RSetMultimap
+from redisson_tpu.models.queue import RDeque, RQueue
+from redisson_tpu.models.scoredsortedset import RLexSortedSet, RScoredSortedSet
 
 
 class _StagingExecutor:
@@ -59,6 +66,48 @@ class RBatch:
 
     def get_bloom_filter(self, name: str) -> RBloomFilter:
         return RBloomFilter(name, self._staging, self._codec, self._widths)
+
+    # -- structure-tier clones (reference RedissonBatch covers every object
+    #    family; only async staging methods are usable, as there) ------------
+
+    def get_bucket(self, name: str) -> RBucket:
+        return RBucket(name, self._staging, self._codec, self._widths)
+
+    def get_atomic_long(self, name: str) -> RAtomicLong:
+        return RAtomicLong(name, self._staging, self._codec, self._widths)
+
+    def get_atomic_double(self, name: str) -> RAtomicDouble:
+        return RAtomicDouble(name, self._staging, self._codec, self._widths)
+
+    def get_map(self, name: str) -> RMap:
+        return RMap(name, self._staging, self._codec, self._widths)
+
+    def get_set(self, name: str) -> RSet:
+        return RSet(name, self._staging, self._codec, self._widths)
+
+    def get_list(self, name: str) -> RList:
+        return RList(name, self._staging, self._codec, self._widths)
+
+    def get_queue(self, name: str) -> RQueue:
+        return RQueue(name, self._staging, self._codec, self._widths)
+
+    def get_deque(self, name: str) -> RDeque:
+        return RDeque(name, self._staging, self._codec, self._widths)
+
+    def get_scored_sorted_set(self, name: str) -> RScoredSortedSet:
+        return RScoredSortedSet(name, self._staging, self._codec, self._widths)
+
+    def get_lex_sorted_set(self, name: str) -> RLexSortedSet:
+        return RLexSortedSet(name, self._staging, self._codec, self._widths)
+
+    def get_set_multimap(self, name: str) -> RSetMultimap:
+        return RSetMultimap(name, self._staging, self._codec, self._widths)
+
+    def get_list_multimap(self, name: str) -> RListMultimap:
+        return RListMultimap(name, self._staging, self._codec, self._widths)
+
+    def get_geo(self, name: str) -> RGeo:
+        return RGeo(name, self._staging, self._codec, self._widths)
 
     def execute(self) -> List[Any]:
         """Dispatch all staged ops; results in staging order."""

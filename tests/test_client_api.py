@@ -244,3 +244,25 @@ class TestConfig:
         cfg.use_tpu()
         with pytest.raises(ValueError):
             cfg.mode()
+
+
+def test_batch_covers_structure_objects(client):
+    # Reference RedissonBatch clones every object family; mixed staged ops
+    # resolve in staging order.
+    b = client.create_batch()
+    b.get_bucket("bt:b").set_async(1)
+    b.get_map("bt:m").put_async("k", "v")
+    b.get_atomic_long("bt:a").increment_and_get_async()
+    b.get_set("bt:s").add_async("x")
+    b.get_list("bt:l").add_async("item")
+    b.get_scored_sorted_set("bt:z").add_async(1.5, "m")
+    b.get_hyper_log_log("bt:h").add_all_async([b"1", b"2"])
+    results = b.execute()
+    assert len(results) == 7
+    assert client.get_bucket("bt:b").get() == 1
+    assert client.get_map("bt:m").get("k") == "v"
+    assert client.get_atomic_long("bt:a").get() == 1
+    assert client.get_set("bt:s").contains("x")
+    assert client.get_list("bt:l").get(0) == "item"
+    assert client.get_scored_sorted_set("bt:z").get_score("m") == 1.5
+    assert client.get_hyper_log_log("bt:h").count() == 2
