@@ -188,26 +188,87 @@ class BatchCollector:
     def __init__(self, executor: CommandExecutor):
         self._executor = executor
         self._staged: List[tuple] = []
+        self._futures: List["StagedFuture"] = []
         self._executed = False
 
-    def add(self, target: str, kind: str, payload: Any, nkeys: int = 0) -> int:
-        """Stage an op; returns its batch index."""
+    def add(self, target: str, kind: str, payload: Any, nkeys: int = 0) -> "StagedFuture":
+        """Stage an op; returns its placeholder future (resolved at execute)."""
         if self._executed:
             raise RuntimeError("batch already executed")
         self._staged.append((target, kind, payload, nkeys))
-        return len(self._staged) - 1
+        f = StagedFuture()
+        self._futures.append(f)
+        return f
 
-    def execute(self) -> List[Any]:
+    def _dispatch(self) -> List[Future]:
         if self._executed:
             raise RuntimeError("batch already executed")
         self._executed = True
-        futures = [
+        for f in self._futures:
+            f._dispatched = True
+        inner = [
             self._executor.execute_async(t, k, p, n) for (t, k, p, n) in self._staged
         ]
-        return [f.result() for f in futures]
+        for staged, src in zip(self._futures, inner):
+            src.add_done_callback(staged._resolve_from)
+        return inner
+
+    def execute(self) -> List[Any]:
+        """Dispatch all staged ops; decoded results in global-index order.
+
+        Per-op decode chains registered via `map_future` fire off the staged
+        futures, so the returned list carries the same values the async
+        getters' futures resolve to (reference: converted batch replies,
+        `CommandBatchService.java:163-174`)."""
+        inner = self._dispatch()
+        for f in inner:
+            # Propagate the first failure like the reference's batch promise.
+            f.result()
+        return [f.outermost().result() for f in self._futures]
 
     def execute_async(self) -> List[Future]:
-        if self._executed:
-            raise RuntimeError("batch already executed")
-        self._executed = True
-        return [self._executor.execute_async(t, k, p, n) for (t, k, p, n) in self._staged]
+        """Dispatch staged ops; returns the decoded per-op futures in order."""
+        self._dispatch()
+        return [f.outermost() for f in self._futures]
+
+
+class StagedFuture(Future):
+    """RBatch placeholder: a real Future resolved only at execute() time.
+
+    Calling result() before the batch is dispatched raises (the reference's
+    batch commands cannot be awaited before `RBatch.execute()` either)
+    instead of deadlocking; after dispatch it blocks normally until the
+    dispatcher thread resolves it. Waiting on an un-dispatched StagedFuture
+    through a raw waiter (asyncio.wrap_future, futures.wait) will block
+    until execute() is called — use result()/the batch return value instead.
+    Decode wrappers chained by `map_future` register themselves via
+    `_note_mapped` so the batch can return decoded values.
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._dispatched = False
+        self._mapped: Future = self
+
+    def result(self, timeout=None):
+        if not self._dispatched and not self.done():
+            raise RuntimeError("batch not executed yet; call RBatch.execute()")
+        return super().result(timeout)
+
+    def _resolve_from(self, src: Future) -> None:
+        if src.cancelled():
+            self.cancel()
+            self.set_running_or_notify_cancel()
+            return
+        exc = src.exception()
+        if exc is not None:
+            self.set_exception(exc)
+        else:
+            self.set_result(src.result())
+
+    def _note_mapped(self, fut: Future) -> None:
+        self._mapped = fut
+
+    def outermost(self) -> Future:
+        """The outermost decode wrapper (or self if none was chained)."""
+        return self._mapped

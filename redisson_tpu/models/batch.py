@@ -26,29 +26,20 @@ from redisson_tpu.models.scoredsortedset import RLexSortedSet, RScoredSortedSet
 
 class _StagingExecutor:
     """Executor facade that stages into a BatchCollector instead of
-    dispatching; async methods return the batch index as a placeholder."""
+    dispatching; async methods return a `StagedFuture` placeholder that the
+    collector resolves in global-index order at execute() time."""
 
     def __init__(self, collector):
         self._collector = collector
 
     def execute_async(self, target, kind, payload, nkeys=0):
-        return _Staged(self._collector.add(target, kind, payload, nkeys))
+        return self._collector.add(target, kind, payload, nkeys)
 
     def execute_sync(self, target, kind, payload, nkeys=0):
         raise RuntimeError(
             "sync calls are not allowed on batch objects; stage with the "
             "async variants and call execute()"
         )
-
-
-class _Staged:
-    """Placeholder future: resolves only after RBatch.execute()."""
-
-    def __init__(self, index: int):
-        self.index = index
-
-    def result(self, timeout=None):
-        raise RuntimeError("batch not executed yet; call RBatch.execute()")
 
 
 class RBatch:

@@ -78,69 +78,135 @@ class RBuckets:
 
 
 class RAtomicLong(RExpirable):
+    """Reference: `RedissonAtomicLong.java` (+ `core/RAtomicLongAsync`)."""
+
     def get(self) -> int:
-        return int(self._executor.execute_sync(self.name, "num_get", {}))
+        return self.get_async().result()
+
+    def get_async(self):
+        f = self._executor.execute_async(self.name, "num_get", {})
+        return _map_future(f, int)
 
     def set(self, value: int) -> None:
-        self._executor.execute_sync(self.name, "set", {"value": str(int(value)).encode()})
+        self.set_async(value).result()
+
+    def set_async(self, value: int):
+        return self._executor.execute_async(
+            self.name, "set", {"value": str(int(value)).encode()}
+        )
 
     def increment_and_get(self) -> int:
         return self.add_and_get(1)
 
+    def increment_and_get_async(self):
+        return self.add_and_get_async(1)
+
     def decrement_and_get(self) -> int:
         return self.add_and_get(-1)
 
+    def decrement_and_get_async(self):
+        return self.add_and_get_async(-1)
+
     def add_and_get(self, delta: int) -> int:
-        return int(self._executor.execute_sync(self.name, "incr", {"by": int(delta)}))
+        return self.add_and_get_async(delta).result()
+
+    def add_and_get_async(self, delta: int):
+        f = self._executor.execute_async(self.name, "incr", {"by": int(delta)})
+        return _map_future(f, int)
 
     def get_and_increment(self) -> int:
         return self.add_and_get(1) - 1
 
+    def get_and_increment_async(self):
+        return _map_future(self.add_and_get_async(1), lambda v: v - 1)
+
     def get_and_decrement(self) -> int:
         return self.add_and_get(-1) + 1
+
+    def get_and_decrement_async(self):
+        return _map_future(self.add_and_get_async(-1), lambda v: v + 1)
 
     def get_and_add(self, delta: int) -> int:
         return self.add_and_get(delta) - int(delta)
 
+    def get_and_add_async(self, delta: int):
+        return _map_future(self.add_and_get_async(delta), lambda v: v - int(delta))
+
     def get_and_set(self, value: int) -> int:
-        return int(self._executor.execute_sync(self.name, "num_getandset", {"value": int(value)}))
+        return self.get_and_set_async(value).result()
+
+    def get_and_set_async(self, value: int):
+        f = self._executor.execute_async(self.name, "num_getandset", {"value": int(value)})
+        return _map_future(f, int)
 
     def compare_and_set(self, expect: int, update: int) -> bool:
-        return self._executor.execute_sync(
+        return self.compare_and_set_async(expect, update).result()
+
+    def compare_and_set_async(self, expect: int, update: int):
+        return self._executor.execute_async(
             self.name, "num_cas", {"expect": int(expect), "update": int(update)}
         )
 
 
 class RAtomicDouble(RExpirable):
+    """Reference: `RedissonAtomicDouble.java` (INCRBYFLOAT semantics)."""
+
     def get(self) -> float:
-        return float(self._executor.execute_sync(self.name, "num_get", {"float": True}))
+        return self.get_async().result()
+
+    def get_async(self):
+        f = self._executor.execute_async(self.name, "num_get", {"float": True})
+        return _map_future(f, float)
 
     def set(self, value: float) -> None:
-        self._executor.execute_sync(self.name, "set", {"value": repr(float(value)).encode()})
+        self.set_async(value).result()
+
+    def set_async(self, value: float):
+        return self._executor.execute_async(
+            self.name, "set", {"value": repr(float(value)).encode()}
+        )
 
     def add_and_get(self, delta: float) -> float:
-        return float(
-            self._executor.execute_sync(self.name, "incr", {"by": float(delta), "float": True})
+        return self.add_and_get_async(delta).result()
+
+    def add_and_get_async(self, delta: float):
+        f = self._executor.execute_async(
+            self.name, "incr", {"by": float(delta), "float": True}
         )
+        return _map_future(f, float)
 
     def increment_and_get(self) -> float:
         return self.add_and_get(1.0)
 
+    def increment_and_get_async(self):
+        return self.add_and_get_async(1.0)
+
     def decrement_and_get(self) -> float:
         return self.add_and_get(-1.0)
+
+    def decrement_and_get_async(self):
+        return self.add_and_get_async(-1.0)
 
     def get_and_add(self, delta: float) -> float:
         return self.add_and_get(delta) - float(delta)
 
+    def get_and_add_async(self, delta: float):
+        return _map_future(self.add_and_get_async(delta), lambda v: v - float(delta))
+
     def get_and_set(self, value: float) -> float:
-        return float(
-            self._executor.execute_sync(
-                self.name, "num_getandset", {"value": float(value), "float": True}
-            )
+        return self.get_and_set_async(value).result()
+
+    def get_and_set_async(self, value: float):
+        f = self._executor.execute_async(
+            self.name, "num_getandset", {"value": float(value), "float": True}
         )
+        return _map_future(f, float)
 
     def compare_and_set(self, expect: float, update: float) -> bool:
-        return self._executor.execute_sync(
+        return self.compare_and_set_async(expect, update).result()
+
+    def compare_and_set_async(self, expect: float, update: float):
+        return self._executor.execute_async(
             self.name,
             "num_cas",
             {"expect": float(expect), "update": float(update), "float": True},

@@ -31,6 +31,13 @@ def map_future(f, fn):
                 out.set_exception(e)
 
     f.add_done_callback(done)
+    note = getattr(f, "_note_mapped", None)
+    if note is not None:
+        # Staged batch future: register the decode wrapper so
+        # RBatch.execute() can return decoded values; forward the hook so
+        # chained decodes keep pointing at the same batch slot.
+        note(out)
+        out._note_mapped = note
     return out
 
 
