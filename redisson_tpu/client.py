@@ -184,13 +184,21 @@ class RedissonTPU:
         """Snapshot sketch state to a local checkpoint directory."""
         from redisson_tpu import checkpoint
 
+        self._require_store("checkpointing")
         return checkpoint.save(self._store, path, names)
 
     def load_checkpoint(self, path: str, names=None) -> int:
         """Restore sketch state from a local checkpoint directory."""
         from redisson_tpu import checkpoint
 
+        self._require_store("checkpointing")
         return checkpoint.load(self._store, path, names)
+
+    def _require_store(self, feature: str) -> None:
+        if self._store is None:
+            raise NotImplementedError(
+                f"{feature} needs a device-resident store; not available in "
+                "redis passthrough mode")
 
     @classmethod
     def create(cls, config: Optional[Config] = None) -> "RedissonTPU":

@@ -8,9 +8,11 @@ so a real Redis can read the flushed values natively:
   hll     -> SET name <dense HYLL blob>          (hyll.encode_dense; a real
              server's PFCOUNT/PFMERGE work on it directly)
   bitset  -> SET name <packed bytes, Redis SETBIT bit order>
-  bloom   -> SET name <packed bit array> + HSET name__config size/
+  bloom   -> SET name <packed bit array> + HSET {name}__config size/
              hashIterations/expectedInsertions/falseProbability — the same
-             sidecar-hash convention as RedissonBloomFilter.java:254-256.
+             sidecar-hash convention as RedissonBloomFilter.java:254-256
+             (hashtag braces keep the config on the key's slot in cluster
+             mode, and a real Redisson client looks it up under that key).
 
 Import reverses each mapping. The periodic flusher runs on a daemon thread
 with an adaptive interval floor, mirroring EvictionScheduler's pacing idea
@@ -31,6 +33,13 @@ from redisson_tpu.native import RespError
 from redisson_tpu.store import ObjectType, SketchStore
 
 BLOOM_CONFIG_SUFFIX = "__config"
+
+
+def bloom_config_key(name: str) -> str:
+    """`{name}__config` — the reference's sidecar key with hashtag braces
+    (RedissonBloomFilter.java:254-256): same slot as `name`, and the key a
+    real Redisson client reads the config from."""
+    return "{" + name + "}" + BLOOM_CONFIG_SUFFIX
 
 
 class DurabilityManager:
@@ -64,7 +73,7 @@ class DurabilityManager:
         if obj.otype == ObjectType.BLOOM:
             packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
             meta = obj.meta or {}
-            cfg: List = ["HSET", key + BLOOM_CONFIG_SUFFIX]
+            cfg: List = ["HSET", self.prefix + bloom_config_key(name)]
             # snake_case store meta -> the reference's camelCase hash fields
             # ({name}__config, RedissonBloomFilter.java:254-256)
             for field, wire in (("size", "size"),
@@ -145,7 +154,8 @@ class DurabilityManager:
         raw = self.client.execute("GET", key)
         if raw is None:
             return False
-        cfg_pairs = self.client.execute("HGETALL", key + BLOOM_CONFIG_SUFFIX)
+        cfg_pairs = self.client.execute(
+            "HGETALL", self.prefix + bloom_config_key(name))
         wire_to_meta = {"size": "size", "hashIterations": "hash_iterations",
                         "expectedInsertions": "expected_insertions",
                         "falseProbability": "false_probability"}

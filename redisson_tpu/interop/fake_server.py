@@ -146,6 +146,10 @@ class FakeRedisServer:
         for k in [k for k, ts in self.expires.items() if ts <= now]:
             self.expires.pop(k, None)
             self.data.pop(k, None)
+        # Drop orphaned deadlines (key deleted by a path that didn't pop its
+        # expiry): real Redis never lets a re-created key inherit an old TTL.
+        for k in [k for k in self.expires if k not in self.data]:
+            self.expires.pop(k, None)
 
     def _cmd_ping(self, a):
         return _bulk(a[0]) if a else b"+PONG\r\n"
@@ -161,6 +165,7 @@ class FakeRedisServer:
 
     def _cmd_flushall(self, a):
         self.data.clear()
+        self.expires.clear()
         return _ok()
 
     def _cmd_set(self, a):

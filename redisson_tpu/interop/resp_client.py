@@ -33,6 +33,27 @@ class ConnectionClosed(ConnectionError):
     pass
 
 
+class PossiblyExecuted(TimeoutError):
+    """A non-idempotent command timed out AFTER the write: the server may
+    have applied it, so blind retry could double-apply (e.g. INCRBY). The
+    caller decides whether to probe state or re-issue."""
+
+
+# Commands whose re-execution changes state a second time. A response
+# timeout after the write retries everything else (SET/SETBIT/HSET/... are
+# idempotent overwrites); these raise PossiblyExecuted instead. Scripts
+# (EVAL/EVALSHA) are included: lock/semaphore scripts mutate counters.
+NON_IDEMPOTENT = frozenset({
+    "INCR", "INCRBY", "INCRBYFLOAT", "DECR", "DECRBY",
+    "HINCRBY", "HINCRBYFLOAT", "ZINCRBY",
+    "APPEND", "LPUSH", "RPUSH", "LPUSHX", "RPUSHX",
+    "LPOP", "RPOP", "BLPOP", "BRPOP", "SPOP", "RPOPLPUSH", "BRPOPLPUSH",
+    "GETSET", "SETNX", "HSETNX", "MSETNX", "GETDEL",
+    "EVAL", "EVALSHA", "PFADD", "SADD", "SREM", "ZADD", "ZREM",
+    "PUBLISH", "XADD",
+})
+
+
 class RespClient:
     """One logical Redis connection with auto-reconnect and retries."""
 
@@ -179,7 +200,16 @@ class RespClient:
         return await asyncio.wait_for(fut, self.timeout)
 
     async def execute(self, *args) -> Any:
-        """Send with the retry policy; reconnects between attempts."""
+        """Send with the retry policy; reconnects between attempts.
+
+        Connect/write failures retry freely (the command never reached the
+        server). A response timeout AFTER the write retries only idempotent
+        commands; non-idempotent ones (NON_IDEMPOTENT) raise
+        PossiblyExecuted, since the original may have been applied
+        (cf. command/CommandAsyncService.java:476-512, which retries
+        unconditionally — at-least-once; we tighten that)."""
+        name = str(args[0]).upper() if args else ""
+        retry_on_timeout = name not in NON_IDEMPOTENT
         last: Exception = ConnectionClosed("never connected")
         for attempt in range(self.retry_attempts + 1):
             if attempt:
@@ -190,7 +220,13 @@ class RespClient:
                 return await self._roundtrip(*args)
             except RespError:
                 raise  # server-side errors are not retryable
-            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            except asyncio.TimeoutError as e:
+                if not retry_on_timeout:
+                    raise PossiblyExecuted(
+                        f"{name} timed out awaiting the reply; the server "
+                        "may have executed it") from e
+                last = e
+            except (ConnectionError, OSError) as e:
                 last = e
         raise last
 

@@ -241,7 +241,9 @@ def test_durability_bloom_roundtrip(local_client):
         with SyncRespClient(port=er.port) as rc:
             dm = DurabilityManager(local_client._store, rc)
             dm.flush(["d:bloom"])
-            cfg = rc.execute("HGETALL", "d:bloom__config")
+            # sidecar key carries hashtag braces, matching the reference's
+            # {name}__config (RedissonBloomFilter.java:254-256)
+            cfg = rc.execute("HGETALL", "{d:bloom}__config")
             cfgmap = {bytes(cfg[i]): bytes(cfg[i + 1]) for i in range(0, len(cfg), 2)}
             assert b"size" in cfgmap and b"hashIterations" in cfgmap
 
@@ -424,3 +426,72 @@ def test_failed_flush_keeps_objects_dirty(local_client):
         dm.client = rc2
         assert dm.flush(only_dirty=True) == 1
         rc2.close()
+
+
+# ---------------------------------------------------------------------------
+# retry idempotency, stale TTLs, passthrough checkpoint gating
+# ---------------------------------------------------------------------------
+
+
+def test_non_idempotent_timeout_raises_possibly_executed():
+    # A server that swallows commands after the write: response timeout.
+    # INCRBY must NOT be blindly retried (double-apply hazard); GET may.
+    from redisson_tpu.interop.resp_client import PossiblyExecuted
+
+    async def go():
+        async def mute_handler(reader, writer):
+            while await reader.read(1 << 12):
+                pass  # read and never reply
+            # 3.12 Server.wait_closed() waits for handler writers to close
+            writer.close()
+
+        srv = await asyncio.start_server(mute_handler, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        c = RespClient(port=port, timeout=0.2, retry_attempts=1,
+                       retry_interval=0.01)
+        await c.connect()
+        t0 = asyncio.get_event_loop().time()
+        with pytest.raises(PossiblyExecuted):
+            await c.execute("INCRBY", "k", "2")
+        one_try = asyncio.get_event_loop().time() - t0
+        assert one_try < 1.0  # single attempt, no retry schedule
+        with pytest.raises((asyncio.TimeoutError, ConnectionError)):
+            await c.execute("GET", "k")  # idempotent: retried, then raises
+        await c.close()
+        srv.close()
+        await srv.wait_closed()
+
+    run(go())
+
+
+def test_recreated_key_does_not_inherit_stale_ttl():
+    # SREM-to-empty (and friends) delete keys without popping their expiry;
+    # a re-created key must start TTL-free, as on a real server.
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            rc.execute("SET", "t:k", "v", "PX", "60000")
+            rc.execute("DEL", "t:k")
+            rc.execute("SETNX", "t:k", "v2")
+            assert rc.execute("PTTL", "t:k") == -1
+            # FLUSHALL clears deadlines too
+            rc.execute("SET", "t:f", "v", "PX", "60000")
+            rc.execute("FLUSHALL")
+            rc.execute("SET", "t:f", "v")
+            assert rc.execute("PTTL", "t:f") == -1
+
+
+def test_checkpoint_gated_in_redis_mode(tmp_path):
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        client = RedissonTPU.create(cfg)
+        try:
+            with pytest.raises(NotImplementedError):
+                client.save_checkpoint(str(tmp_path / "cp"))
+            with pytest.raises(NotImplementedError):
+                client.load_checkpoint(str(tmp_path / "cp"))
+        finally:
+            client.shutdown()
