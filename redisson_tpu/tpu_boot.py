@@ -1,0 +1,109 @@
+"""Robust JAX backend acquisition for the flaky single-tenant TPU tunnel.
+
+The axon TPU backend in this image is reached over a tunnel that can stall
+or return UNAVAILABLE transiently (observed: init failures and >120 s hangs
+that succeed seconds later).  Every entry point that needs a device —
+``bench.py``, ``benchmarks/suite.py``, ``__graft_entry__.py`` — must go
+through :func:`acquire_devices` so that:
+
+  * an explicit ``JAX_PLATFORMS=cpu`` request is honored *before* any
+    backend initializes (the axon sitecustomize sets
+    ``jax_platforms="axon,cpu"`` in jax config, which overrides the env
+    var — we re-assert it);
+  * TPU init is probed in a **subprocess with a hard timeout** first, so an
+    in-process hang can never wedge the caller;
+  * init is retried with exponential backoff on transient UNAVAILABLE;
+  * after retries are exhausted the caller can still proceed on CPU
+    (``fallback_cpu=True``) instead of exiting non-zero.
+
+The reference has no analogue (its transport failures are handled by
+``ConnectionWatchdog`` reconnect backoff, ``client/handler/
+ConnectionWatchdog.java:71-114``); this is the same policy applied to the
+accelerator "connection".
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_PROBE_SRC = "import jax; print(jax.devices()[0].platform)"
+
+
+def _honor_cpu_request() -> bool:
+    """If the caller explicitly asked for CPU, pin jax config before init."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    return False
+
+
+def probe_tpu(timeout_s: float = 90.0) -> bool:
+    """Check (in a throwaway subprocess) that the TPU tunnel yields devices.
+
+    Runs ``jax.devices()`` in a child so a hung tunnel cannot wedge the
+    caller, and a failed init does not poison this process's backend cache.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True,
+            timeout=timeout_s,
+            env=env,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return out.returncode == 0 and "cpu" not in out.stdout.lower()
+
+
+def acquire_devices(
+    retries: int = 5,
+    base_delay_s: float = 4.0,
+    probe_timeout_s: float = 90.0,
+    fallback_cpu: bool = True,
+    log=lambda msg: print(msg, file=sys.stderr),
+):
+    """Return ``jax.devices()``, retrying tunnel init; optionally fall back to CPU.
+
+    Returns (devices, platform_str).  Raises only when the backend cannot be
+    acquired AND ``fallback_cpu`` is False.
+    """
+    if _honor_cpu_request():
+        import jax
+
+        return jax.devices(), "cpu"
+
+    delay = base_delay_s
+    for attempt in range(1, retries + 1):
+        if probe_tpu(probe_timeout_s):
+            # Tunnel is warm: in-process init should now succeed quickly —
+            # but guard it anyway (the tunnel can drop between probe and use).
+            try:
+                import jax
+
+                devs = jax.devices()
+                return devs, devs[0].platform
+            except Exception as exc:  # noqa: BLE001 - transient backend errors vary
+                log(f"# tpu_boot: in-process init failed after probe ok: {exc}")
+        log(
+            f"# tpu_boot: TPU unavailable (attempt {attempt}/{retries}); "
+            f"retrying in {delay:.0f}s"
+        )
+        time.sleep(delay)
+        delay = min(delay * 2, 60.0)
+
+    if not fallback_cpu:
+        raise RuntimeError(f"TPU backend unavailable after {retries} attempts")
+    log("# tpu_boot: falling back to CPU backend")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices(), "cpu"
