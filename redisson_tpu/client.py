@@ -9,6 +9,7 @@ waist around it, and the getters hand out objects bound to it.
 
 from __future__ import annotations
 
+import threading as _threading
 from typing import Callable, Optional
 
 from redisson_tpu.codecs import get_codec
@@ -49,7 +50,7 @@ class RedissonTPU:
 
     def __init__(self, config: Optional[Config] = None):
         self.config = config or Config()
-        mode = self.config.mode()
+        mode = self._mode = self.config.mode()
         self._codec = get_codec(self.config.codec)
         self.id = new_client_id()  # connection-manager UUID analogue
 
@@ -108,16 +109,17 @@ class RedissonTPU:
                 self.shutdown()
                 raise
 
-    def _init_redis_mode(self):
+    def _make_resp_pool(self):
+        """Connection pool to the configured redis endpoint — shared by
+        passthrough traffic, blocking pops, coordination scripts and
+        durability flushes (ConnectionPool.java role)."""
         from urllib.parse import urlparse
 
-        from redisson_tpu.interop.backend_redis import RedisBackend
-        from redisson_tpu.interop.resp_client import SyncRespClient
-        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+        from redisson_tpu.interop.pool import RespConnectionPool
 
         rcfg = self.config.redis
         u = urlparse(rcfg.address)
-        self._resp = SyncRespClient(
+        return RespConnectionPool(
             host=u.hostname or "127.0.0.1",
             port=u.port or 6379,
             password=rcfg.password,
@@ -125,7 +127,17 @@ class RedissonTPU:
             timeout=rcfg.timeout_ms / 1000.0,
             retry_attempts=rcfg.retry_attempts,
             retry_interval=rcfg.retry_interval_ms / 1000.0,
+            size=rcfg.connection_pool_size,
+            min_idle=rcfg.connection_minimum_idle_size,
+            failed_attempts=rcfg.failed_attempts,
+            reconnection_timeout=rcfg.reconnection_timeout_ms / 1000.0,
         )
+
+    def _init_redis_mode(self):
+        from redisson_tpu.interop.backend_redis import RedisBackend
+        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+
+        self._resp = self._make_resp_pool()
         try:
             self._resp.connect()
         except Exception:
@@ -138,31 +150,55 @@ class RedissonTPU:
         self._executor = CommandExecutor(
             self._backend, metrics=ExecutorMetrics(self.metrics))
         self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
-        # Coordination/pubsub/eviction tiers need the in-process engine or
-        # server-side scripts; not available over bare passthrough (v1).
+        # Engine-backed tiers are absent; coordination runs as server-side
+        # Lua + pub/sub wake-ups instead (interop/coordination_redis.py) —
+        # the reference's own execution model.
         self._pubsub = None
         self._watchdog = None
         self._eviction = None
         self._remote_services = {}
         self._durability = None
+        from redisson_tpu.interop.coordination_redis import ScriptRunner
 
-    def _connect_durability(self):
+        self._redis_scripts = ScriptRunner(self._resp)
+        self._redis_pubsub = None  # lazy: dedicated subscribe connection
+        self._redis_watchdog = None  # lazy: lock lease renewal thread
+        self._redis_coord_lock = _threading.Lock()
+
+    def _redis_coordination(self):
+        """(scripts, pubsub, watchdog) for redis-mode coordination objects;
+        the subscribe connection and the renewal thread start on first use
+        (the reference also dials pub/sub connections lazily,
+        MasterSlaveConnectionManager.java:306-403)."""
         from urllib.parse import urlparse
 
-        from redisson_tpu.interop.durability import DurabilityManager
-        from redisson_tpu.interop.resp_client import SyncRespClient
+        from redisson_tpu.interop.coordination_redis import RedisLockWatchdog
+        from redisson_tpu.interop.resp_client import SyncPubSubClient
 
-        rcfg = self.config.redis
-        u = urlparse(rcfg.address)
-        self._resp = SyncRespClient(
-            host=u.hostname or "127.0.0.1",
-            port=u.port or 6379,
-            password=rcfg.password,
-            db=rcfg.database,
-            timeout=rcfg.timeout_ms / 1000.0,
-            retry_attempts=rcfg.retry_attempts,
-            retry_interval=rcfg.retry_interval_ms / 1000.0,
-        )
+        with self._redis_coord_lock:
+            if self._redis_pubsub is None:
+                rcfg = self.config.redis
+                u = urlparse(rcfg.address)
+                pubsub = SyncPubSubClient(
+                    host=u.hostname or "127.0.0.1",
+                    port=u.port or 6379,
+                    password=rcfg.password,
+                    timeout=rcfg.timeout_ms / 1000.0,
+                )
+                try:
+                    pubsub.connect()
+                except Exception:
+                    pubsub.close()  # reclaim the IO thread on a failed dial
+                    raise
+                self._redis_pubsub = pubsub
+            if self._redis_watchdog is None:
+                self._redis_watchdog = RedisLockWatchdog(self._redis_scripts)
+            return self._redis_scripts, self._redis_pubsub, self._redis_watchdog
+
+    def _connect_durability(self):
+        from redisson_tpu.interop.durability import DurabilityManager
+
+        self._resp = self._make_resp_pool()
         self._resp.connect()
         self._durability = DurabilityManager(self._store, self._resp)
         if self.config.flush_interval_s > 0:
@@ -241,6 +277,10 @@ class RedissonTPU:
         return RMap(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_map_cache(self, name: str, codec=None) -> RMapCache:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisMapCache
+
+            return RedisMapCache(name, self._redis_scripts, self._resolve_codec(codec))
         return RMapCache(
             name, self._executor, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,
@@ -292,9 +332,19 @@ class RedissonTPU:
         return RGeo(name, self._executor, self._resolve_codec(codec), self._widths)
 
     def get_topic(self, name: str, codec=None) -> RTopic:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisTopic
+
+            _, pubsub, _ = self._redis_coordination()
+            return RedisTopic(name, self._resp, pubsub, self._resolve_codec(codec))
         return RTopic(name, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     def get_pattern_topic(self, pattern: str, codec=None) -> RPatternTopic:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisPatternTopic
+
+            _, pubsub, _ = self._redis_coordination()
+            return RedisPatternTopic(pattern, self._resp, pubsub, self._resolve_codec(codec))
         return RPatternTopic(pattern, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     # -- coordination -------------------------------------------------------
@@ -302,38 +352,63 @@ class RedissonTPU:
     def _require_pubsub(self, feature: str):
         if self._pubsub is None:
             raise NotImplementedError(
-                f"{feature} needs the in-process engine (locks/topics use "
-                "pub/sub wake-ups); redis passthrough mode does not support "
-                "it in v1 — use local/tpu/pod mode")
+                f"{feature} needs the in-process engine's pub/sub hub, which "
+                "this mode does not run")
         return self._pubsub
 
     def get_lock(self, name: str) -> RLock:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisLock
+
+            scripts, pubsub, watchdog = self._redis_coordination()
+            return RedisLock(name, scripts, pubsub, self.id, watchdog)
         return RLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_fair_lock(self, name: str) -> RFairLock:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisFairLock
+
+            scripts, pubsub, watchdog = self._redis_coordination()
+            return RedisFairLock(name, scripts, pubsub, self.id, watchdog)
         return RFairLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_read_write_lock(self, name: str) -> RReadWriteLock:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisReadWriteLock
+
+            scripts, pubsub, watchdog = self._redis_coordination()
+            return RedisReadWriteLock(name, scripts, pubsub, self.id, watchdog)
         return RReadWriteLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_multi_lock(self, *locks: RLock) -> RMultiLock:
         return RMultiLock(*locks)
 
     def get_semaphore(self, name: str) -> RSemaphore:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisSemaphore
+
+            scripts, pubsub, _ = self._redis_coordination()
+            return RedisSemaphore(name, scripts, pubsub)
         return RSemaphore(name, self._executor, self._require_pubsub("semaphores"))
 
     def get_count_down_latch(self, name: str) -> RCountDownLatch:
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisCountDownLatch
+
+            scripts, pubsub, _ = self._redis_coordination()
+            return RedisCountDownLatch(name, scripts, pubsub)
         return RCountDownLatch(name, self._executor, self._require_pubsub("latches"))
 
     def get_script(self):
-        """Atomic scripting over the structure engine (RScript analogue —
-        python functions in the Lua role, see models/script.py)."""
+        """Atomic scripting: python functions over the structure engine in
+        local/tpu/pod mode (models/script.py), real server-side Lua
+        (EVAL/EVALSHA) in redis mode (RedissonScript.java surface)."""
+        if self._mode == "redis":
+            from redisson_tpu.interop.coordination_redis import RedisScript
+
+            return RedisScript(self._resp, self._codec)
         from redisson_tpu.models.script import RScript
 
-        if getattr(self._routing, "structures", None) is None:
-            raise NotImplementedError(
-                "scripting runs on the in-process engine; not available in "
-                "redis passthrough mode (use server-side Lua there)")
         return RScript(self._executor)
 
     # -- observability ------------------------------------------------------
@@ -406,6 +481,15 @@ class RedissonTPU:
             except Exception:
                 pass
             self._durability = None
+        if getattr(self, "_redis_watchdog", None) is not None:
+            self._redis_watchdog.shutdown()
+            self._redis_watchdog = None
+        if getattr(self, "_redis_pubsub", None) is not None:
+            try:
+                self._redis_pubsub.close()
+            except Exception:
+                pass
+            self._redis_pubsub = None
         if self._resp is not None:
             try:
                 self._resp.close()

@@ -60,6 +60,11 @@ class RedisConfig:
     retry_interval_ms: int = 1000  # BaseConfig.retryInterval
     password: Optional[str] = None
     database: int = 0
+    # Connection pool (connection/pool/ConnectionPool.java semantics):
+    connection_pool_size: int = 4  # masterConnectionPoolSize
+    connection_minimum_idle_size: int = 1  # masterConnectionMinimumIdleSize
+    failed_attempts: int = 3  # freeze threshold (ConnectionPool.java:184-186)
+    reconnection_timeout_ms: int = 3000  # re-probe period (:297-386)
 
 
 @dataclass

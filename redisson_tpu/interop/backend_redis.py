@@ -40,6 +40,11 @@ def _b(v) -> bytes:
     return str(v).encode()
 
 
+def _fmt_num(x: float) -> str:
+    """Redis numeric arg: integral floats render without the decimal."""
+    return str(int(x)) if float(x) == int(x) else repr(float(x))
+
+
 class RedisBackend:
     """Backend for CommandExecutor whose run() executes via RESP."""
 
@@ -331,6 +336,82 @@ class RedisBackend:
     def _op_rpop(self, key: str, op: Op) -> None:
         v = self._x("RPOP", key)
         op.future.set_result(None if v is None else bytes(v))
+
+    # -- blocking pops -------------------------------------------------------
+
+    def _op_bpop(self, key: str, op: Op) -> None:
+        """BLPOP/BRPOP/BRPOPLPUSH pushed server-side, on a worker thread so
+        the dispatcher never blocks; the transport uses a dedicated
+        connection (pool exclusive checkout / execute_blocking) so a parked
+        pop never stalls pipelined traffic — the reference's timeoutless
+        blocking path (`command/CommandAsyncService.java:491-497,
+        514-577`)."""
+        import threading
+
+        side = op.payload.get("side", "left")
+        dest = op.payload.get("dest")
+        timeout_s = op.payload.get("timeout_s")
+        # Server-side wait; 0 = block forever. The client-side reply window
+        # adds the normal response timeout as slack.
+        server_timeout = 0.0 if timeout_s is None else max(float(timeout_s), 0.05)
+        slack = getattr(self.client, "timeout", 30.0)
+        response_timeout = 10 ** 9 if timeout_s is None else server_timeout + slack
+
+        def work():
+            try:
+                if dest is not None:
+                    v = self.client.execute_blocking(
+                        "BRPOPLPUSH", key, dest, _fmt_num(server_timeout),
+                        response_timeout=response_timeout)
+                    value = None if v is None else bytes(v)
+                else:
+                    cmd = "BLPOP" if side == "left" else "BRPOP"
+                    v = self.client.execute_blocking(
+                        cmd, key, _fmt_num(server_timeout),
+                        response_timeout=response_timeout)
+                    value = None if v is None else bytes(v[1])
+            except Exception as e:  # noqa: BLE001
+                if not op.future.done():
+                    try:
+                        op.future.set_exception(e)
+                        return
+                    except Exception:  # noqa: BLE001 - lost to cancel
+                        pass
+                return
+            try:
+                op.future.set_result(value)
+            except Exception:  # noqa: BLE001 - cancel already resolved it
+                # The model gave up (bpop_cancel) but the server had already
+                # destructively popped: requeue at the same end so no element
+                # is ever dropped (BRPOPLPUSH is inherently safe — the value
+                # landed in dest). May reorder vs concurrent pushers; the
+                # reference's connection-close cancellation has the same
+                # window (CommandAsyncService.java:514-577).
+                if value is not None and dest is None:
+                    requeue = "LPUSH" if side == "left" else "RPUSH"
+                    try:
+                        self.client.execute(requeue, key, value)
+                    except Exception:  # noqa: BLE001 - nothing left to try
+                        pass
+
+        worker = threading.Thread(target=work, daemon=True,
+                                  name="rtpu-redis-bpop")
+        op.payload["worker"] = worker
+        op.payload["op"] = op  # bpop_cancel resolves the future through this
+        worker.start()
+
+    def _op_bpop_cancel(self, key: str, op: Op) -> None:
+        """The model timed out waiting: resolve the original bpop future to
+        None NOW (no dispatcher-blocking join — every other op would queue
+        behind it). If the worker's reply races past us with an element,
+        its set_result loses and it requeues the element (see work())."""
+        ref_op = op.payload["ref"].get("op")
+        if ref_op is not None and not ref_op.future.done():
+            try:
+                ref_op.future.set_result(None)
+            except Exception:  # noqa: BLE001 - worker won the race
+                pass
+        op.future.set_result(True)
 
     # -- zset (core) ---------------------------------------------------------
 

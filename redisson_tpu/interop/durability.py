@@ -156,6 +156,12 @@ class DurabilityManager:
             return False
         cfg_pairs = self.client.execute(
             "HGETALL", self.prefix + bloom_config_key(name))
+        if not cfg_pairs:
+            # Fallback: data flushed by the round-1 exporter used the
+            # brace-less `name__config` sidecar; read it so pre-existing
+            # flushes keep their parameters after the key-format fix.
+            cfg_pairs = self.client.execute(
+                "HGETALL", self.prefix + name + BLOOM_CONFIG_SUFFIX)
         wire_to_meta = {"size": "size", "hashIterations": "hash_iterations",
                         "expectedInsertions": "expected_insertions",
                         "falseProbability": "false_probability"}

@@ -12,6 +12,15 @@ server speaks enough RESP2 for the durability/interop tier and its tests:
             hashing via the native murmur3 — self-consistent with the TPU
             sketches, see hyll.py docstring)
   admin:    PING AUTH SELECT ECHO DBSIZE
+  scripts:  EVAL EVALSHA SCRIPT LOAD/EXISTS/FLUSH — real server-side
+            execution via the mini-Lua interpreter (interop/mini_lua.py),
+            the mechanism the reference's locks/semaphores/map-cache run on
+            (RedissonLock.java:236-252, RedissonMapCache.java:75-87)
+  pubsub:   SUBSCRIBE UNSUBSCRIBE PSUBSCRIBE PUNSUBSCRIBE PUBLISH — push
+            frames to subscribed connections (lock wake-ups,
+            pubsub/LockPubSub.java)
+  blocking: BLPOP BRPOP with parked asyncio waiters (the reference's
+            timeoutless command path, CommandAsyncService.java:514-577)
   fault injection: DROPCONN (closes the socket mid-stream, for watchdog
             tests — the in-process analogue of RedisRunner's process kill)
 
@@ -21,12 +30,15 @@ State is a plain dict per server; binary-safe; single-threaded asyncio.
 from __future__ import annotations
 
 import asyncio
+import fnmatch
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from redisson_tpu import native
 from redisson_tpu.interop import hyll
+from redisson_tpu.interop import mini_lua
 
 
 def _ok() -> bytes:
@@ -69,13 +81,23 @@ class FakeRedisServer:
         self._server: Optional[asyncio.AbstractServer] = None
         self.connections = 0
         self._writers: set = set()
+        self._scripts: Dict[bytes, bytes] = {}  # sha1 hex -> source
+        # writer -> (channels, patterns) for connections in subscribe mode
+        self._subs: Dict[asyncio.StreamWriter, Tuple[set, set]] = {}
+        # Signalled after every write command; parked BLPOP/BRPOP waiters
+        # re-check their keys (the fake analogue of the reference's
+        # blocking-command reattach machinery).
+        self._push_cond = asyncio.Condition()
+        self._stopping = False
 
     async def start(self) -> None:
+        self._stopping = False
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
         if self._server is not None:
+            self._stopping = True
             self._server.close()
             # Force-close live client connections: wait_closed() blocks until
             # every handler returns, and handlers only return on client EOF.
@@ -84,6 +106,9 @@ class FakeRedisServer:
                     w.close()
                 except Exception:
                     pass
+            # Wake parked blocking-pop waiters so their handlers can exit.
+            async with self._push_cond:
+                self._push_cond.notify_all()
             await self._server.wait_closed()
             self._server = None
 
@@ -115,7 +140,16 @@ class FakeRedisServer:
                         writer.close()
                         return
                     try:
-                        writer.write(self._dispatch(name, args))
+                        if name in ("SUBSCRIBE", "UNSUBSCRIBE", "PSUBSCRIBE",
+                                    "PUNSUBSCRIBE"):
+                            writer.write(self._do_subscribe(name, args, writer))
+                        elif name in ("BLPOP", "BRPOP", "BRPOPLPUSH"):
+                            writer.write(await self._blocking_pop(name, args))
+                        else:
+                            writer.write(self._dispatch(name, args))
+                            # Wake parked blocking-pop waiters to re-check.
+                            async with self._push_cond:
+                                self._push_cond.notify_all()
                     except Exception as e:  # noqa: BLE001
                         writer.write(_err(str(e)))
                 await writer.drain()
@@ -123,6 +157,7 @@ class FakeRedisServer:
             pass
         finally:
             self._writers.discard(writer)
+            self._subs.pop(writer, None)
             parser.close()
             try:
                 writer.close()
@@ -229,6 +264,9 @@ class FakeRedisServer:
 
     def _cmd_decr(self, a):
         return self._cmd_incrby([a[0], b"-1"])
+
+    def _cmd_decrby(self, a):
+        return self._cmd_incrby([a[0], b"%d" % -int(a[1])])
 
     def _cmd_mget(self, a):
         out = []
@@ -710,19 +748,261 @@ class FakeRedisServer:
         self.data[dest] = hyll.encode_dense(regs)
         return _ok()
 
+    # zset range-by-score family (mapcache TTL zsets + eviction scripts)
+
+    @staticmethod
+    def _parse_score_bound(raw: bytes) -> Tuple[float, bool]:
+        """Returns (score, exclusive) for min/max syntax: 1.5, (1.5, -inf, +inf."""
+        s = bytes(raw)
+        exclusive = s.startswith(b"(")
+        if exclusive:
+            s = s[1:]
+        if s in (b"-inf", b"-INF"):
+            return float("-inf"), exclusive
+        if s in (b"+inf", b"inf", b"+INF", b"INF"):
+            return float("inf"), exclusive
+        return float(s), exclusive
+
+    def _zrangebyscore_items(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet):
+            return []
+        lo, lo_ex = self._parse_score_bound(a[1])
+        hi, hi_ex = self._parse_score_bound(a[2])
+        items = sorted(v.items(), key=lambda kv: (kv[1], kv[0]))
+        return [
+            (m, s) for m, s in items
+            if (s > lo if lo_ex else s >= lo) and (s < hi if hi_ex else s <= hi)
+        ]
+
+    def _cmd_zrangebyscore(self, a):
+        items = self._zrangebyscore_items(a)
+        rest = [bytes(x).upper() for x in a[3:]]
+        withscores = b"WITHSCORES" in rest
+        if b"LIMIT" in rest:
+            i = rest.index(b"LIMIT")
+            off, cnt = int(a[3 + i + 1]), int(a[3 + i + 2])
+            items = items[off:] if cnt < 0 else items[off : off + cnt]
+        out = []
+        for m, s in items:
+            out.append(_bulk(m))
+            if withscores:
+                out.append(_bulk(repr(s).encode()))
+        return _array(out)
+
+    def _cmd_zcount(self, a):
+        return _int(len(self._zrangebyscore_items(a)))
+
+    def _cmd_zremrangebyscore(self, a):
+        items = self._zrangebyscore_items(a)
+        v = self.data.get(bytes(a[0]))
+        for m, _ in items:
+            v.pop(m, None)
+        if isinstance(v, _ZSet) and not v:
+            self.data.pop(bytes(a[0]), None)
+        return _int(len(items))
+
+    # -- scripting (EVAL via the mini-Lua interpreter) ----------------------
+
+    # Structured value -> RESP bytes, for script return values.
+    def _encode_value(self, v) -> bytes:
+        if v is None:
+            return _bulk(None)
+        if isinstance(v, bool):
+            return _int(1) if v else _bulk(None)
+        if isinstance(v, int):
+            return _int(v)
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            return _bulk(bytes(v))
+        if isinstance(v, list):
+            return _array([self._encode_value(x) for x in v])
+        if isinstance(v, dict):
+            if "ok" in v:
+                ok = v["ok"]
+                return b"+" + (ok if isinstance(ok, bytes) else str(ok).encode()) + b"\r\n"
+            if "err" in v:
+                err = v["err"]
+                return b"-" + (err if isinstance(err, bytes) else str(err).encode()) + b"\r\n"
+        raise ValueError(f"unencodable script return {type(v).__name__}")
+
+    # redis.call bridge: run a command through _dispatch and convert its
+    # RESP bytes back into a structured value for the interpreter.
+    _SCRIPT_FORBIDDEN = frozenset({
+        "EVAL", "EVALSHA", "SCRIPT", "SUBSCRIBE", "UNSUBSCRIBE", "PSUBSCRIBE",
+        "PUNSUBSCRIBE", "BLPOP", "BRPOP", "AUTH", "DROPCONN",
+    })
+
+    def _script_redis_call(self, args: List[bytes]):
+        if not args:
+            raise mini_lua.LuaError(b"wrong number of arguments")
+        name = bytes(args[0]).upper().decode()
+        if name in self._SCRIPT_FORBIDDEN:
+            raise mini_lua.LuaError(
+                b"This Redis command is not allowed from scripts: " + bytes(args[0])
+            )
+        try:
+            raw = self._dispatch(name, [bytes(a) for a in args[1:]])
+        except mini_lua.LuaError:
+            raise
+        except Exception as e:  # noqa: BLE001 - surface as a script error
+            raise mini_lua.LuaError(str(e).encode())
+        if raw.startswith(b"-"):
+            raise mini_lua.LuaError(raw[1:].split(b"\r\n", 1)[0])
+        if raw.startswith(b"+"):
+            return {"ok": raw[1:].split(b"\r\n", 1)[0]}
+        parser = native.RespParser()
+        try:
+            vals = parser.feed(raw)
+        finally:
+            parser.close()
+        v = vals[0]
+        if isinstance(v, native.RespError):
+            raise mini_lua.LuaError(str(v).encode())
+        return v
+
+    def _run_script(self, source: bytes, a: List[bytes]) -> bytes:
+        numkeys = int(a[1])
+        keys = [bytes(k) for k in a[2 : 2 + numkeys]]
+        argv = [bytes(x) for x in a[2 + numkeys :]]
+        try:
+            result = mini_lua.run_script(source, keys, argv, self._script_redis_call)
+        except mini_lua.LuaError as e:
+            return _err(f"Error running script: {e}")
+        return self._encode_value(result)
+
+    def _cmd_eval(self, a):
+        source = bytes(a[0])
+        self._scripts[hashlib.sha1(source).hexdigest().encode()] = source
+        return self._run_script(source, a)
+
+    def _cmd_evalsha(self, a):
+        source = self._scripts.get(bytes(a[0]).lower())
+        if source is None:
+            return b"-NOSCRIPT No matching script. Please use EVAL.\r\n"
+        return self._run_script(source, a)
+
+    def _cmd_script(self, a):
+        sub = bytes(a[0]).upper()
+        if sub == b"LOAD":
+            source = bytes(a[1])
+            sha = hashlib.sha1(source).hexdigest().encode()
+            self._scripts[sha] = source
+            return _bulk(sha)
+        if sub == b"EXISTS":
+            return _array([
+                _int(1 if bytes(s).lower() in self._scripts else 0) for s in a[1:]
+            ])
+        if sub == b"FLUSH":
+            self._scripts.clear()
+            return _ok()
+        return _err(f"unknown SCRIPT subcommand {sub.decode()}")
+
+    # -- pub/sub ------------------------------------------------------------
+
+    def _do_subscribe(self, name: str, a: List[bytes], writer) -> bytes:
+        chans, pats = self._subs.setdefault(writer, (set(), set()))
+        out = []
+        if name == "SUBSCRIBE":
+            for c in a:
+                chans.add(bytes(c))
+                out.append(_array([_bulk(b"subscribe"), _bulk(bytes(c)),
+                                   _int(len(chans) + len(pats))]))
+        elif name == "PSUBSCRIBE":
+            for p in a:
+                pats.add(bytes(p))
+                out.append(_array([_bulk(b"psubscribe"), _bulk(bytes(p)),
+                                   _int(len(chans) + len(pats))]))
+        elif name == "UNSUBSCRIBE":
+            targets = [bytes(c) for c in a] or sorted(chans)
+            for c in targets:
+                chans.discard(c)
+                out.append(_array([_bulk(b"unsubscribe"), _bulk(c),
+                                   _int(len(chans) + len(pats))]))
+        else:  # PUNSUBSCRIBE
+            targets = [bytes(p) for p in a] or sorted(pats)
+            for p in targets:
+                pats.discard(p)
+                out.append(_array([_bulk(b"punsubscribe"), _bulk(p),
+                                   _int(len(chans) + len(pats))]))
+        return b"".join(out)
+
+    def _cmd_publish(self, a):
+        channel, payload = bytes(a[0]), bytes(a[1])
+        receivers = 0
+        for writer, (chans, pats) in list(self._subs.items()):
+            frames = []
+            if channel in chans:
+                frames.append(_array([_bulk(b"message"), _bulk(channel),
+                                      _bulk(payload)]))
+            for p in pats:
+                if fnmatch.fnmatchcase(channel.decode("latin-1"),
+                                       p.decode("latin-1")):
+                    frames.append(_array([_bulk(b"pmessage"), _bulk(p),
+                                          _bulk(channel), _bulk(payload)]))
+            if frames:
+                receivers += 1
+                try:
+                    writer.write(b"".join(frames))
+                except Exception:  # noqa: BLE001 - dying subscriber
+                    self._subs.pop(writer, None)
+        return _int(receivers)
+
+    # -- blocking pops ------------------------------------------------------
+
+    async def _blocking_pop(self, name: str, a: List[bytes]) -> bytes:
+        if name == "BRPOPLPUSH":
+            keys = [bytes(a[0])]
+            dest = bytes(a[1])
+        else:
+            keys = [bytes(k) for k in a[:-1]]
+            dest = None
+        timeout = float(a[-1])
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout == 0 else loop.time() + timeout
+        while True:
+            self._purge_expired()
+            for k in keys:
+                v = self.data.get(k)
+                if isinstance(v, list) and v:
+                    item = v.pop(0) if name == "BLPOP" else v.pop()
+                    if not v:
+                        self.data.pop(k, None)
+                    if dest is not None:
+                        self._list(dest).insert(0, item)
+                        async with self._push_cond:
+                            self._push_cond.notify_all()
+                        return _bulk(item)
+                    return _array([_bulk(k), _bulk(item)])
+            nil = _bulk(None) if dest is not None else b"*-1\r\n"
+            if self._stopping:
+                return nil
+            remaining = None if deadline is None else deadline - loop.time()
+            if remaining is not None and remaining <= 0:
+                return nil
+            try:
+                async with self._push_cond:
+                    await asyncio.wait_for(self._push_cond.wait(), remaining)
+            except asyncio.TimeoutError:
+                return nil
+
 
 class EmbeddedRedis:
     """Run a FakeRedisServer on a background event-loop thread — the
     test fixture analogue of RedisRunner.startDefaultRedisServerInstance."""
 
-    def __init__(self, password: Optional[str] = None):
+    def __init__(self, password: Optional[str] = None, port: int = 0):
         import threading
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         name="rtpu-fake-redis", daemon=True)
         self._thread.start()
-        self.server = FakeRedisServer(password=password)
+        self.server = FakeRedisServer(password=password, port=port)
         asyncio.run_coroutine_threadsafe(self.server.start(), self._loop).result(10)
+
+    @classmethod
+    def on_port(cls, port: int, password: Optional[str] = None) -> "EmbeddedRedis":
+        """Restart fixture: bind an explicit port (kill/restart tests)."""
+        return cls(password=password, port=port)
 
     @property
     def port(self) -> int:

@@ -189,27 +189,56 @@ class RespClient:
                         raise ConnectionClosed(
                             f"reconnect to {self.host}:{self.port} failed after {attempt} attempts")
 
-    async def _roundtrip(self, *args) -> Any:
-        """Send one command on the current connection, no retry."""
+    async def _roundtrip(self, *args, response_timeout: Optional[float] = None) -> Any:
+        """Send one command on the current connection, no retry.
+
+        Failures BEFORE the payload reaches the socket buffer are re-raised
+        with ``pre_write=True`` so execute() knows a retry cannot
+        double-apply."""
         if not self.connected:
-            raise ConnectionClosed("not connected")
+            exc = ConnectionClosed("not connected")
+            exc.pre_write = True
+            raise exc
         fut = asyncio.get_event_loop().create_future()
         self._pending.append(fut)
-        self._writer.write(native.resp_encode(*args))
-        await self._writer.drain()
-        return await asyncio.wait_for(fut, self.timeout)
+        try:
+            self._writer.write(native.resp_encode(*args))
+            await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            try:
+                self._pending.remove(fut)
+            except ValueError:
+                pass
+            e.pre_write = True
+            raise
+        return await asyncio.wait_for(
+            fut, self.timeout if response_timeout is None else response_timeout)
+
+    async def execute_blocking(self, *args, response_timeout: float) -> Any:
+        """One attempt with a caller-chosen response window — the path for
+        BLPOP/BRPOP-style commands whose legitimate reply can arrive later
+        than the normal response timeout (the reference's timeoutless
+        special case, command/CommandAsyncService.java:491-497). No retry:
+        a popped element must never be popped twice."""
+        if not self.connected:
+            await self._reconnect()
+        return await self._roundtrip(*args, response_timeout=response_timeout)
 
     async def execute(self, *args) -> Any:
         """Send with the retry policy; reconnects between attempts.
 
         Connect/write failures retry freely (the command never reached the
-        server). A response timeout AFTER the write retries only idempotent
-        commands; non-idempotent ones (NON_IDEMPOTENT) raise
-        PossiblyExecuted, since the original may have been applied
+        server). Once the payload has been written, a lost reply — response
+        timeout OR connection drop — is a may-have-executed ambiguity:
+        idempotent commands retry, non-idempotent ones (NON_IDEMPOTENT)
+        raise PossiblyExecuted instead of risking a double-apply
         (cf. command/CommandAsyncService.java:476-512, which retries
         unconditionally — at-least-once; we tighten that)."""
-        name = str(args[0]).upper() if args else ""
-        retry_on_timeout = name not in NON_IDEMPOTENT
+        raw_name = args[0] if args else ""
+        if isinstance(raw_name, (bytes, bytearray)):
+            raw_name = bytes(raw_name).decode("latin-1")
+        name = str(raw_name).upper()
+        retry_after_write = name not in NON_IDEMPOTENT
         last: Exception = ConnectionClosed("never connected")
         for attempt in range(self.retry_attempts + 1):
             if attempt:
@@ -221,12 +250,16 @@ class RespClient:
             except RespError:
                 raise  # server-side errors are not retryable
             except asyncio.TimeoutError as e:
-                if not retry_on_timeout:
+                if not retry_after_write:
                     raise PossiblyExecuted(
                         f"{name} timed out awaiting the reply; the server "
                         "may have executed it") from e
                 last = e
             except (ConnectionError, OSError) as e:
+                if not retry_after_write and not getattr(e, "pre_write", False):
+                    raise PossiblyExecuted(
+                        f"{name} was written before the connection dropped; "
+                        "the server may have executed it") from e
                 last = e
         raise last
 
@@ -310,14 +343,326 @@ class SyncRespClient:
     def connect(self) -> None:
         self._run(self._client.connect())
 
+    @property
+    def timeout(self) -> float:
+        return self._client.timeout
+
+    @property
+    def host(self) -> str:
+        return self._client.host
+
+    @property
+    def port(self) -> int:
+        return self._client.port
+
     def execute(self, *args) -> Any:
         return self._run(self._client.execute(*args))
+
+    def execute_blocking(self, *args, response_timeout: float) -> Any:
+        """Blocking-command path (BLPOP family). NOTE: on this single shared
+        connection a parked pop stalls pipelined traffic behind it; prefer
+        RespConnectionPool (interop/pool.py), which checks out a dedicated
+        connection."""
+        return self._run(
+            self._client.execute_blocking(
+                *args, response_timeout=response_timeout),
+            extra_timeout=min(response_timeout, 10 ** 9) + 30.0)
 
     def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
         # Match the inner pipeline timeout scaling so the outer guard never
         # fires first on large batches.
         scale = self._client.timeout * max(1, len(commands) // 1000 + 1)
         return self._run(self._client.pipeline(commands), extra_timeout=30.0 + scale)
+
+    def close(self) -> None:
+        try:
+            self._run(self._client.close())
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            self._loop.close()
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class PubSubRespClient:
+    """A dedicated subscribe-mode connection (async core).
+
+    Mirrors the reference's pub/sub wiring: subscriptions live on their own
+    connection (`RedisPubSubConnection`), listeners are dispatched off the
+    read loop, and a reconnect re-issues every subscription —
+    `client/handler/ConnectionWatchdog.java:135-145` (pubsub reattach) +
+    `connection/PubSubConnectionEntry.java` (listener multiplexing).
+
+    Listeners run on the IO loop and must not block; coordination waiters
+    hand off via events/queues (pubsub/LockPubSub.java semantics).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379, *,
+                 password: Optional[str] = None, timeout: float = 3.0,
+                 reconnect_backoff_cap: int = 5):
+        self.host = host
+        self.port = port
+        self.password = password
+        self.timeout = timeout
+        self.reconnect_backoff_cap = reconnect_backoff_cap
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._parser: Optional[native.RespParser] = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._conn_lock = asyncio.Lock()
+        # channel/pattern -> listener list; the desired-state registry that
+        # reconnects replay.
+        self._channels: dict = {}
+        self._patterns: dict = {}
+        # channel/pattern -> Event set when the server confirms
+        self._confirmed: dict = {}
+        self.reconnects = 0
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def connect(self) -> None:
+        async with self._conn_lock:
+            if self.connected or self._closed:
+                return
+            await self._dial()
+
+    async def _dial(self) -> None:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port), self.timeout)
+        parser = native.RespParser()
+        self._writer, self._parser = writer, parser
+        if self.password is not None:
+            # AUTH is request/response even pre-subscribe: consume its reply
+            # here, before the push read-loop starts, and fail fast on a
+            # rejected password (a silent bad subscribe connection would
+            # degrade every lock/semaphore wait to blind timeout polling).
+            try:
+                writer.write(native.resp_encode("AUTH", self.password))
+                await writer.drain()
+                deadline = asyncio.get_event_loop().time() + self.timeout
+                reply = None
+                while reply is None:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise ConnectionClosed("AUTH reply timeout")
+                    data = await asyncio.wait_for(
+                        reader.read(1 << 12), self.timeout)
+                    if not data:
+                        raise ConnectionClosed("connection lost during AUTH")
+                    replies = parser.feed(data)
+                    if replies:
+                        reply = replies[0]
+                if isinstance(reply, RespError):
+                    raise reply
+            except Exception:
+                writer.close()
+                parser.close()
+                if self._parser is parser:
+                    self._parser = None
+                raise
+        self._read_task = asyncio.ensure_future(
+            self._read_loop(reader, writer, parser))
+        # Replay desired subscriptions (reconnect reattach).
+        for ch in self._channels:
+            writer.write(native.resp_encode("SUBSCRIBE", ch))
+        for p in self._patterns:
+            writer.write(native.resp_encode("PSUBSCRIBE", p))
+        await writer.drain()
+
+    async def _read_loop(self, reader, writer, parser) -> None:
+        try:
+            while True:
+                data = await reader.read(1 << 16)
+                if not data:
+                    break
+                for frame in parser.feed(data):
+                    self._on_frame(frame)
+        except (asyncio.CancelledError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+            # The read loop owns its parser: release the native buffers here
+            # so reconnect cycles don't accumulate unclosed parsers.
+            parser.close()
+            if self._parser is parser:
+                self._parser = None
+            if self._writer is writer:
+                self._writer = None
+                for ev in self._confirmed.values():
+                    ev.clear()
+                if not self._closed and (self._channels or self._patterns):
+                    self._reconnect_task = asyncio.ensure_future(
+                        self._reconnect())
+
+    def _on_frame(self, frame) -> None:
+        if isinstance(frame, RespError) or not isinstance(frame, list) or not frame:
+            return
+        kind = bytes(frame[0])
+        if kind == b"message":
+            channel = bytes(frame[1]).decode("latin-1")
+            for fn in tuple(self._channels.get(channel, ())):
+                self._safe_call(fn, channel, bytes(frame[2]))
+        elif kind == b"pmessage":
+            pattern = bytes(frame[1]).decode("latin-1")
+            channel = bytes(frame[2]).decode("latin-1")
+            for fn in tuple(self._patterns.get(pattern, ())):
+                self._safe_call(fn, channel, bytes(frame[3]))
+        elif kind in (b"subscribe", b"psubscribe"):
+            name = bytes(frame[1]).decode("latin-1")
+            ev = self._confirmed.get(name)
+            if ev is not None:
+                ev.set()
+
+    @staticmethod
+    def _safe_call(fn, channel: str, payload: bytes) -> None:
+        try:
+            fn(channel, payload)
+        except Exception:  # noqa: BLE001 - a bad listener must not kill IO
+            pass
+
+    async def _reconnect(self) -> None:
+        attempt = 0
+        while not self._closed:
+            delay = min(2 << attempt, 2 << self.reconnect_backoff_cap) / 1000.0
+            await asyncio.sleep(delay)
+            attempt += 1
+            async with self._conn_lock:
+                if self.connected or self._closed:
+                    return
+                try:
+                    await self._dial()
+                    self.reconnects += 1
+                    return
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    continue
+
+    async def subscribe(self, channel: str, listener) -> None:
+        listeners = self._channels.setdefault(channel, [])
+        listeners.append(listener)
+        self._confirmed.setdefault(channel, asyncio.Event())
+        if len(listeners) == 1 and self.connected:
+            self._writer.write(native.resp_encode("SUBSCRIBE", channel))
+            await self._writer.drain()
+
+    async def psubscribe(self, pattern: str, listener) -> None:
+        listeners = self._patterns.setdefault(pattern, [])
+        listeners.append(listener)
+        self._confirmed.setdefault(pattern, asyncio.Event())
+        if len(listeners) == 1 and self.connected:
+            self._writer.write(native.resp_encode("PSUBSCRIBE", pattern))
+            await self._writer.drain()
+
+    async def unsubscribe(self, channel: str, listener=None) -> None:
+        listeners = self._channels.get(channel, [])
+        if listener is None:
+            listeners.clear()
+        elif listener in listeners:
+            listeners.remove(listener)
+        if not listeners:
+            self._channels.pop(channel, None)
+            self._confirmed.pop(channel, None)
+            if self.connected:
+                self._writer.write(native.resp_encode("UNSUBSCRIBE", channel))
+                await self._writer.drain()
+
+    async def punsubscribe(self, pattern: str, listener=None) -> None:
+        listeners = self._patterns.get(pattern, [])
+        if listener is None:
+            listeners.clear()
+        elif listener in listeners:
+            listeners.remove(listener)
+        if not listeners:
+            self._patterns.pop(pattern, None)
+            self._confirmed.pop(pattern, None)
+            if self.connected:
+                self._writer.write(native.resp_encode("PUNSUBSCRIBE", pattern))
+                await self._writer.drain()
+
+    async def wait_subscribed(self, name: str, timeout: float) -> bool:
+        """Block until the server confirms the (p)subscription — callers use
+        this to close the subscribe-then-recheck race in lock waits
+        (RedissonLock.java:306-316)."""
+        ev = self._confirmed.get(name)
+        if ev is None:
+            return False
+        try:
+            await asyncio.wait_for(ev.wait(), timeout)
+            return True
+        except asyncio.TimeoutError:
+            return False
+
+    async def close(self) -> None:
+        self._closed = True
+        for task in (self._reconnect_task, self._read_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+        self._reconnect_task = self._read_task = None
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+            self._writer = None
+        if self._parser is not None:
+            self._parser.close()
+            self._parser = None
+
+
+class SyncPubSubClient:
+    """Blocking facade over PubSubRespClient on a private IO thread."""
+
+    def __init__(self, *args, **kwargs):
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever, name="rtpu-pubsub-io", daemon=True)
+        self._thread.start()
+        self._client = PubSubRespClient(*args, **kwargs)
+
+    def _run(self, coro, timeout: float = 30.0):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(timeout)
+        except FuturesTimeoutError:
+            fut.cancel()
+            raise
+
+    @property
+    def reconnects(self) -> int:
+        return self._client.reconnects
+
+    def connect(self) -> None:
+        self._run(self._client.connect())
+
+    def subscribe(self, channel: str, listener) -> None:
+        self._run(self._client.subscribe(channel, listener))
+
+    def psubscribe(self, pattern: str, listener) -> None:
+        self._run(self._client.psubscribe(pattern, listener))
+
+    def unsubscribe(self, channel: str, listener=None) -> None:
+        self._run(self._client.unsubscribe(channel, listener))
+
+    def punsubscribe(self, pattern: str, listener=None) -> None:
+        self._run(self._client.punsubscribe(pattern, listener))
+
+    def wait_subscribed(self, name: str, timeout: float = 5.0) -> bool:
+        return self._run(
+            self._client.wait_subscribed(name, timeout), timeout + 10.0)
 
     def close(self) -> None:
         try:

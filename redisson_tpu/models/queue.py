@@ -71,7 +71,10 @@ class RBlockingQueue(RQueue):
     """take()/poll(timeout) parity with `RedissonBlockingQueue.java`."""
 
     def _blocking_pop(self, timeout_s: Optional[float], side: str, dest: Optional[str] = None):
-        payload = {"side": side}
+        # timeout_s rides along for backends that push the wait server-side
+        # (redis BLPOP timeout); the engine backend parks a waiter and
+        # ignores it.
+        payload = {"side": side, "timeout_s": timeout_s}
         if dest is not None:
             payload["dest"] = dest
         f = self._executor.execute_async(self.name, "bpop", payload)

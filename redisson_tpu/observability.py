@@ -208,9 +208,7 @@ class NodesGroup:
             def ping_redis() -> bool:
                 return resp.execute("PING") in (b"PONG", b"pong")
 
-            out.append(Node("redis",
-                            f"{resp._client.host}:{resp._client.port}",
-                            ping_redis))
+            out.append(Node("redis", f"{resp.host}:{resp.port}", ping_redis))
         return out
 
     def ping_all(self) -> bool:

@@ -145,42 +145,55 @@ class PodBackend:
     # -- HLL over the bank --------------------------------------------------
 
     def _keys_of(self, op: Op):
-        """Extract (hi, lo) uint32 key arrays from either payload format."""
+        """(hi, lo, pre_hashed) uint32 lane pairs from either payload format.
+
+        Int keys stay raw — the device murmurs them inside the bank kernel
+        (the 100M/s ingest path, identical to single-chip hll_add_u64).
+        Byte keys hash host-side through the NATIVE batch murmur3
+        (native/redisson_native.cpp) and enter the bank pre-hashed: the
+        exact same h1 the single-chip device path computes for the same
+        bytes, so local and pod estimates agree bit-for-bit (VERDICT r1
+        item #7 — replaces the round-1 FNV-1a id fold)."""
         p = op.payload
         if "hi" in p:
-            return p["hi"], p["lo"]
-        # Byte keys: hash host-side is wrong (device does it); instead pack
-        # bytes through the murmur u64 fast path is impossible — so for the
-        # pod bank we pre-hash byte keys to u64 on device via the delegate
-        # path. Round-1 simplification: hash bytes on host with the golden
-        # algorithm would be slow; we instead fold bytes to u64 with FNV-1a
-        # host-side as the *key id* — uniformity is preserved because the
-        # bank path re-hashes ids with murmur3 on device.
+            return p["hi"], p["lo"], False
+        from redisson_tpu import native
+
         data, lengths = p["data"], p["lengths"]
-        ids = _fnv1a_u64(data, lengths)
-        return (ids >> np.uint64(32)).astype(np.uint32), (
-            ids & np.uint64(0xFFFFFFFF)
-        ).astype(np.uint32)
+        keys = [data[i, : lengths[i]].tobytes() for i in range(data.shape[0])]
+        h1, _ = native.murmur3_x64_128(keys, self.seed)
+        return (
+            (h1 >> np.uint64(32)).astype(np.uint32),
+            (h1 & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            True,
+        )
 
     def _op_hll_add(self, target: str, ops: List[Op]) -> None:
-        his, los, rows = [], [], []
+        # Two insert groups: raw u64 keys (device murmur) and pre-hashed
+        # byte keys — each a separate bank_insert variant.
+        groups = {False: ([], [], []), True: ([], [], [])}
         for op in ops:
-            hi, lo = self._keys_of(op)
+            hi, lo, hashed = self._keys_of(op)
+            his, los, rows = groups[hashed]
             his.append(hi)
             los.append(lo)
             rows.append(np.full((hi.shape[0],), self.row_of(op.target), np.int32))
-        hi = np.concatenate(his)
-        lo = np.concatenate(los)
-        row = np.concatenate(rows)
         changed_any = False
-        for s, e in engine.chunk_spans(hi.shape[0]):
-            phi, valid = engine.pad_ints(hi[s:e])
-            plo, _ = engine.pad_ints(lo[s:e])
-            prow, _ = engine.pad_ints(row[s:e])
-            self.bank, changed = sharded.bank_insert(
-                self.bank, phi, plo, prow, valid, self.mesh, self.seed
-            )
-            changed_any |= bool(changed)
+        for pre_hashed, (his, los, rows) in groups.items():
+            if not his:
+                continue
+            hi = np.concatenate(his)
+            lo = np.concatenate(los)
+            row = np.concatenate(rows)
+            for s, e in engine.chunk_spans(hi.shape[0]):
+                phi, valid = engine.pad_ints(hi[s:e])
+                plo, _ = engine.pad_ints(lo[s:e])
+                prow, _ = engine.pad_ints(row[s:e])
+                self.bank, changed = sharded.bank_insert(
+                    self.bank, phi, plo, prow, valid, self.mesh, self.seed,
+                    pre_hashed
+                )
+                changed_any |= bool(changed)
         for op in ops:
             op.future.set_result(changed_any)
 
@@ -224,14 +237,3 @@ class PodBackend:
         est = float(sharded.bank_count_all(self.bank, self.mesh))
         for op in ops:
             op.future.set_result(int(round(est)))
-
-
-def _fnv1a_u64(data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-    """Vectorized FNV-1a over padded byte rows (host-side key-id fold)."""
-    h = np.full((data.shape[0],), 0xCBF29CE484222325, np.uint64)
-    prime = np.uint64(0x100000001B3)
-    for j in range(data.shape[1]):
-        active = j < lengths
-        nh = (h ^ data[:, j].astype(np.uint64)) * prime
-        h = np.where(active, nh, h)
-    return h

@@ -45,10 +45,15 @@ def make_bank(mesh: Mesh, num_sketches: int, m: int = hll.M) -> jax.Array:
     )
 
 
-def _insert_local(bank_local, hi, lo, row, valid, seed: int):
+def _insert_local(bank_local, hi, lo, row, valid, seed: int,
+                  pre_hashed: bool = False):
     """Per-device body: fold keys routed to this device's rows.
 
     bank_local: [S/D, m]; hi/lo/row/valid: full replicated batch.
+    pre_hashed=True treats (hi, lo) as an already-computed murmur3 h1
+    (byte keys hash host-side via the native batch murmur so local and pod
+    modes agree bit-for-bit on identical inputs); False hashes the raw u64
+    key on device (the int fast path).
     Returns (new_local, changed[1]) — changed is this device's "any register
     raised" flag pmax-reduced over the mesh (the PFADD bool contract).
     """
@@ -58,7 +63,10 @@ def _insert_local(bank_local, hi, lo, row, valid, seed: int):
     local_row = row - row_start
     mine = valid & (local_row >= 0) & (local_row < s_local)
 
-    h1, _ = murmur3_x64_128_u64(U64(hi, lo), seed)
+    if pre_hashed:
+        h1 = U64(hi, lo)
+    else:
+        h1, _ = murmur3_x64_128_u64(U64(hi, lo), seed)
     p = m.bit_length() - 1
     bucket, rank = hll.bucket_rank(h1, p)
     rank = jnp.where(mine, rank, 0)
@@ -70,15 +78,16 @@ def _insert_local(bank_local, hi, lo, row, valid, seed: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "seed"), donate_argnums=(0,)
+    jax.jit, static_argnames=("mesh", "seed", "pre_hashed"), donate_argnums=(0,)
 )
-def bank_insert(bank, hi, lo, row, valid, mesh: Mesh, seed: int = 0):
+def bank_insert(bank, hi, lo, row, valid, mesh: Mesh, seed: int = 0,
+                pre_hashed: bool = False):
     """Insert a replicated key batch into the sharded bank (one SPMD step).
 
     Returns (new_bank, changed) where changed is vs pre-batch state.
     """
     fn = shard_map(
-        functools.partial(_insert_local, seed=seed),
+        functools.partial(_insert_local, seed=seed, pre_hashed=pre_hashed),
         mesh=mesh,
         in_specs=(P(SHARD_AXIS, None), P(), P(), P(), P()),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),

@@ -1,0 +1,309 @@
+"""mini-Lua interpreter + fake-server EVAL / pub-sub / blocking-pop tests.
+
+The scripts exercised here are shaped like the reference's server-side
+coordination scripts (RedissonLock.java:236-252 tryAcquire,
+RedissonLock.java:324-343 unlock, RedissonMapCache.java TTL puts) — run
+against the fake server through a real RESP connection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.interop import mini_lua
+from redisson_tpu.interop.fake_server import EmbeddedRedis
+from redisson_tpu.interop.resp_client import SyncRespClient
+from redisson_tpu.native import RespError
+
+
+# ---------------------------------------------------------------------------
+# Interpreter unit tests (no server: a dict-backed redis.call stub)
+# ---------------------------------------------------------------------------
+
+
+class FakeCall:
+    """Minimal redis.call target: a few commands over a plain dict."""
+
+    def __init__(self):
+        self.kv = {}
+        self.hashes = {}
+
+    def __call__(self, args):
+        name = bytes(args[0]).upper()
+        if name == b"SET":
+            self.kv[args[1]] = args[2]
+            return {"ok": b"OK"}
+        if name == b"GET":
+            return self.kv.get(args[1])
+        if name == b"EXISTS":
+            return int(args[1] in self.kv or args[1] in self.hashes)
+        if name == b"HSET":
+            self.hashes.setdefault(args[1], {})[args[2]] = args[3]
+            return 1
+        if name == b"HEXISTS":
+            return int(args[2] in self.hashes.get(args[1], {}))
+        if name == b"HINCRBY":
+            h = self.hashes.setdefault(args[1], {})
+            v = int(h.get(args[2], b"0")) + int(args[3])
+            h[args[2]] = str(v).encode()
+            return v
+        if name == b"DEL":
+            n = int(args[1] in self.kv) + int(args[1] in self.hashes)
+            self.kv.pop(args[1], None)
+            self.hashes.pop(args[1], None)
+            return n
+        raise mini_lua.LuaError(b"unknown command " + name)
+
+
+def run(src, keys=(), argv=(), call=None):
+    return mini_lua.run_script(
+        src if isinstance(src, bytes) else src.encode(),
+        [k if isinstance(k, bytes) else k.encode() for k in keys],
+        [a if isinstance(a, bytes) else a.encode() for a in argv],
+        call or FakeCall(),
+    )
+
+
+def test_literals_and_arithmetic():
+    assert run("return 1 + 2 * 3") == 7
+    assert run("return (1 + 2) * 3") == 9
+    assert run("return 7 % 3") == 1
+    assert run("return 2 ^ 10") == 1024
+    assert run("return -(-5)") == 5
+    assert run("return 10 / 4") == 2  # Lua->RESP truncates to integer
+
+
+def test_strings_concat_compare():
+    assert run("return 'a' .. 'b' .. 1") == b"ab1"
+    assert run("return tostring(3)") == b"3"
+    assert run("return tostring(3.5)") == b"3.5"
+    assert run("return tonumber('12') + 1") == 13
+    assert run("return tonumber('nope')") is None
+    assert run("if 'abc' < 'abd' then return 1 else return 0 end") == 1
+
+
+def test_keys_argv_and_locals():
+    assert run("return KEYS[1]", keys=["k1"]) == b"k1"
+    assert run("return ARGV[2]", argv=["a", "b"]) == b"b"
+    assert run("local x = 5; local y = x + 1; return y") == 6
+    assert run("local a, b = 1; return tostring(b)") == b"nil"
+    assert run("return #ARGV", argv=["a", "b", "c"]) == 3
+
+
+def test_control_flow():
+    src = """
+    local total = 0
+    for i = 1, 10 do
+        if i % 2 == 0 then total = total + i end
+    end
+    return total
+    """
+    assert run(src) == 30
+    src = """
+    local i = 0
+    while true do
+        i = i + 1
+        if i >= 4 then break end
+    end
+    return i
+    """
+    assert run(src) == 4
+    src = """
+    local n = 0
+    repeat n = n + 1 until n >= 3
+    return n
+    """
+    assert run(src) == 3
+
+
+def test_tables():
+    assert run("local t = {10, 20, 30}; return t[2]") == 20
+    assert run("local t = {}; table.insert(t, 'x'); table.insert(t, 'y'); return t") == [
+        b"x",
+        b"y",
+    ]
+    assert run("local t = {a = 7}; return t.a") == 7
+    src = """
+    local out = {}
+    for i, v in ipairs({'p', 'q'}) do
+        table.insert(out, v .. i)
+    end
+    return out
+    """
+    assert run(src) == [b"p1", b"q2"]
+
+
+def test_stdlib():
+    assert run("return string.sub('hello', 2, 3)") == b"el"
+    assert run("return string.sub('hello', -3)") == b"llo"
+    assert run("return string.rep('ab', 3)") == b"ababab"
+    assert run("return string.format('%s=%d', 'n', 42)") == b"n=42"
+    assert run("return math.floor(3.9)") == 3
+    assert run("return math.max(1, 9, 4)") == 9
+    assert run("return type('x')") == b"string"
+    with pytest.raises(mini_lua.LuaError, match="boom"):
+        run("error('boom')")
+
+
+def test_redis_call_roundtrip():
+    call = FakeCall()
+    assert run("return redis.call('set', KEYS[1], ARGV[1])", ["k"], ["v"], call) == {
+        "ok": b"OK"
+    }
+    assert run("return redis.call('get', KEYS[1])", ["k"], [], call) == b"v"
+    # nil bulk converts to Lua false -> RESP nil
+    assert run("return redis.call('get', 'missing')", [], [], call) is None
+    assert (
+        run(
+            "if redis.call('get', 'missing') == false then return 'was-nil' end",
+            [],
+            [],
+            call,
+        )
+        == b"was-nil"
+    )
+
+
+def test_lock_shaped_script():
+    """The reference's tryAcquire contract (RedissonLock.java:236-252):
+    nil => acquired; number => remaining ttl of the holder."""
+    call = FakeCall()
+    acquire = """
+    if (redis.call('exists', KEYS[1]) == 0) then
+        redis.call('hset', KEYS[1], ARGV[2], 1)
+        return nil
+    end
+    if (redis.call('hexists', KEYS[1], ARGV[2]) == 1) then
+        redis.call('hincrby', KEYS[1], ARGV[2], 1)
+        return nil
+    end
+    return 42
+    """
+    assert run(acquire, ["L"], ["30000", "owner:1"], call) is None  # acquired
+    assert run(acquire, ["L"], ["30000", "owner:1"], call) is None  # reentrant
+    assert run(acquire, ["L"], ["30000", "owner:2"], call) == 42  # contended
+    assert call.hashes[b"L"][b"owner:1"] == b"2"
+
+
+def test_execution_budget():
+    with pytest.raises(mini_lua.LuaError, match="budget"):
+        run("while true do end")
+
+
+# ---------------------------------------------------------------------------
+# Fake-server integration: EVAL over the wire
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def server():
+    with EmbeddedRedis() as s:
+        yield s
+
+
+@pytest.fixture()
+def client(server):
+    c = SyncRespClient(port=server.port, timeout=5.0)
+    c.connect()
+    yield c
+    c.close()
+
+
+def test_eval_over_wire(client):
+    assert client.execute("EVAL", "return 1 + 1", "0") == 2
+    assert (
+        client.execute("EVAL", "return redis.call('set', KEYS[1], ARGV[1])",
+                       "1", "k", "v")
+        == b"OK"
+    )
+    assert client.execute("GET", "k") == b"v"
+    assert client.execute("EVAL", "return {1, 'two', 3}", "0") == [1, b"two", 3]
+
+
+def test_evalsha_and_script_load(client):
+    sha = client.execute("SCRIPT", "LOAD", "return ARGV[1]")
+    assert len(sha) == 40
+    assert client.execute("EVALSHA", sha, "0", "hi") == b"hi"
+    assert client.execute("SCRIPT", "EXISTS", sha, "0" * 40) == [1, 0]
+    with pytest.raises(RespError, match="NOSCRIPT"):
+        client.execute("EVALSHA", "f" * 40, "0")
+
+
+def test_eval_atomic_counter_script(client):
+    src = """
+    local v = redis.call('incrby', KEYS[1], ARGV[1])
+    if v > tonumber(ARGV[2]) then
+        redis.call('set', KEYS[1], ARGV[2])
+        return tonumber(ARGV[2])
+    end
+    return v
+    """
+    assert client.execute("EVAL", src, "1", "ctr", "7", "10") == 7
+    assert client.execute("EVAL", src, "1", "ctr", "7", "10") == 10
+
+
+def test_eval_error_surfaces(client):
+    with pytest.raises(RespError, match="(?i)script"):
+        client.execute("EVAL", "error('custom failure')", "0")
+
+
+def test_eval_pexpire_pttl(client):
+    src = """
+    redis.call('set', KEYS[1], 'v')
+    redis.call('pexpire', KEYS[1], ARGV[1])
+    return redis.call('pttl', KEYS[1])
+    """
+    ttl = client.execute("EVAL", src, "1", "tkey", "30000")
+    assert 0 < ttl <= 30000
+
+
+def test_zrangebyscore(client):
+    client.execute("ZADD", "z", "1", "a", "2", "b", "3", "c")
+    assert client.execute("ZRANGEBYSCORE", "z", "-inf", "2") == [b"a", b"b"]
+    assert client.execute("ZRANGEBYSCORE", "z", "(1", "+inf") == [b"b", b"c"]
+    assert client.execute("ZCOUNT", "z", "1", "3") == 3
+    assert client.execute("ZREMRANGEBYSCORE", "z", "-inf", "1") == 1
+    assert client.execute("ZRANGEBYSCORE", "z", "-inf", "+inf") == [b"b", b"c"]
+    assert client.execute(
+        "ZRANGEBYSCORE", "z", "-inf", "+inf", "LIMIT", "1", "1"
+    ) == [b"c"]
+
+
+def test_blocking_pop_immediate(client):
+    client.execute("RPUSH", "q", "x")
+    assert client.execute("BLPOP", "q", "0") == [b"q", b"x"]
+    # empty + timeout -> nil after ~the timeout
+    t0 = time.time()
+    assert client.execute("BLPOP", "q", "0.1") is None
+    assert time.time() - t0 >= 0.09
+
+
+def test_blocking_pop_wakeup(server, client):
+    """A parked BLPOP wakes when another connection pushes."""
+    got = {}
+
+    def waiter():
+        c2 = SyncRespClient(port=server.port, timeout=10.0)
+        c2.connect()
+        try:
+            got["v"] = c2.execute("BLPOP", "wq", "5")
+        finally:
+            c2.close()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.15)  # let it park
+    client.execute("RPUSH", "wq", "payload")
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["v"] == [b"wq", b"payload"]
+
+
+def test_pubsub_publish_counts_receivers(server, client):
+    """PUBLISH with no subscribers returns 0; with one connection in
+    subscribe mode, 1 (frame delivery is exercised by the PubSub client
+    tests in test_redis_coordination)."""
+    assert client.execute("PUBLISH", "chan", "m") == 0

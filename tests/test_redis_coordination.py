@@ -1,0 +1,359 @@
+"""Coordination in redis passthrough mode: server-side Lua + pub/sub.
+
+Closes VERDICT r1 missing-item #3/#6: locks/semaphores/latches/topics/
+map-cache now execute on the (fake) Redis server, so SEPARATE CLIENT
+INSTANCES — the reference's definition of "distributed" — exclude each
+other. Test shapes mirror the reference's lock suites
+(RedissonLockTest, RedissonSemaphoreTest, RedissonCountDownLatchTest,
+RedissonTopicTest; SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+
+@pytest.fixture(scope="module")
+def server():
+    with EmbeddedRedis() as s:
+        yield s
+
+
+def make_client(server) -> RedissonTPU:
+    cfg = Config.from_dict({
+        "redis": {"address": f"redis://127.0.0.1:{server.port}"},
+    })
+    return RedissonTPU.create(cfg)
+
+
+@pytest.fixture()
+def client(server):
+    c = make_client(server)
+    yield c
+    c.get_keys().flushall()
+    c.shutdown()
+
+
+@pytest.fixture()
+def client2(server):
+    c = make_client(server)
+    yield c
+    c.shutdown()
+
+
+# -- locks ------------------------------------------------------------------
+
+
+def test_lock_basic_acquire_release(client):
+    lock = client.get_lock("rlock:a")
+    assert not lock.is_locked()
+    lock.lock()
+    assert lock.is_locked()
+    assert lock.is_held_by_current_thread()
+    lock.unlock()
+    assert not lock.is_locked()
+
+
+def test_lock_reentrant(client):
+    lock = client.get_lock("rlock:reent")
+    lock.lock()
+    lock.lock()
+    assert lock.get_hold_count() == 2
+    lock.unlock()
+    assert lock.is_locked()
+    lock.unlock()
+    assert not lock.is_locked()
+
+
+def test_lock_two_clients_mutual_exclusion(client, client2):
+    """The VERDICT's acceptance shape: two clients on one server exclude
+    each other (the reference's cross-JVM contract)."""
+    l1 = client.get_lock("rlock:x")
+    l2 = client2.get_lock("rlock:x")
+    l1.lock()
+    assert not l2.try_lock()
+    assert l2.is_locked()  # visible cross-client
+    assert not l2.is_held_by_current_thread()
+    l1.unlock()
+    assert l2.try_lock()
+    l2.unlock()
+
+
+def test_lock_wait_wakeup_across_clients(client, client2):
+    """A parked waiter on client2 wakes when client1 unlocks (pub/sub
+    wake-up, not polling: RedissonLock.java:107-142)."""
+    l1 = client.get_lock("rlock:wake")
+    l2 = client2.get_lock("rlock:wake")
+    l1.lock()
+    got = {}
+
+    def waiter():
+        got["ok"] = l2.try_lock(wait_time_s=10.0)
+        if got["ok"]:
+            l2.unlock()  # owner identity is per-thread: release here
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)  # let it subscribe and park
+    l1.unlock()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["ok"]
+
+
+def test_lock_unlock_not_owner_raises(client, client2):
+    l1 = client.get_lock("rlock:owner")
+    l1.lock()
+    with pytest.raises(RuntimeError, match="not locked by current thread"):
+        client2.get_lock("rlock:owner").unlock()
+    l1.unlock()
+
+
+def test_lock_force_unlock(client, client2):
+    l1 = client.get_lock("rlock:force")
+    l1.lock()
+    assert client2.get_lock("rlock:force").force_unlock()
+    assert not l1.is_locked()
+
+
+def test_lock_lease_expires_without_watchdog(client, client2):
+    """An explicit short lease is NOT renewed: the holder's crash analogue
+    (RedissonLock watchdog only renews default-lease holds)."""
+    l1 = client.get_lock("rlock:lease")
+    assert l1.try_lock(lease_time_s=0.3)
+    l2 = client2.get_lock("rlock:lease")
+    assert not l2.try_lock()
+    assert l2.try_lock(wait_time_s=5.0)
+    l2.unlock()
+
+
+def test_lock_watchdog_renews_default_lease(client):
+    lock = client.get_lock("rlock:wd")
+    lock.lock()  # default lease; watchdog must keep it alive
+    wd = client._redis_watchdog
+    assert (lock.name, lock._owner()) in wd._held
+    lock.unlock()
+    assert (lock.name, lock._owner()) not in wd._held
+
+
+def test_fair_lock_fifo_across_clients(server, client, client2):
+    """Waiters acquire in arrival order (RedissonFairLock queue)."""
+    c3 = make_client(server)
+    try:
+        l1 = client.get_fair_lock("flock:f")
+        l2 = client2.get_fair_lock("flock:f")
+        l3 = c3.get_fair_lock("flock:f")
+        l1.lock()
+        order = []
+        barrier = threading.Barrier(2)
+
+        def waiter(lk, tag, delay):
+            time.sleep(delay)
+            barrier.wait()  # both threads running before either enqueues
+            if tag == "second":
+                time.sleep(0.4)  # enforce arrival order: first enqueues first
+            assert lk.try_lock(wait_time_s=15.0)
+            order.append(tag)
+            time.sleep(0.1)
+            lk.unlock()
+
+        t1 = threading.Thread(target=waiter, args=(l2, "first", 0))
+        t2 = threading.Thread(target=waiter, args=(l3, "second", 0))
+        t1.start(); t2.start()
+        time.sleep(1.2)  # both parked in the queue
+        l1.unlock()
+        t1.join(timeout=20); t2.join(timeout=20)
+        assert order == ["first", "second"]
+    finally:
+        c3.shutdown()
+
+
+def test_read_write_lock(client, client2):
+    rw1 = client.get_read_write_lock("rw:a")
+    rw2 = client2.get_read_write_lock("rw:a")
+    r1 = rw1.read_lock()
+    r2 = rw2.read_lock()
+    r1.lock()
+    assert r2.try_lock()  # readers share
+    assert not rw2.write_lock().try_lock()  # writer excluded
+    r1.unlock()
+    r2.unlock()
+    w1 = rw1.write_lock()
+    w1.lock()
+    assert not rw2.read_lock().try_lock()  # writer excludes readers
+    assert rw1.read_lock().try_lock()  # ... except its own holder
+    rw1.read_lock().unlock()
+    w1.unlock()
+
+
+# -- semaphore / latch ------------------------------------------------------
+
+
+def test_semaphore_across_clients(client, client2):
+    s1 = client.get_semaphore("sem:a")
+    assert s1.try_set_permits(2)
+    s2 = client2.get_semaphore("sem:a")
+    assert s2.try_acquire()
+    assert s2.try_acquire()
+    assert not s2.try_acquire()
+    assert s1.available_permits() == 0
+    s1.release()
+    assert s2.try_acquire()
+    s2.release(2)
+
+
+def test_semaphore_blocking_release_wakeup(client, client2):
+    s1 = client.get_semaphore("sem:wake")
+    s1.try_set_permits(1)
+    assert s1.try_acquire()
+    got = {}
+
+    def waiter():
+        got["ok"] = client2.get_semaphore("sem:wake").try_acquire(
+            timeout_s=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    s1.release()
+    t.join(timeout=10)
+    assert got["ok"]
+    client2.get_semaphore("sem:wake").release()
+
+
+def test_count_down_latch_across_clients(client, client2):
+    latch1 = client.get_count_down_latch("latch:a")
+    assert latch1.try_set_count(2)
+    latch2 = client2.get_count_down_latch("latch:a")
+    assert latch2.get_count() == 2
+    done = {}
+
+    def waiter():
+        done["ok"] = latch2.await_(timeout_s=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    latch1.count_down()
+    latch1.count_down()
+    t.join(timeout=10)
+    assert done["ok"]
+    assert latch2.get_count() == 0
+
+
+# -- topics -----------------------------------------------------------------
+
+
+def test_topic_cross_client_pubsub(client, client2):
+    received = []
+    event = threading.Event()
+    topic2 = client2.get_topic("news")
+    topic2.add_listener(lambda ch, msg: (received.append((ch, msg)),
+                                         event.set()))
+    n = client.get_topic("news").publish({"headline": "tpu"})
+    assert n == 1  # one subscriber counted by the server
+    assert event.wait(5.0)
+    assert received == [("news", {"headline": "tpu"})]
+    topic2.remove_all_listeners()
+
+
+def test_pattern_topic(client, client2):
+    received = []
+    event = threading.Event()
+    pt = client2.get_pattern_topic("evt.*")
+    pt.add_listener(lambda pat, ch, msg: (received.append((pat, ch, msg)),
+                                          event.set()))
+    client.get_topic("evt.user").publish("login")
+    assert event.wait(5.0)
+    assert received == [("evt.*", "evt.user", "login")]
+    pt.remove_all_listeners()
+
+
+# -- map cache --------------------------------------------------------------
+
+
+def test_mapcache_ttl(client):
+    mc = client.get_map_cache("mc:a")
+    assert mc.put("k", "v1", ttl_s=0.25) is None
+    assert mc.get("k") == "v1"
+    assert mc.contains_key("k")
+    assert mc.size() == 1
+    time.sleep(0.3)
+    assert mc.get("k") is None
+    assert mc.size() == 0
+
+
+def test_mapcache_no_ttl_persists(client):
+    mc = client.get_map_cache("mc:b")
+    mc.put("k", 42)
+    time.sleep(0.2)
+    assert mc.get("k") == 42
+    assert mc.remove("k") == 42
+    assert mc.get("k") is None
+
+
+def test_mapcache_put_returns_old_and_put_if_absent(client):
+    mc = client.get_map_cache("mc:c")
+    assert mc.put("k", "a") is None
+    assert mc.put("k", "b") == "a"
+    assert mc.put_if_absent("k", "c") == "b"  # present: keeps b
+    assert mc.get("k") == "b"
+    assert mc.put_if_absent("new", "n", ttl_s=10) is None
+    assert mc.get("new") == "n"
+
+
+def test_mapcache_expired_entry_overwritable_by_put_if_absent(client):
+    mc = client.get_map_cache("mc:d")
+    mc.put("k", "old", ttl_s=0.2)
+    time.sleep(0.25)
+    assert mc.put_if_absent("k", "fresh") is None
+    assert mc.get("k") == "fresh"
+
+
+def test_mapcache_evict_expired_sweeper(client):
+    mc = client.get_map_cache("mc:e")
+    for i in range(5):
+        mc.put(f"k{i}", i, ttl_s=0.15)
+    mc.put("keep", "alive")
+    time.sleep(0.25)
+    assert mc.evict_expired() == 5
+    assert mc.size() == 1
+    assert mc.get("keep") == "alive"
+    assert mc.delete()
+
+
+# -- script -----------------------------------------------------------------
+
+
+def test_get_script_redis_mode(client):
+    script = client.get_script()
+    sha = script.script_load("return tonumber(ARGV[1]) * 2")
+    assert script.script_exists(sha) == [True]
+    assert script.eval_sha(sha, args=["21"]) == 42
+    assert script.eval(
+        "redis.call('set', KEYS[1], ARGV[1]); return redis.call('get', KEYS[1])",
+        keys=["sk"], args=["v"]) == b"v"
+
+
+# -- regression: old gates are gone -----------------------------------------
+
+
+def test_unsupported_gates_removed(client):
+    """VERDICT done-condition: UnsupportedInRedisMode gone for
+    locks/topics/mapcache/scripting."""
+    client.get_lock("gate:lock")
+    client.get_fair_lock("gate:flock")
+    client.get_read_write_lock("gate:rw")
+    client.get_semaphore("gate:sem")
+    client.get_count_down_latch("gate:latch")
+    client.get_topic("gate:topic")
+    client.get_pattern_topic("gate:*")
+    client.get_map_cache("gate:mc")
+    client.get_script()

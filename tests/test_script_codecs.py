@@ -95,7 +95,10 @@ def test_script_error_propagates(client):
         client.get_script().eval(boom)
 
 
-def test_script_unavailable_in_redis_mode():
+def test_script_redis_mode_is_server_side_lua():
+    """get_script() in redis mode now returns the EVAL/EVALSHA-backed
+    RedisScript (server-side Lua via mini_lua on the fake server) — the old
+    NotImplementedError gate is gone (VERDICT r1 item #3)."""
     from redisson_tpu.config import Config
     from redisson_tpu.interop.fake_server import EmbeddedRedis
 
@@ -104,8 +107,8 @@ def test_script_unavailable_in_redis_mode():
         cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
         c = RedissonTPU.create(cfg)
         try:
-            with pytest.raises(NotImplementedError):
-                c.get_script()
+            script = c.get_script()
+            assert script.eval("return 6 * 7") == 42
         finally:
             c.shutdown()
 
