@@ -107,6 +107,30 @@ class TpuBackend:
         for op in ops:
             op.future.set_result(int(round(est)))
 
+    def _op_hll_export(self, target: str, ops: List[Op]) -> None:
+        """(registers uint8[m], version) on the dispatcher — serialized with
+        the donating insert kernels, so the read can never hit an
+        invalidated buffer (the durability/checkpoint read path)."""
+        obj = self.store.get(target, ObjectType.HLL)
+        result = (
+            None
+            if obj is None
+            else (np.asarray(obj.state).astype(np.uint8), obj.version)
+        )
+        for op in ops:
+            op.future.set_result(result)
+
+    def _op_hll_import(self, target: str, ops: List[Op]) -> None:
+        """Overwrite (or create) an HLL from host registers."""
+        import jax
+
+        for op in ops:
+            regs = np.asarray(op.payload["regs"]).astype(np.int32)
+            arr = jax.device_put(regs, self.store.device)
+            self.store.get_or_create(target, ObjectType.HLL, lambda: arr, {})
+            self.store.swap(target, arr)
+            op.future.set_result(True)
+
     def _op_hll_count_with(self, target: str, ops: List[Op]) -> None:
         # Union count across sketches: merge copies, never mutate.
         for op in ops:

@@ -46,8 +46,14 @@ def _swap_lock(path: str) -> threading.Lock:
 
 
 def save(store: SketchStore, path: str,
-         names: Optional[List[str]] = None) -> int:
-    """Snapshot the named objects (default all) into `path`. Returns count."""
+         names: Optional[List[str]] = None,
+         extra_objects: Optional[Dict] = None) -> int:
+    """Snapshot the named objects (default all) into `path`. Returns count.
+
+    extra_objects: {name: (otype, host_array, meta, version)} for state
+    living outside the store — pod-mode bank rows exported by the client
+    (dispatcher-serialized). Saved identically, so checkpoints are portable
+    between pod and single-chip modes."""
     if names is None:
         names = store.keys()
     objs = {}
@@ -62,6 +68,16 @@ def save(store: SketchStore, path: str,
             "otype": obj.otype,
             "meta": obj.meta,
             "version": obj.version,
+            "dtype": str(host.dtype),
+            "shape": list(host.shape),
+        }
+    for name, (otype, host, meta, version) in (extra_objects or {}).items():
+        host = np.asarray(host)
+        arrays[name] = host
+        objs[name] = {
+            "otype": otype,
+            "meta": meta,
+            "version": version,
             "dtype": str(host.dtype),
             "shape": list(host.shape),
         }
@@ -98,10 +114,14 @@ def save(store: SketchStore, path: str,
 
 
 def load(store: SketchStore, path: str,
-         names: Optional[List[str]] = None) -> int:
+         names: Optional[List[str]] = None, put=None) -> int:
     """Restore objects from a checkpoint into the store (overwriting
     same-named objects). Returns the number restored. Falls back to the
-    `.old` sibling if a crash interrupted the last save's swap."""
+    `.old` sibling if a crash interrupted the last save's swap.
+
+    put: optional hook ``put(name, otype, host_array, meta) -> bool`` that
+    claims an object (returning True) instead of the default store path —
+    the client uses it to route HLLs into the pod bank."""
     import jax
 
     if not os.path.exists(os.path.join(path, MANIFEST)):
@@ -118,11 +138,14 @@ def load(store: SketchStore, path: str,
             if names is not None and name not in names:
                 continue
             host = z[_KEY_PREFIX + name]
+            meta = info.get("meta") or {}
+            if put is not None and put(name, info["otype"], host, meta):
+                count += 1
+                continue
             arr = jax.device_put(host, store.device)
-            obj = store.get_or_create(name, info["otype"], lambda: arr,
-                                      info.get("meta") or {})
+            obj = store.get_or_create(name, info["otype"], lambda: arr, meta)
             store.swap(name, arr)
-            obj.meta.update(info.get("meta") or {})
+            obj.meta.update(meta)
             count += 1
     return count
 

@@ -42,6 +42,9 @@ class PodBackend:
         self._rows: dict = {}  # name -> row
         self._free_rows: list = []  # rows returned by delete, for reuse
         self._next_row = 0
+        # name -> mutation counter: the durability tier's dirty tracking
+        # (the store-version analogue for bank-resident sketches).
+        self._row_versions: dict = {}
         # Non-HLL ops delegate to a single-device backend.
         self.store = SketchStore(device=self.mesh.devices.flat[0])
         self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed)
@@ -121,6 +124,7 @@ class PodBackend:
         if row is not None:
             self.bank = sharded.zero_row(self.bank, row)
             self._free_rows.append(row)
+            self._row_versions.pop(target, None)
             for op in ops:
                 op.future.set_result(True)
             return
@@ -136,6 +140,7 @@ class PodBackend:
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
         self._rows.clear()
         self._free_rows.clear()
+        self._row_versions.clear()
         self._next_row = 0
         self.bank = sharded.make_bank(self.mesh, self.bank_capacity)
         self.store.flushall()
@@ -195,6 +200,7 @@ class PodBackend:
                 )
                 changed_any |= bool(changed)
         for op in ops:
+            self._row_versions[op.target] = self._row_versions.get(op.target, 0) + 1
             op.future.set_result(changed_any)
 
     def _op_hll_count(self, target: str, ops: List[Op]) -> None:
@@ -230,6 +236,7 @@ class PodBackend:
             rows_arr = np.array(rows, np.int32)
             merged = jnp.max(self.bank[rows_arr], axis=0)
             self.bank = self.bank.at[self.row_of(target)].set(merged)
+            self._row_versions[target] = self._row_versions.get(target, 0) + 1
             op.future.set_result(None)
 
     def _op_hll_count_all(self, target: str, ops: List[Op]) -> None:
@@ -237,3 +244,37 @@ class PodBackend:
         est = float(sharded.bank_count_all(self.bank, self.mesh))
         for op in ops:
             op.future.set_result(int(round(est)))
+
+    # -- durability/checkpoint surface (VERDICT r1 item #5) ------------------
+    # Export/import run as ops ON THE DISPATCHER, serialized with inserts,
+    # so they never read a bank buffer that a donating insert just
+    # invalidated. The durability/checkpoint tiers call these through the
+    # executor instead of touching the bank directly.
+
+    def bank_names(self) -> List[str]:
+        return list(self._rows)
+
+    def row_version(self, name: str) -> int:
+        return self._row_versions.get(name, 0)
+
+    def _op_hll_export(self, target: str, ops: List[Op]) -> None:
+        """(registers uint8[m], version) of a bank row; falls back to the
+        delegate store for single-device HLLs."""
+        row = self._rows.get(target)
+        if row is None:
+            self._delegate.run("hll_export", target, ops)
+            return
+        regs = np.asarray(self.bank[row]).astype(np.uint8)
+        version = self._row_versions.get(target, 0)
+        for op in ops:
+            op.future.set_result((regs, version))
+
+    def _op_hll_import(self, target: str, ops: List[Op]) -> None:
+        """Overwrite (or create) a bank row from host registers — the
+        flush-restore / checkpoint-load path."""
+        for op in ops:
+            regs = np.asarray(op.payload["regs"]).astype(np.int32)
+            row = self.row_of(target)
+            self.bank = self.bank.at[row].set(regs)
+            self._row_versions[target] = self._row_versions.get(target, 0) + 1
+            op.future.set_result(True)
