@@ -535,6 +535,13 @@ class RedissonTPU:
         if self._watchdog is not None:
             self._watchdog.shutdown()
         self._executor.shutdown()
+        sketch = getattr(getattr(self, "_routing", None), "sketch", None)
+        completer = getattr(sketch, "completer", None)
+        if completer is not None:
+            # Resolve every future whose device result is still in flight
+            # before tearing the rest down (the dispatcher only dispatches;
+            # materialization happens on the completer thread).
+            completer.shutdown()
         if getattr(self._routing, "structures", None) is not None:
             # Dispatcher has exited: release threads parked in blocking pops.
             self._routing.structures.fail_waiters()

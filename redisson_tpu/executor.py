@@ -85,7 +85,14 @@ class CommandExecutor:
         op = Op(target=target, kind=kind, payload=payload, nkeys=nkeys)
         with self._cv:
             if self._shutdown:
-                raise RuntimeError("executor is shut down")
+                # Drain-then-reject: ops already queued at shutdown() still
+                # run, but a submission racing shutdown gets a *failed
+                # future* — raising here would surface as an unhandled
+                # exception in whatever background thread submitted (the
+                # reference's shutdown latch rejects the same way,
+                # `MasterSlaveConnectionManager.java:651-662`).
+                op.future.set_exception(RuntimeError("executor is shut down"))
+                return op.future
             q = self._queues.get(target)
             if q is None:
                 q = self._queues[target] = deque()

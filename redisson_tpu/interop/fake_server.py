@@ -67,6 +67,10 @@ class _ZSet(dict):
     """member -> score; its own type so TYPE can tell it from a hash."""
 
 
+class _Geo(dict):
+    """member -> (lon, lat); its own type so TYPE can tell it from a hash."""
+
+
 class FakeRedisServer:
     """asyncio RESP server over an in-memory dict. start()/stop(); the
     listening port is self.port (0 -> ephemeral)."""
@@ -356,6 +360,8 @@ class FakeRedisServer:
             return b"+none\r\n"
         if isinstance(v, _ZSet):
             return b"+zset\r\n"
+        if isinstance(v, _Geo):
+            return b"+zset\r\n"  # real Redis stores geo as a zset
         if isinstance(v, dict):
             return b"+hash\r\n"
         if isinstance(v, set):
@@ -417,7 +423,7 @@ class FakeRedisServer:
 
     def _hash(self, k: bytes) -> dict:
         v = self.data.setdefault(k, {})
-        if not isinstance(v, dict) or isinstance(v, _ZSet):
+        if not isinstance(v, dict) or isinstance(v, (_ZSet, _Geo)):
             raise ValueError("WRONGTYPE")
         return v
 
@@ -463,7 +469,7 @@ class FakeRedisServer:
     def _hash_read(self, k: bytes):
         """Read-side hash lookup; WRONGTYPE on zsets (dict subclasses)."""
         v = self.data.get(k)
-        if v is not None and (not isinstance(v, dict) or isinstance(v, _ZSet)):
+        if v is not None and (not isinstance(v, dict) or isinstance(v, (_ZSet, _Geo))):
             raise ValueError("WRONGTYPE")
         return v
 
@@ -777,12 +783,8 @@ class FakeRedisServer:
 
     def _cmd_zrangebyscore(self, a):
         items = self._zrangebyscore_items(a)
-        rest = [bytes(x).upper() for x in a[3:]]
-        withscores = b"WITHSCORES" in rest
-        if b"LIMIT" in rest:
-            i = rest.index(b"LIMIT")
-            off, cnt = int(a[3 + i + 1]), int(a[3 + i + 2])
-            items = items[off:] if cnt < 0 else items[off : off + cnt]
+        withscores = b"WITHSCORES" in [bytes(x).upper() for x in a[3:]]
+        items = self._apply_limit(items, a, 3)
         out = []
         for m, s in items:
             out.append(_bulk(m))
@@ -801,6 +803,465 @@ class FakeRedisServer:
         if isinstance(v, _ZSet) and not v:
             self.data.pop(bytes(a[0]), None)
         return _int(len(items))
+
+    # -- set algebra / sampling (RedisCommands.java:60-128 families) --------
+
+    def _cmd_spop(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, set) or not v:
+            return _array([]) if len(a) > 1 else _bulk(None)
+        if len(a) > 1:
+            n = min(int(a[1]), len(v))
+            out = [v.pop() for _ in range(n)]
+            if not v:
+                self.data.pop(bytes(a[0]), None)
+            return _array([_bulk(m) for m in out])
+        m = v.pop()
+        if not v:
+            self.data.pop(bytes(a[0]), None)
+        return _bulk(m)
+
+    def _cmd_srandmember(self, a):
+        import random as _random
+
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, set) or not v:
+            return _array([]) if len(a) > 1 else _bulk(None)
+        members = list(v)
+        if len(a) > 1:
+            n = int(a[1])
+            if n < 0:
+                picks = [_random.choice(members) for _ in range(-n)]
+            else:
+                picks = _random.sample(members, min(n, len(members)))
+            return _array([_bulk(m) for m in picks])
+        return _bulk(_random.choice(members))
+
+    def _cmd_smove(self, a):
+        src = self.data.get(bytes(a[0]))
+        m = bytes(a[2])
+        if not isinstance(src, set) or m not in src:
+            return _int(0)
+        src.discard(m)
+        if not src:
+            self.data.pop(bytes(a[0]), None)
+        self._set(bytes(a[1])).add(m)
+        return _int(1)
+
+    def _sets_for(self, keys):
+        out = []
+        for k in keys:
+            v = self.data.get(bytes(k))
+            out.append(v if isinstance(v, set) else set())
+        return out
+
+    def _set_algebra(self, which: str, keys) -> set:
+        sets = self._sets_for(keys)
+        if not sets:
+            return set()
+        if which == "inter":
+            return set.intersection(*sets)
+        if which == "union":
+            return set.union(*sets)
+        return sets[0].difference(*sets[1:])
+
+    def _cmd_sinter(self, a):
+        return _array([_bulk(m) for m in sorted(self._set_algebra("inter", a))])
+
+    def _cmd_sunion(self, a):
+        return _array([_bulk(m) for m in sorted(self._set_algebra("union", a))])
+
+    def _cmd_sdiff(self, a):
+        return _array([_bulk(m) for m in sorted(self._set_algebra("diff", a))])
+
+    def _store_set(self, which: str, a):
+        result = self._set_algebra(which, a[1:])
+        dst = bytes(a[0])
+        if result:
+            self.data[dst] = set(result)
+        else:
+            self.data.pop(dst, None)
+        return _int(len(result))
+
+    def _cmd_sinterstore(self, a):
+        return self._store_set("inter", a)
+
+    def _cmd_sunionstore(self, a):
+        return self._store_set("union", a)
+
+    def _cmd_sdiffstore(self, a):
+        return self._store_set("diff", a)
+
+    # -- SCAN family --------------------------------------------------------
+    # COUNT is a hint in Redis; returning the full collection in one page
+    # with cursor 0 is valid protocol (real Redis does it for small keys).
+
+    @staticmethod
+    def _apply_limit(items, a, start: int):
+        """Shared [LIMIT off cnt] tail parsing for the range-by families."""
+        rest = [bytes(x).upper() for x in a[start:]]
+        if b"LIMIT" in rest:
+            i = rest.index(b"LIMIT")
+            off, cnt = int(a[start + i + 1]), int(a[start + i + 2])
+            items = items[off:] if cnt < 0 else items[off : off + cnt]
+        return items
+
+    @staticmethod
+    def _scan_match(a, start: int):
+        pat = None
+        rest = [bytes(x).upper() for x in a[start:]]
+        if b"MATCH" in rest:
+            pat = bytes(a[start + rest.index(b"MATCH") + 1])
+        return pat
+
+    @staticmethod
+    def _matches(m: bytes, pat) -> bool:
+        return pat is None or fnmatch.fnmatchcase(
+            m.decode("latin-1"), pat.decode("latin-1"))
+
+    def _cmd_sscan(self, a):
+        v = self.data.get(bytes(a[0]))
+        pat = self._scan_match(a, 2)
+        members = sorted(v) if isinstance(v, set) else []
+        members = [m for m in members if self._matches(m, pat)]
+        return _array([_bulk(b"0"), _array([_bulk(m) for m in members])])
+
+    def _cmd_hscan(self, a):
+        v = self.data.get(bytes(a[0]))
+        pat = self._scan_match(a, 2)
+        flat = []
+        if isinstance(v, dict) and not isinstance(v, (_ZSet, _Geo)):
+            for f, val in v.items():
+                if self._matches(f, pat):
+                    flat += [_bulk(f), _bulk(val)]
+        return _array([_bulk(b"0"), _array(flat)])
+
+    def _cmd_zscan(self, a):
+        v = self.data.get(bytes(a[0]))
+        pat = self._scan_match(a, 2)
+        flat = []
+        if isinstance(v, _ZSet):
+            for m, s in sorted(v.items(), key=lambda kv: (kv[1], kv[0])):
+                if self._matches(m, pat):
+                    flat += [_bulk(m), _bulk(repr(s).encode())]
+        return _array([_bulk(b"0"), _array(flat)])
+
+    # -- zset rank / pop / lex / store --------------------------------------
+
+    def _cmd_zrank(self, a, rev=False):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet) or bytes(a[1]) not in v:
+            return _bulk(None)
+        ordered = sorted(v.items(), key=lambda kv: (kv[1], kv[0]))
+        if rev:
+            ordered = ordered[::-1]
+        for i, (m, _) in enumerate(ordered):
+            if m == bytes(a[1]):
+                return _int(i)
+        return _bulk(None)
+
+    def _cmd_zrevrank(self, a):
+        return self._cmd_zrank(a, rev=True)
+
+    def _zpop(self, a, last: bool):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet) or not v:
+            return _array([])
+        n = int(a[1]) if len(a) > 1 else 1
+        ordered = sorted(v.items(), key=lambda kv: (kv[1], kv[0]))
+        if last:
+            ordered = ordered[::-1]
+        out = []
+        for m, s in ordered[:n]:
+            del v[m]
+            out += [_bulk(m), _bulk(repr(s).encode())]
+        if not v:
+            self.data.pop(bytes(a[0]), None)
+        return _array(out)
+
+    def _cmd_zpopmin(self, a):
+        return self._zpop(a, last=False)
+
+    def _cmd_zpopmax(self, a):
+        return self._zpop(a, last=True)
+
+    def _cmd_zmscore(self, a):
+        v = self.data.get(bytes(a[0]))
+        out = []
+        for m in a[1:]:
+            if isinstance(v, _ZSet) and bytes(m) in v:
+                out.append(_bulk(repr(v[bytes(m)]).encode()))
+            else:
+                out.append(_bulk(None))
+        return _array(out)
+
+    @staticmethod
+    def _parse_lex_bound(raw: bytes, is_min: bool):
+        """(value, inclusive) for -, +, [m, (m syntax."""
+        s = bytes(raw)
+        if s == b"-":
+            return (None, True) if is_min else (b"", True)
+        if s == b"+":
+            return (None, True)
+        if s.startswith(b"["):
+            return s[1:], True
+        if s.startswith(b"("):
+            return s[1:], False
+        raise ValueError("min or max not valid string range item")
+
+    def _lex_items(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet):
+            return []
+        lo, lo_inc = self._parse_lex_bound(a[1], True)
+        hi, hi_inc = self._parse_lex_bound(a[2], False)
+        out = []
+        for m in sorted(v):
+            if lo is not None and (m < lo if lo_inc else m <= lo):
+                continue
+            if bytes(a[2]) != b"+":
+                if hi_inc and m > hi:
+                    continue
+                if not hi_inc and m >= hi:
+                    continue
+            out.append(m)
+        return out
+
+    def _cmd_zrangebylex(self, a):
+        items = self._lex_items(a)
+        items = self._apply_limit(items, a, 3)
+        return _array([_bulk(m) for m in items])
+
+    def _cmd_zrevrangebylex(self, a):
+        # args come as key max min
+        items = self._lex_items([a[0], a[2], a[1]])[::-1]
+        items = self._apply_limit(items, a, 3)
+        return _array([_bulk(m) for m in items])
+
+    def _cmd_zremrangebylex(self, a):
+        items = self._lex_items(a)
+        v = self.data.get(bytes(a[0]))
+        for m in items:
+            v.pop(m, None)
+        if isinstance(v, _ZSet) and not v:
+            self.data.pop(bytes(a[0]), None)
+        return _int(len(items))
+
+    def _cmd_zremrangebyrank(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _ZSet):
+            return _int(0)
+        ordered = sorted(v.items(), key=lambda kv: (kv[1], kv[0]))
+        start, stop = int(a[1]), int(a[2])
+        n = len(ordered)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        doomed = [] if stop < start else ordered[max(0, start) : stop + 1]
+        for m, _ in doomed:
+            del v[m]
+        if not v:
+            self.data.pop(bytes(a[0]), None)
+        return _int(len(doomed))
+
+    def _cmd_zrevrangebyscore(self, a):
+        # args: key max min [...] — reuse the ascending path with swapped
+        # bounds, then reverse.
+        items = self._zrangebyscore_items([a[0], a[2], a[1]])[::-1]
+        withscores = b"WITHSCORES" in [bytes(x).upper() for x in a[3:]]
+        items = self._apply_limit(items, a, 3)
+        out = []
+        for m, s in items:
+            out.append(_bulk(m))
+            if withscores:
+                out.append(_bulk(repr(s).encode()))
+        return _array(out)
+
+    def _zstore(self, which: str, a):
+        dst = bytes(a[0])
+        numkeys = int(a[1])
+        maps = []
+        for k in a[2 : 2 + numkeys]:
+            v = self.data.get(bytes(k))
+            maps.append(dict(v) if isinstance(v, _ZSet) else {})
+        if which == "union":
+            out = {}
+            for m in maps:
+                for member, score in m.items():
+                    out[member] = out.get(member, 0.0) + score
+        else:
+            common = set(maps[0]) if maps else set()
+            for m in maps[1:]:
+                common &= set(m)
+            out = {member: sum(m.get(member, 0.0) for m in maps) for member in common}
+        if out:
+            z = _ZSet()
+            z.update(out)
+            self.data[dst] = z
+        else:
+            self.data.pop(dst, None)
+        return _int(len(out))
+
+    def _cmd_zunionstore(self, a):
+        return self._zstore("union", a)
+
+    def _cmd_zinterstore(self, a):
+        return self._zstore("inter", a)
+
+    # -- list surgery -------------------------------------------------------
+
+    def _cmd_linsert(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list):
+            return _int(0)
+        where = bytes(a[1]).upper()
+        pivot, val = bytes(a[2]), bytes(a[3])
+        try:
+            idx = v.index(pivot)
+        except ValueError:
+            return _int(-1)
+        v.insert(idx if where == b"BEFORE" else idx + 1, val)
+        return _int(len(v))
+
+    def _cmd_ltrim(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list):
+            return _ok()
+        start, stop = int(a[1]), int(a[2])
+        n = len(v)
+        if start < 0:
+            start += n
+        if stop < 0:
+            stop += n
+        v[:] = [] if stop < max(0, start) else v[max(0, start) : stop + 1]
+        if not v:
+            self.data.pop(bytes(a[0]), None)
+        return _ok()
+
+    def _cmd_rpoplpush(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, list) or not v:
+            return _bulk(None)
+        item = v.pop()
+        if not v:
+            self.data.pop(bytes(a[0]), None)
+        self._list(bytes(a[1])).insert(0, item)
+        return _bulk(item)
+
+    def _cmd_lpos(self, a):
+        v = self.data.get(bytes(a[0]))
+        val = bytes(a[1])
+        rank = 1
+        rest = [bytes(x).upper() for x in a[2:]]
+        if b"RANK" in rest:
+            rank = int(a[2 + rest.index(b"RANK") + 1])
+        if not isinstance(v, list):
+            return _bulk(None)
+        order = range(len(v)) if rank > 0 else range(len(v) - 1, -1, -1)
+        for i in order:
+            if v[i] == val:
+                return _int(i)
+        return _bulk(None)
+
+    # -- geo (member -> (lon, lat); haversine, not geohash zsets) -----------
+
+    def _geo(self, k: bytes) -> "_Geo":
+        v = self.data.get(k)
+        if v is None:
+            v = self.data[k] = _Geo()
+        if not isinstance(v, _Geo):
+            raise ValueError("WRONGTYPE")
+        return v
+
+    def _cmd_geoadd(self, a):
+        g = self._geo(bytes(a[0]))
+        added = 0
+        for i in range(1, len(a) - 2, 3):
+            member = bytes(a[i + 2])
+            if member not in g:
+                added += 1
+            g[member] = (float(a[i]), float(a[i + 1]))
+        return _int(added)
+
+    def _cmd_geopos(self, a):
+        v = self.data.get(bytes(a[0]))
+        out = []
+        for m in a[1:]:
+            if isinstance(v, _Geo) and bytes(m) in v:
+                lon, lat = v[bytes(m)]
+                out.append(_array([_bulk(repr(lon).encode()),
+                                   _bulk(repr(lat).encode())]))
+            else:
+                out.append(b"*-1\r\n")
+        return _array(out)
+
+    @staticmethod
+    def _geo_unit_m(u: bytes) -> float:
+        return {b"M": 1.0, b"KM": 1000.0, b"MI": 1609.344, b"FT": 0.3048}[u.upper()]
+
+    def _cmd_geodist(self, a):
+        from redisson_tpu.structures.extended import _haversine_m
+
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _Geo):
+            return _bulk(None)
+        p1, p2 = v.get(bytes(a[1])), v.get(bytes(a[2]))
+        if p1 is None or p2 is None:
+            return _bulk(None)
+        d = float(_haversine_m(p1[0], p1[1], p2[0], p2[1]))
+        if len(a) > 3:
+            d /= self._geo_unit_m(bytes(a[3]))
+        return _bulk(repr(d).encode())
+
+    def _georadius(self, key: bytes, lon0: float, lat0: float, radius: float,
+                   unit: bytes, rest_args) -> bytes:
+        from redisson_tpu.structures.extended import _haversine_m
+
+        v = self.data.get(key)
+        if not isinstance(v, _Geo) or not v:
+            return _array([])
+        rest = [bytes(x).upper() for x in rest_args]
+        withcoord = b"WITHCOORD" in rest
+        withdist = b"WITHDIST" in rest
+        count = None
+        if b"COUNT" in rest:
+            count = int(rest_args[rest.index(b"COUNT") + 1])
+        unit_m = self._geo_unit_m(unit)
+        radius_m = radius * unit_m
+        hits = []
+        for m, (lon, lat) in v.items():
+            d = float(_haversine_m(lon0, lat0, lon, lat))
+            if d <= radius_m:
+                hits.append((m, d / unit_m, lon, lat))
+        hits.sort(key=lambda h: h[1])
+        if count is not None:
+            hits = hits[:count]
+        out = []
+        for m, d, lon, lat in hits:
+            if not withcoord and not withdist:
+                out.append(_bulk(m))
+                continue
+            row = [_bulk(m)]
+            if withdist:
+                row.append(_bulk(repr(d).encode()))
+            if withcoord:
+                row.append(_array([_bulk(repr(lon).encode()),
+                                   _bulk(repr(lat).encode())]))
+            out.append(_array(row))
+        return _array(out)
+
+    def _cmd_georadius(self, a):
+        return self._georadius(bytes(a[0]), float(a[1]), float(a[2]),
+                               float(a[3]), bytes(a[4]), a[5:])
+
+    def _cmd_georadiusbymember(self, a):
+        v = self.data.get(bytes(a[0]))
+        if not isinstance(v, _Geo) or bytes(a[1]) not in v:
+            return _array([])
+        lon0, lat0 = v[bytes(a[1])]
+        return self._georadius(bytes(a[0]), lon0, lat0, float(a[2]),
+                               bytes(a[3]), a[4:])
 
     # -- scripting (EVAL via the mini-Lua interpreter) ----------------------
 

@@ -43,11 +43,20 @@ class RHyperLogLog(RObject):
         return self.add_ints_async(values).result()
 
     def add_ints_async(self, values: np.ndarray):
+        # Zero-copy ingest: ship the keys' raw little-endian uint32 view
+        # ([:, 0]=lo, [:, 1]=hi); the lane split and the validity mask are
+        # computed on device (engine.hll_add_packed). The host never touches
+        # the payload beyond the (elided when already uint64-contiguous)
+        # dtype normalization — this is the 100M/s surface.
+        #
+        # BORROW CONTRACT: the array is enqueued by reference, not copied —
+        # the caller must not mutate `values` until the returned future
+        # resolves (copy first if reusing the buffer; add_all() is the
+        # always-copies path).
         values = np.ascontiguousarray(values, np.uint64)
-        hi = (values >> np.uint64(32)).astype(np.uint32)
-        lo = (values & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        packed = values.view(np.uint32).reshape(-1, 2)
         return self._executor.execute_async(
-            self.name, "hll_add", {"hi": hi, "lo": lo}, nkeys=values.shape[0]
+            self.name, "hll_add", {"packed": packed}, nkeys=values.shape[0]
         )
 
     # -- reads --------------------------------------------------------------

@@ -49,6 +49,12 @@ class PodBackend:
         self.store = SketchStore(device=self.mesh.devices.flat[0])
         self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed)
 
+    @property
+    def completer(self):
+        """The delegate's completer — exposed so client.shutdown() drains
+        pod-mode bitset/bloom completions exactly like single-chip mode."""
+        return self._delegate.completer
+
     # -- routing ------------------------------------------------------------
 
     def row_of(self, name: str) -> int:
@@ -160,6 +166,10 @@ class PodBackend:
         bytes, so local and pod estimates agree bit-for-bit (VERDICT r1
         item #7 — replaces the round-1 FNV-1a id fold)."""
         p = op.payload
+        if "packed" in p:
+            # Raw LE uint32 view of the key buffer ([:, 0]=lo, [:, 1]=hi);
+            # strided views here, materialized by the later concatenate.
+            return p["packed"][:, 1], p["packed"][:, 0], False
         if "hi" in p:
             return p["hi"], p["lo"], False
         from redisson_tpu import native

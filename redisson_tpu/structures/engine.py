@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import fnmatch
 import itertools
+import random
 import threading
 import time
 from collections import deque
@@ -37,6 +38,11 @@ from redisson_tpu.structures.extended import ExtendedOps
 
 def now_ms() -> int:
     return int(time.time() * 1000)
+
+
+# SRANDMEMBER randomness (os-entropy seeded; r2 used a now_ms()-derived
+# window, so two calls in the same millisecond returned identical members).
+_rand = random.Random()
 
 
 class T:
@@ -63,6 +69,10 @@ class KV:
     otype: str
     value: Any
     expire_at: Optional[int] = None  # epoch ms
+    # SCAN support: member -> monotonic stamp, assigned on first sight by a
+    # scan, dropped on deletion, never renumbered (see Engine._scan_page).
+    scan_seq: Optional[Dict[Any, int]] = None
+    scan_next: int = 1
 
 
 @dataclass
@@ -558,14 +568,58 @@ class StructureBackend(ExtendedOps):
         kv.value[f] = repr(val).encode() if as_float else str(val).encode()
         op.future.set_result(val)
 
+    def _scan_page(self, kv, cursor: int, count: int):
+        """Stable-cursor SCAN page over a hash/set/zset entry.
+
+        Members are stamped with a monotonic per-entry sequence number the
+        first time a scan sees them; a page is the `count` live members with
+        stamp > cursor, in stamp order. Deleting a member drops its stamp
+        without renumbering the others, so an element present for the whole
+        scan is returned exactly once regardless of concurrent mutation (the
+        guarantee the reference's iterators rely on,
+        `RedissonBaseIterator.java`); members added or re-added mid-scan
+        stamp after the cursor and are seen at most once.
+        """
+        if kv.scan_seq is None:
+            kv.scan_seq = {}
+        seqs = kv.scan_seq
+        members = kv.value  # dict (hash/zset field map) or set
+        for m in [m for m in seqs if m not in members]:
+            del seqs[m]
+        for m in members:
+            if m not in seqs:
+                seqs[m] = kv.scan_next
+                kv.scan_next += 1
+        # seqs is insertion-ordered = stamp-ascending (new stamps append,
+        # deletions don't reorder), so a page is one ordered walk — no sort.
+        page: list = []
+        more = False
+        for m, s in seqs.items():
+            if s <= cursor:
+                continue
+            if len(page) < count:
+                page.append((s, m))
+            else:
+                more = True
+                break
+        if not more:
+            # Scan complete: drop the stamp map so a scanned 1M-member set
+            # doesn't carry a permanent member->stamp shadow. A concurrent
+            # scan still in flight degrades to at-least-once (fresh stamps
+            # may re-return members) — Redis SCAN's own guarantee.
+            kv.scan_seq = None
+            return 0, [m for _, m in page]
+        return page[-1][0], [m for _, m in page]
+
     def _op_hscan(self, key: str, op: Op) -> None:
         """Cursor iteration (HSCAN): returns (next_cursor, [(f, v)...])."""
         kv = self._entry(key, T.HASH)
-        items = [] if kv is None else list(kv.value.items())
         cursor, count = op.payload["cursor"], op.payload.get("count", 10)
-        chunk = items[cursor : cursor + count]
-        nxt = cursor + count
-        op.future.set_result((0 if nxt >= len(items) else nxt, chunk))
+        if kv is None:
+            op.future.set_result((0, []))
+            return
+        nxt, fields = self._scan_page(kv, cursor, count)
+        op.future.set_result((nxt, [(f, kv.value[f]) for f in fields]))
 
     # -- set (RSet) ----------------------------------------------------------
 
@@ -619,8 +673,11 @@ class StructureBackend(ExtendedOps):
             return
         count = op.payload.get("count", 1)
         members = list(kv.value)
-        start = now_ms() % len(members)
-        op.future.set_result([members[(start + i) % len(members)] for i in range(min(count, len(members)))])
+        if count < 0:
+            # Redis semantics: negative count samples with repetition.
+            op.future.set_result(_rand.choices(members, k=-count))
+            return
+        op.future.set_result(_rand.sample(members, min(count, len(members))))
 
     def _op_smove(self, key: str, op: Op) -> None:
         kv = self._entry(key, T.SET)
@@ -682,11 +739,11 @@ class StructureBackend(ExtendedOps):
 
     def _op_sscan(self, key: str, op: Op) -> None:
         kv = self._entry(key, T.SET)
-        items = [] if kv is None else sorted(kv.value)
         cursor, count = op.payload["cursor"], op.payload.get("count", 10)
-        chunk = items[cursor : cursor + count]
-        nxt = cursor + count
-        op.future.set_result((0 if nxt >= len(items) else nxt, chunk))
+        if kv is None:
+            op.future.set_result((0, []))
+            return
+        op.future.set_result(self._scan_page(kv, cursor, count))
 
     # -- list (RList / RQueue / RDeque) --------------------------------------
 
@@ -1169,11 +1226,12 @@ class StructureBackend(ExtendedOps):
 
     def _op_zscan(self, key: str, op: Op) -> None:
         kv = self._entry(key, T.ZSET)
-        items = [] if kv is None else self._zsorted(kv.value)
         cursor, count = op.payload["cursor"], op.payload.get("count", 10)
-        chunk = items[cursor : cursor + count]
-        nxt = cursor + count
-        op.future.set_result((0 if nxt >= len(items) else nxt, chunk))
+        if kv is None:
+            op.future.set_result((0, []))
+            return
+        nxt, members = self._scan_page(kv, cursor, count)
+        op.future.set_result((nxt, [(m, kv.value[m]) for m in members]))
 
     # -- pub/sub -------------------------------------------------------------
 

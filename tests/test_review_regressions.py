@@ -241,3 +241,24 @@ def test_map_cache_delete_unschedules_sweep(client):
     mc.put("a", 1)
     mc.delete()
     assert "reg:mc:del" not in client._eviction._timers
+
+
+def test_executor_shutdown_race_returns_failed_future():
+    """A submission racing shutdown gets a failed future, not an exception
+    raised into the submitting (possibly non-test) thread (VERDICT r2 weak
+    #6). Ops queued before shutdown still drain."""
+    from redisson_tpu.executor import CommandExecutor
+
+    class Backend:
+        def run(self, kind, target, ops):
+            for op in ops:
+                op.future.set_result(kind)
+
+    ex = CommandExecutor(Backend())
+    pre = ex.execute_async("t", "noop", None)
+    ex.shutdown(wait=True)
+    assert pre.result(timeout=5) == "noop"  # drained
+    post = ex.execute_async("t", "noop", None)
+    assert post.done()
+    with pytest.raises(RuntimeError, match="shut down"):
+        post.result()
