@@ -112,26 +112,44 @@ class RedissonTPU:
     def _make_resp_pool(self):
         """Connection pool to the configured redis endpoint — shared by
         passthrough traffic, blocking pops, coordination scripts and
-        durability flushes (ConnectionPool.java role)."""
+        durability flushes (ConnectionPool.java role). With slave_addresses
+        configured, a MasterSlaveRouter (write-to-master, balanced reads,
+        freeze-driven promotion, MOVED/ASK redirects) wraps one pool per
+        endpoint (MasterSlaveEntry.java:53-250)."""
         from urllib.parse import urlparse
 
         from redisson_tpu.interop.pool import RespConnectionPool
 
         rcfg = self.config.redis
+
+        def factory(host: str, port: int) -> RespConnectionPool:
+            return RespConnectionPool(
+                host=host or "127.0.0.1",
+                port=port or 6379,
+                password=rcfg.password,
+                db=rcfg.database,
+                timeout=rcfg.timeout_ms / 1000.0,
+                retry_attempts=rcfg.retry_attempts,
+                retry_interval=rcfg.retry_interval_ms / 1000.0,
+                size=rcfg.connection_pool_size,
+                min_idle=rcfg.connection_minimum_idle_size,
+                failed_attempts=rcfg.failed_attempts,
+                reconnection_timeout=rcfg.reconnection_timeout_ms / 1000.0,
+                idle_timeout=rcfg.idle_connection_timeout_ms / 1000.0,
+            )
+
         u = urlparse(rcfg.address)
-        return RespConnectionPool(
-            host=u.hostname or "127.0.0.1",
-            port=u.port or 6379,
-            password=rcfg.password,
-            db=rcfg.database,
-            timeout=rcfg.timeout_ms / 1000.0,
-            retry_attempts=rcfg.retry_attempts,
-            retry_interval=rcfg.retry_interval_ms / 1000.0,
-            size=rcfg.connection_pool_size,
-            min_idle=rcfg.connection_minimum_idle_size,
-            failed_attempts=rcfg.failed_attempts,
-            reconnection_timeout=rcfg.reconnection_timeout_ms / 1000.0,
-        )
+        if rcfg.slave_addresses:
+            from redisson_tpu.interop.topology_redis import MasterSlaveRouter
+
+            return MasterSlaveRouter(
+                factory,
+                f"{u.hostname or '127.0.0.1'}:{u.port or 6379}",
+                rcfg.slave_addresses,
+                read_mode=rcfg.read_mode,
+            )
+        pool = factory(u.hostname, u.port)
+        return pool
 
     def _init_redis_mode(self):
         from redisson_tpu.interop.backend_redis import RedisBackend
@@ -155,7 +173,11 @@ class RedissonTPU:
         # the reference's own execution model.
         self._pubsub = None
         self._watchdog = None
-        self._eviction = None
+        # Redis-mode map caches register their Lua sweep here, so TTL
+        # entries are physically removed without manual evict_expired calls
+        # (the reference registers every map cache with EvictionScheduler,
+        # RedissonMapCache.java:91-96; r2 advisor finding #3).
+        self._eviction = EvictionScheduler()
         self._remote_services = {}
         self._durability = None
         from redisson_tpu.interop.coordination_redis import ScriptRunner
@@ -179,11 +201,20 @@ class RedissonTPU:
             if self._redis_pubsub is None:
                 rcfg = self.config.redis
                 u = urlparse(rcfg.address)
+                # Follow master promotion: when a MasterSlaveRouter fronts
+                # the endpoints, every (re)dial asks it for the current
+                # master so lock wake-ups survive failover.
+                addr_provider = None
+                if getattr(self._resp, "master_address", None) is not None:
+                    def addr_provider():
+                        host, _, port = self._resp.master_address.rpartition(":")
+                        return host, int(port)
                 pubsub = SyncPubSubClient(
                     host=u.hostname or "127.0.0.1",
                     port=u.port or 6379,
                     password=rcfg.password,
                     timeout=rcfg.timeout_ms / 1000.0,
+                    addr_provider=addr_provider,
                 )
                 try:
                     pubsub.connect()
@@ -313,7 +344,9 @@ class RedissonTPU:
         if self._mode == "redis":
             from redisson_tpu.interop.coordination_redis import RedisMapCache
 
-            return RedisMapCache(name, self._redis_scripts, self._resolve_codec(codec))
+            cache = RedisMapCache(name, self._redis_scripts, self._resolve_codec(codec))
+            self._eviction.schedule(name, cache.evict_expired)
+            return cache
         return RMapCache(
             name, self._executor, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 
 @dataclass
@@ -55,6 +55,11 @@ class RedisConfig:
     """RESP passthrough / durability flush target."""
 
     address: str = "redis://127.0.0.1:6379"
+    # Master/slave topology (BaseMasterSlaveServersConfig): writes go to
+    # `address`, reads balance over `slave_addresses` per `read_mode`
+    # (SLAVE | MASTER | MASTER_SLAVE). Empty = single endpoint.
+    slave_addresses: List[str] = dataclasses.field(default_factory=list)
+    read_mode: str = "SLAVE"
     timeout_ms: int = 3000  # BaseConfig.timeout
     retry_attempts: int = 3  # BaseConfig.retryAttempts
     retry_interval_ms: int = 1000  # BaseConfig.retryInterval
@@ -65,6 +70,7 @@ class RedisConfig:
     connection_minimum_idle_size: int = 1  # masterConnectionMinimumIdleSize
     failed_attempts: int = 3  # freeze threshold (ConnectionPool.java:184-186)
     reconnection_timeout_ms: int = 3000  # re-probe period (:297-386)
+    idle_connection_timeout_ms: int = 10000  # reaper (IdleConnectionWatcher)
 
 
 @dataclass

@@ -18,18 +18,24 @@ BATCH_LIMIT = 300
 
 
 class EvictionScheduler:
-    def __init__(self, executor):
+    def __init__(self, executor=None):
         self._executor = executor
         self._delays: Dict[str, float] = {}
         self._empty_runs: Dict[str, int] = {}
         self._timers: Dict[str, threading.Timer] = {}
+        self._sweeps: Dict[str, object] = {}  # name -> callable(limit)->int
         self._lock = threading.Lock()
         self._shutdown = False
 
-    def schedule(self, name: str) -> None:
+    def schedule(self, name: str, sweep=None) -> None:
+        """Register an object for adaptive sweeping. Default sweep is the
+        engine's `mc_evict_expired` op; redis-mode caches pass their own
+        sweep callable (the batched Lua, RedisMapCache.evict_expired)."""
         with self._lock:
             if self._shutdown or name in self._timers:
                 return
+            if sweep is not None:
+                self._sweeps[name] = sweep
             self._delays[name] = MIN_DELAY_S
             self._empty_runs[name] = 0
             self._arm(name)
@@ -41,10 +47,14 @@ class EvictionScheduler:
         t.start()
 
     def _run(self, name: str) -> None:
+        sweep = self._sweeps.get(name)
         try:
-            removed = self._executor.execute_sync(
-                name, "mc_evict_expired", {"limit": BATCH_LIMIT}
-            )
+            if sweep is not None:
+                removed = sweep(BATCH_LIMIT)
+            else:
+                removed = self._executor.execute_sync(
+                    name, "mc_evict_expired", {"limit": BATCH_LIMIT}
+                )
         except Exception:
             removed = 0
         with self._lock:
@@ -70,6 +80,7 @@ class EvictionScheduler:
                 t.cancel()
             self._delays.pop(name, None)
             self._empty_runs.pop(name, None)
+            self._sweeps.pop(name, None)
 
     def shutdown(self) -> None:
         with self._lock:

@@ -60,6 +60,10 @@ def _ck(v):
 class RedisBackend:
     """Backend for CommandExecutor whose run() executes via RESP."""
 
+    # Observability: times a blocking pop's reply window expired with the
+    # popped value unknown (potential element loss — see _op_bpop).
+    blocking_pop_loss_windows = 0
+
     def __init__(self, client: SyncRespClient):
         self.client = client
 
@@ -390,6 +394,20 @@ class RedisBackend:
                         response_timeout=response_timeout)
                     value = None if v is None else bytes(v[1])
             except Exception as e:  # noqa: BLE001
+                if isinstance(e, TimeoutError) and dest is None:
+                    # Response window expired exactly as the server may have
+                    # popped: the element's value is unknown, so it cannot be
+                    # requeued — a silent-loss window. Count + log so
+                    # operators can see it (r2 advisor finding; exactly-once
+                    # callers should use poll_last_and_offer_first_to /
+                    # BRPOPLPUSH, which lands the value in dest regardless).
+                    import logging
+
+                    type(self).blocking_pop_loss_windows += 1
+                    logging.getLogger(__name__).warning(
+                        "blocking pop on %r timed out in the reply window; "
+                        "a popped element may be lost (total windows: %d)",
+                        key, type(self).blocking_pop_loss_windows)
                 if not op.future.done():
                     try:
                         op.future.set_exception(e)

@@ -443,6 +443,23 @@ class RedisReadWriteLock:
     end
     redis.call('hdel', KEYS[1], ARGV[2])
     if (redis.call('hlen', KEYS[1]) > 1) then
+        -- Recompute mode from the remaining hold fields: when the released
+        -- write hold leaves only read holds (the writer-reads-then-releases
+        -- downgrade this tier allows), flip mode to 'read' and publish so
+        -- blocked readers/writers stop TTL-paced polling (r2 advisor
+        -- finding: mode stayed 'write' and no wake-up was published).
+        local fields = redis.call('hkeys', KEYS[1])
+        local writers = 0
+        for i = 1, #fields do
+            local f = fields[i]
+            if (f ~= 'mode') and (string.sub(f, -6) == ':write') then
+                writers = writers + 1
+            end
+        end
+        if (writers == 0) and (redis.call('hget', KEYS[1], 'mode') == 'write') then
+            redis.call('hset', KEYS[1], 'mode', 'read')
+            redis.call('publish', KEYS[2], ARGV[1])
+        end
         return 2
     end
     redis.call('del', KEYS[1])
@@ -846,8 +863,11 @@ class RedisMapCache:
             [self._k(key), self._codec.encode(value), ttl_ms, _now_ms()])
         return None if old is None else self._codec.decode(old)
 
-    def put_if_absent(self, key, value, ttl_s: float = 0):
+    def put_if_absent(self, key, value, ttl_s: float = 0, max_idle_s: float = 0):
         ttl_ms = int(ttl_s * 1000) if ttl_s else 0
+        if max_idle_s:
+            idle_ms = int(max_idle_s * 1000)
+            ttl_ms = min(ttl_ms, idle_ms) if ttl_ms else idle_ms
         old = self._scripts.run(
             self.PUT_IF_ABSENT, [self.name, self.timeout_set_name],
             [self._k(key), self._codec.encode(value), ttl_ms, _now_ms()])
@@ -882,6 +902,9 @@ class RedisMapCache:
         n = self._scripts.resp.execute(
             "DEL", self.name, self.timeout_set_name)
         return bool(n)
+
+    def clear(self) -> None:
+        self.delete()
 
 
 class RedisScript:

@@ -63,6 +63,15 @@ def _array(items: List[bytes]) -> bytes:
     return b"*%d\r\n" % len(items) + b"".join(items)
 
 
+def _readonly_for_replication() -> frozenset:
+    """Commands a master must NOT forward to replicas: the router's read
+    set (single source of truth — drift between read-routing and fake
+    replication makes master/slave tests lie) plus pure-admin commands."""
+    from redisson_tpu.interop.topology_redis import READ_COMMANDS
+
+    return READ_COMMANDS | {"ECHO", "SELECT", "AUTH", "SCRIPT", "PUBLISH"}
+
+
 class _ZSet(dict):
     """member -> score; its own type so TYPE can tell it from a hash."""
 
@@ -93,6 +102,19 @@ class FakeRedisServer:
         # blocking-command reattach machinery).
         self._push_cond = asyncio.Condition()
         self._stopping = False
+        # -- topology fixtures (in-process master/slave + cluster fakes,
+        # the SURVEY §4 "improve on the reference" fake-topology point) --
+        # Write commands are forwarded to replicas (must share this
+        # server's event loop — EmbeddedRedis.pair wires that up).
+        self.replicas: List["FakeRedisServer"] = []
+        # slot -> "host:port" owned elsewhere: keyed commands for these
+        # slots get "-MOVED slot addr" (ClusterConnectionManager redirect).
+        self.moved_slots: Dict[int, str] = {}
+        # key (bytes) -> "host:port" mid-migration: replies "-ASK slot addr";
+        # the importing side lists the key in `importing` and only serves it
+        # on a connection that sent ASKING first.
+        self.ask_keys: Dict[bytes, str] = {}
+        self.importing: set = set()
 
     async def start(self) -> None:
         self._stopping = False
@@ -122,6 +144,7 @@ class FakeRedisServer:
         self._writers.add(writer)
         parser = native.RespParser()
         authed = self.password is None
+        asking = False  # set by ASKING, whitelists exactly the next command
         try:
             while True:
                 data = await reader.read(1 << 16)
@@ -143,19 +166,32 @@ class FakeRedisServer:
                     if name == "DROPCONN":
                         writer.close()
                         return
+                    if name == "ASKING":
+                        asking = True
+                        writer.write(_ok())
+                        continue
                     try:
                         if name in ("SUBSCRIBE", "UNSUBSCRIBE", "PSUBSCRIBE",
                                     "PUNSUBSCRIBE"):
                             writer.write(self._do_subscribe(name, args, writer))
                         elif name in ("BLPOP", "BRPOP", "BRPOPLPUSH"):
-                            writer.write(await self._blocking_pop(name, args))
+                            reply = await self._blocking_pop(name, args)
+                            writer.write(reply)
+                            self._replicate_blocking_pop(name, args, reply)
                         else:
-                            writer.write(self._dispatch(name, args))
-                            # Wake parked blocking-pop waiters to re-check.
-                            async with self._push_cond:
-                                self._push_cond.notify_all()
+                            redirect = self._redirect_for(name, args, asking)
+                            if redirect is not None:
+                                writer.write(redirect)
+                            else:
+                                writer.write(self._dispatch(name, args))
+                                self._replicate(name, args)
+                                # Wake parked blocking-pop waiters to re-check.
+                                async with self._push_cond:
+                                    self._push_cond.notify_all()
                     except Exception as e:  # noqa: BLE001
                         writer.write(_err(str(e)))
+                    finally:
+                        asking = False
                 await writer.drain()
         except (ConnectionError, OSError):
             pass
@@ -167,6 +203,69 @@ class FakeRedisServer:
                 writer.close()
             except Exception:
                 pass
+
+    # -- topology fixtures ---------------------------------------------------
+
+    # Commands whose first arg is NOT a key (redirect check skips them).
+    _UNKEYED = frozenset({
+        "PING", "ECHO", "SELECT", "DBSIZE", "FLUSHALL", "KEYS", "SCRIPT",
+        "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN",
+    })
+
+    def _redirect_for(self, name: str, a: List[bytes], asking: bool):
+        """-MOVED / -ASK replies for the cluster-fixture maps (real cluster
+        redirect semantics: `cluster/ClusterConnectionManager.java:543-558`,
+        importing nodes demand ASKING)."""
+        if name in self._UNKEYED or not a:
+            return None
+        key = bytes(a[0])
+        if self.importing and key in self.importing and not asking:
+            # Importing side: only an ASKING-prefixed command may touch it.
+            return _err(f"key {key!r} is importing; ASKING required")
+        if self.ask_keys and key in self.ask_keys:
+            from redisson_tpu.ops import crc16
+
+            slot = crc16.key_slot(key.decode("utf-8", "replace"))
+            return f"-ASK {slot} {self.ask_keys[key]}\r\n".encode()
+        if self.moved_slots:
+            from redisson_tpu.ops import crc16
+
+            slot = crc16.key_slot(key.decode("utf-8", "replace"))
+            owner = self.moved_slots.get(slot)
+            if owner is not None:
+                return f"-MOVED {slot} {owner}\r\n".encode()
+        return None
+
+    def _replicate(self, name: str, a: List[bytes]) -> None:
+        """Forward write commands to replica servers (must share this
+        server's event loop). The reference tests against real replicating
+        redis-servers; this is the in-process equivalent."""
+        if not self.replicas or name.upper() in _readonly_for_replication():
+            return
+        for r in self.replicas:
+            try:
+                r._dispatch(name, [bytes(x) for x in a])
+            except Exception:  # noqa: BLE001 - a broken replica stays broken
+                pass
+
+    def _replicate_blocking_pop(self, name: str, a: List[bytes],
+                                reply: bytes) -> None:
+        """Blocking pops consume destructively on the master only; forward
+        the equivalent non-blocking effect so replica lists don't diverge
+        (replication of effects, as real Redis propagates LPOP for BLPOP)."""
+        if not self.replicas or reply in (b"*-1\r\n", b"$-1\r\n"):
+            return
+        if name == "BRPOPLPUSH":
+            self._replicate("RPOPLPUSH", [bytes(a[0]), bytes(a[1])])
+            return
+        # BLPOP/BRPOP reply: [key, value] — pop that key on the replicas.
+        parser = native.RespParser()
+        try:
+            vals = parser.feed(reply)
+        finally:
+            parser.close()
+        popped_key = bytes(vals[0][0])
+        self._replicate("LPOP" if name == "BLPOP" else "RPOP", [popped_key])
 
     # -- command handlers ---------------------------------------------------
 
@@ -1451,12 +1550,21 @@ class EmbeddedRedis:
     """Run a FakeRedisServer on a background event-loop thread — the
     test fixture analogue of RedisRunner.startDefaultRedisServerInstance."""
 
-    def __init__(self, password: Optional[str] = None, port: int = 0):
+    def __init__(self, password: Optional[str] = None, port: int = 0,
+                 share_with: Optional["EmbeddedRedis"] = None):
         import threading
-        self._loop = asyncio.new_event_loop()
-        self._thread = threading.Thread(target=self._loop.run_forever,
-                                        name="rtpu-fake-redis", daemon=True)
-        self._thread.start()
+        if share_with is None:
+            self._loop = asyncio.new_event_loop()
+            self._thread = threading.Thread(target=self._loop.run_forever,
+                                            name="rtpu-fake-redis", daemon=True)
+            self._thread.start()
+            self._owns_loop = True
+        else:
+            # Same event loop as the peer: replication forwards between the
+            # two servers with plain calls, no cross-thread races.
+            self._loop = share_with._loop
+            self._thread = share_with._thread
+            self._owns_loop = False
         self.server = FakeRedisServer(password=password, port=port)
         asyncio.run_coroutine_threadsafe(self.server.start(), self._loop).result(10)
 
@@ -1465,15 +1573,33 @@ class EmbeddedRedis:
         """Restart fixture: bind an explicit port (kill/restart tests)."""
         return cls(password=password, port=port)
 
+    @classmethod
+    def pair(cls, password: Optional[str] = None):
+        """(master, slave) on one event loop with write replication — the
+        in-process analogue of the reference's replicating redis-server
+        fixtures (RedisRunner master/slave configs). Stop the slave first;
+        the master owns the loop."""
+        master = cls(password=password)
+        slave = cls(password=password, share_with=master)
+        master.server.replicas.append(slave.server)
+        return master, slave
+
     @property
     def port(self) -> int:
         return self.server.port
 
+    def kill(self) -> None:
+        """Fault injection: stop just the server (sockets die), leaving the
+        event loop running — required when this instance shares its loop
+        with a peer (pair()); the process-kill analogue."""
+        asyncio.run_coroutine_threadsafe(self.server.stop(), self._loop).result(10)
+
     def stop(self) -> None:
         asyncio.run_coroutine_threadsafe(self.server.stop(), self._loop).result(10)
-        self._loop.call_soon_threadsafe(self._loop.stop)
-        self._thread.join(timeout=5)
-        self._loop.close()
+        if self._owns_loop:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5)
+            self._loop.close()
 
     def __enter__(self):
         return self

@@ -42,7 +42,7 @@ class _AsyncPool:
     def __init__(self, host: str, port: int, *, password=None, db=0,
                  timeout=3.0, retry_attempts=3, retry_interval=1.0,
                  size=4, min_idle=1, failed_attempts=3,
-                 reconnection_timeout=3.0):
+                 reconnection_timeout=3.0, idle_timeout=10.0):
         self.host = host
         self.port = port
         self._mk = lambda: RespClient(
@@ -62,6 +62,10 @@ class _AsyncPool:
         self._lock = asyncio.Lock()
         self._listeners: List[Callable[[str], None]] = []
         self.freezes = 0  # observability
+        self.idle_timeout = idle_timeout
+        self.reaped = 0  # observability: idle connections retired
+        self._reaper_task: Optional[asyncio.Task] = None
+        self._last_used: dict = {}  # id(conn) -> monotonic seconds
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -76,6 +80,37 @@ class _AsyncPool:
                 errors.append(e)
         if not self._conns:
             raise errors[0] if errors else ConnectionClosed("no connections")
+        self._reaper_task = asyncio.ensure_future(self._reap_loop())
+
+    async def _reap_loop(self) -> None:
+        """Close connections idle past `idle_timeout`, keeping `min_idle`
+        alive (`connection/IdleConnectionWatcher.java:42-60`)."""
+        import time as _time
+
+        period = max(self.idle_timeout / 2, 0.05)
+        while not self._closed:
+            await asyncio.sleep(period)
+            async with self._lock:
+                live = [c for c in self._conns if c.connected]
+                if len(live) <= self.min_idle:
+                    continue
+                now = _time.monotonic()
+                for conn in live:
+                    if len([c for c in self._conns if c.connected]) <= self.min_idle:
+                        break
+                    if getattr(conn, "_pending", None):
+                        continue  # never close under an in-flight command
+                    last = self._last_used.get(id(conn))
+                    if last is not None and now - last > self.idle_timeout:
+                        self._conns.remove(conn)
+                        self._last_used.pop(id(conn), None)
+                        self.reaped += 1
+                        asyncio.ensure_future(conn.close())
+
+    def _touch(self, conn: RespClient) -> None:
+        import time as _time
+
+        self._last_used[id(conn)] = _time.monotonic()
 
     async def _dial_one(self, register: bool = True) -> RespClient:
         """Dial a fresh connection; register=False keeps it OUT of the
@@ -90,6 +125,7 @@ class _AsyncPool:
         self._note_success()
         if register:
             self._conns.append(conn)
+            self._touch(conn)
         self._fire("connect")
         return conn
 
@@ -147,7 +183,9 @@ class _AsyncPool:
                 raise ConnectionClosed("pool is closed")
             live = [c for c in self._conns if c.connected]
             if live:
-                return live[next(self._rr) % len(live)]
+                conn = live[next(self._rr) % len(live)]
+                self._touch(conn)
+                return conn
             if self._frozen:
                 raise EndpointFrozen(
                     f"{self.host}:{self.port} frozen after "
@@ -156,7 +194,9 @@ class _AsyncPool:
             # reconnects lazily on use; pick one and let execute() retry it,
             # or dial fresh if the pool is empty.
             if self._conns:
-                return self._conns[next(self._rr) % len(self._conns)]
+                conn = self._conns[next(self._rr) % len(self._conns)]
+                self._touch(conn)
+                return conn
             return await self._dial_one()
 
     async def _acquire_exclusive(self) -> RespClient:
@@ -175,10 +215,23 @@ class _AsyncPool:
         # Adopt the spare into the rotation if under budget, else close.
         if conn.connected and len(self._conns) < self.size:
             self._conns.append(conn)
+            self._touch(conn)
         else:
             asyncio.ensure_future(conn.close())
 
     # -- ops ----------------------------------------------------------------
+
+    @staticmethod
+    def _counts_toward_freeze(e: BaseException) -> bool:
+        """Only genuine connection failures freeze the endpoint (the
+        reference counts consecutive *connect* failures,
+        ConnectionPool.java:184-186). Response timeouts — including
+        PossiblyExecuted, a TimeoutError — are per-command errors: three
+        slow-but-successful commands on a healthy endpoint must not flip it
+        to fail-fast (r2 advisor finding)."""
+        if isinstance(e, (EndpointFrozen, TimeoutError)):
+            return False
+        return isinstance(e, (ConnectionError, OSError))
 
     async def execute(self, *args) -> Any:
         try:
@@ -187,7 +240,7 @@ class _AsyncPool:
             self._note_success()
             return result
         except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-            if not isinstance(e, EndpointFrozen):
+            if self._counts_toward_freeze(e):
                 self._note_failure()
             raise
 
@@ -206,12 +259,19 @@ class _AsyncPool:
             self._note_success()
             return result
         except (ConnectionError, OSError, asyncio.TimeoutError) as e:
-            if not isinstance(e, EndpointFrozen):
+            if self._counts_toward_freeze(e):
                 self._note_failure()
             raise
 
     async def close(self) -> None:
         self._closed = True
+        if self._reaper_task is not None:
+            self._reaper_task.cancel()
+            try:
+                await self._reaper_task
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reaper_task = None
         if self._probe_task is not None:
             self._probe_task.cancel()
             try:
@@ -296,6 +356,10 @@ class RespConnectionPool:
     @property
     def freezes(self) -> int:
         return self._pool.freezes
+
+    @property
+    def reaped(self) -> int:
+        return self._pool.reaped
 
     def close(self) -> None:
         try:

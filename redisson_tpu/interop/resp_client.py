@@ -405,9 +405,13 @@ class PubSubRespClient:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 6379, *,
                  password: Optional[str] = None, timeout: float = 3.0,
-                 reconnect_backoff_cap: int = 5):
+                 reconnect_backoff_cap: int = 5, addr_provider=None):
         self.host = host
         self.port = port
+        # Dynamic dial target: consulted before every dial so the subscribe
+        # connection follows master promotion (the reference reattaches
+        # pub/sub to the new master, MasterSlaveEntry.java:158-250).
+        self._addr_provider = addr_provider
         self.password = password
         self.timeout = timeout
         self.reconnect_backoff_cap = reconnect_backoff_cap
@@ -436,6 +440,11 @@ class PubSubRespClient:
             await self._dial()
 
     async def _dial(self) -> None:
+        if self._addr_provider is not None:
+            try:
+                self.host, self.port = self._addr_provider()
+            except Exception:  # noqa: BLE001 - keep the last-known address
+                pass
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), self.timeout)
         parser = native.RespParser()
@@ -547,6 +556,19 @@ class PubSubRespClient:
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     continue
 
+    def _ensure_redial(self) -> None:
+        """Schedule a reconnect when disconnected with no task in flight.
+
+        The read loop only schedules _reconnect() when subscriptions existed
+        at drop time; a connection that died while *idle* (zero
+        subscriptions) would otherwise never re-dial, silently degrading
+        every later lock/semaphore wait to timeout polling (r2 advisor
+        finding)."""
+        if self._closed or self.connected:
+            return
+        if self._reconnect_task is None or self._reconnect_task.done():
+            self._reconnect_task = asyncio.ensure_future(self._reconnect())
+
     async def subscribe(self, channel: str, listener) -> None:
         listeners = self._channels.setdefault(channel, [])
         listeners.append(listener)
@@ -554,6 +576,8 @@ class PubSubRespClient:
         if len(listeners) == 1 and self.connected:
             self._writer.write(native.resp_encode("SUBSCRIBE", channel))
             await self._writer.drain()
+        elif not self.connected:
+            self._ensure_redial()
 
     async def psubscribe(self, pattern: str, listener) -> None:
         listeners = self._patterns.setdefault(pattern, [])
@@ -562,6 +586,8 @@ class PubSubRespClient:
         if len(listeners) == 1 and self.connected:
             self._writer.write(native.resp_encode("PSUBSCRIBE", pattern))
             await self._writer.drain()
+        elif not self.connected:
+            self._ensure_redial()
 
     async def unsubscribe(self, channel: str, listener=None) -> None:
         listeners = self._channels.get(channel, [])
@@ -601,6 +627,13 @@ class PubSubRespClient:
             return True
         except asyncio.TimeoutError:
             return False
+
+    async def drop(self) -> None:
+        """Fault-injection hook: sever the TCP connection WITHOUT marking
+        the client closed — the read loop treats it exactly like a remote
+        drop (reconnect + desired-state replay if subscriptions exist)."""
+        if self._writer is not None:
+            self._writer.close()
 
     async def close(self) -> None:
         self._closed = True
@@ -663,6 +696,10 @@ class SyncPubSubClient:
     def wait_subscribed(self, name: str, timeout: float = 5.0) -> bool:
         return self._run(
             self._client.wait_subscribed(name, timeout), timeout + 10.0)
+
+    def drop_for_test(self) -> None:
+        """Sever the socket without closing the client (fault injection)."""
+        self._run(self._client.drop())
 
     def close(self) -> None:
         try:

@@ -1,0 +1,253 @@
+"""Multi-endpoint redis topology: master/slave routing, failover promotion,
+and cluster MOVED/ASK redirects.
+
+The reference's L1 layer: `connection/MasterSlaveEntry.java:53-250` (write
+pool on master, read pool per ReadMode with a slave balancer),
+`balancer/LoadBalancerManagerImpl.java:39-90` (round-robin slave choice +
+freeze/unfreeze), `cluster/ClusterConnectionManager.java:543-558` (CRC16
+key-slot routing) and `command/CommandAsyncService.java:593-600, 657-685`
+(MOVED re-route / ASK with ASKING prefix).
+
+Design (TPU build): one `RespConnectionPool` per endpoint — each already
+carries freeze-after-N-connect-failures and a background PING re-probe
+(`ConnectionPool.java:184-186, 297-386`) — and a thin sync router on top:
+
+  * writes -> master pool; a master whose pool is frozen (or that raises a
+    connect error) triggers PROMOTION of the first live slave, then one
+    retry (`MasterSlaveEntry.changeMaster`, the pool-freeze-driven analogue
+    of sentinel's +switch-master).
+  * reads  -> per ReadMode: SLAVE (balanced round-robin over live slaves,
+    master fallback when none), MASTER, or MASTER_SLAVE (master joins the
+    rotation) — `ReadMode` semantics from the reference's
+    `BaseMasterSlaveServersConfig`.
+  * MOVED slot host:port -> re-route to (possibly new) endpoint, cache
+    slot -> endpoint so later keyed commands go direct; ASK -> one-shot
+    redirect prefixed with ASKING, no cache — exactly the reference's
+    redirect contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from redisson_tpu.native import RespError
+from redisson_tpu.ops import crc16
+
+# Commands safe to serve from a replica (the read-command subset of
+# `client/protocol/RedisCommands.java` the structure tier emits).
+READ_COMMANDS = frozenset({
+    "GET", "MGET", "STRLEN", "EXISTS", "TYPE", "KEYS", "PTTL", "TTL",
+    "DBSIZE", "GETBIT", "BITCOUNT", "BITPOS",
+    "HGET", "HMGET", "HGETALL", "HLEN", "HKEYS", "HVALS", "HEXISTS", "HSCAN",
+    "SMEMBERS", "SCARD", "SISMEMBER", "SRANDMEMBER", "SSCAN", "SINTER",
+    "SUNION", "SDIFF",
+    "LRANGE", "LLEN", "LINDEX", "LPOS",
+    "ZSCORE", "ZMSCORE", "ZCARD", "ZCOUNT", "ZRANGE", "ZRANGEBYSCORE",
+    "ZREVRANGEBYSCORE", "ZRANGEBYLEX", "ZREVRANGEBYLEX", "ZRANK", "ZREVRANK",
+    "ZSCAN", "PFCOUNT", "GEOPOS", "GEODIST", "GEORADIUS",
+    "GEORADIUSBYMEMBER", "SCAN", "PING",
+})
+
+
+# Commands whose first argument is NOT a key: never slot-route these (a
+# cached MOVED entry must not hijack an EVALSHA/SCAN/PUBLISH whose arg
+# happens to hash into the moved slot).
+UNKEYED_COMMANDS = frozenset({
+    "PING", "ECHO", "SELECT", "DBSIZE", "FLUSHALL", "KEYS", "SCRIPT",
+    "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN", "ASKING", "SUBSCRIBE",
+    "UNSUBSCRIBE", "PSUBSCRIBE", "PUNSUBSCRIBE", "INFO", "CONFIG",
+})
+
+
+def _addr_key(addr: str) -> str:
+    """Normalize 'redis://h[:p]' / 'h[:p]' to 'h:p' (default port 6379)."""
+    a = addr
+    if "://" in a:
+        a = a.split("://", 1)[1]
+    host, _, port = a.rpartition(":")
+    if not host or not port.isdigit():
+        a = f"{a}:6379"
+    return a
+
+
+def _parse_redirect(msg: str):
+    """'MOVED 1234 127.0.0.1:7001' -> (1234, '127.0.0.1:7001')."""
+    parts = msg.split()
+    return int(parts[1]), parts[2]
+
+
+class MasterSlaveRouter:
+    """Sync facade (execute/pipeline/execute_blocking/connect/close) that
+    routes across endpoint pools. Drop-in where RespConnectionPool is used.
+
+    pool_factory(host, port) -> RespConnectionPool (constructed by the
+    client with its configured timeouts/sizes).
+    """
+
+    def __init__(self, pool_factory: Callable[[str, int], Any],
+                 master_address: str,
+                 slave_addresses: Sequence[str] = (),
+                 read_mode: str = "SLAVE"):
+        self._factory = pool_factory
+        self._lock = threading.Lock()
+        self._pools: Dict[str, Any] = {}  # "host:port" -> pool
+        self._master = _addr_key(master_address)
+        self._slaves: List[str] = [_addr_key(a) for a in slave_addresses]
+        self.read_mode = read_mode.upper()
+        self._rr = 0
+        self._slot_table: Dict[int, str] = {}  # slot -> "host:port" (MOVED)
+        self.promotions = 0  # observability: master changes
+        self.redirects = 0   # observability: MOVED/ASK followed
+
+    # -- pool bookkeeping ----------------------------------------------------
+
+    def _pool(self, addr: str):
+        with self._lock:
+            p = self._pools.get(addr)
+            if p is None:
+                host, _, port = addr.rpartition(":")
+                p = self._factory(host, int(port))
+                p.connect()
+                self._pools[addr] = p
+            return p
+
+    def connect(self) -> None:
+        self._pool(self._master)
+        for a in self._slaves:
+            try:
+                self._pool(a)
+            except Exception:  # noqa: BLE001 - a dead slave must not block boot
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            pools, self._pools = list(self._pools.values()), {}
+        for p in pools:
+            try:
+                p.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @property
+    def timeout(self) -> float:
+        return self._pool(self._master).timeout
+
+    @property
+    def master_address(self) -> str:
+        return self._master
+
+    # -- routing -------------------------------------------------------------
+
+    @staticmethod
+    def _key_of(args) -> Optional[str]:
+        if len(args) < 2 or str(args[0]).upper() in UNKEYED_COMMANDS:
+            return None
+        k = args[1]
+        return k.decode("utf-8", "replace") if isinstance(k, bytes) else str(k)
+
+    def _endpoint_for(self, args, write: bool) -> str:
+        key = self._key_of(args)
+        if key is not None and self._slot_table:
+            owner = self._slot_table.get(crc16.key_slot(key))
+            if owner is not None:
+                return owner
+        if write or self.read_mode == "MASTER":
+            return self._master
+        candidates = list(self._slaves)
+        if self.read_mode == "MASTER_SLAVE":
+            candidates.append(self._master)
+        live = [a for a in candidates if not self._frozen(a)]
+        if not live:
+            return self._master
+        self._rr += 1
+        return live[self._rr % len(live)]
+
+    def _frozen(self, addr: str) -> bool:
+        p = self._pools.get(addr)
+        return p is not None and getattr(p, "frozen", False)
+
+    def _promote(self) -> bool:
+        """Master unreachable: promote the first live slave
+        (`MasterSlaveEntry.changeMaster` / `slaveDown` promotion,
+        `MasterSlaveEntry.java:99-156`). The old master re-enters as a
+        slave — its pool's PING re-probe revives it if it comes back."""
+        with self._lock:
+            live = [a for a in self._slaves if not self._frozen(a)]
+            if not live:
+                return False
+            new_master = live[0]
+            old = self._master
+            self._slaves = [a for a in self._slaves if a != new_master] + [old]
+            self._master = new_master
+            self.promotions += 1
+            return True
+
+    # -- execution with redirect/failover ------------------------------------
+
+    def _run_on(self, addr: str, fn_name: str, *args, **kwargs):
+        pool = self._pool(addr)
+        return getattr(pool, fn_name)(*args, **kwargs)
+
+    def _execute_routed(self, args, write: bool, depth: int = 0):
+        addr = self._endpoint_for(args, write)
+        try:
+            result = self._run_on(addr, "execute", *args)
+        except RespError as e:
+            return self._maybe_redirect(e, args, write, depth)
+        except (ConnectionError, OSError, TimeoutError):
+            if write and addr == self._master and depth < 1 and self._promote():
+                return self._execute_routed(args, write, depth + 1)
+            if not write and depth < 2:
+                # Read fallback: drop the dead endpoint from this attempt by
+                # retrying — the balancer skips frozen pools.
+                return self._execute_routed(args, write, depth + 1)
+            raise
+        if isinstance(result, RespError):
+            return self._maybe_redirect(result, args, write, depth)
+        return result
+
+    def _maybe_redirect(self, err: RespError, args, write: bool, depth: int):
+        msg = str(err)
+        if depth >= 3:
+            raise err
+        if msg.startswith("MOVED"):
+            slot, addr = _parse_redirect(msg)
+            self._slot_table[slot] = addr
+            self.redirects += 1
+            try:
+                result = self._run_on(addr, "execute", *args)
+            except RespError as e2:
+                return self._maybe_redirect(e2, args, write, depth + 1)
+            if isinstance(result, RespError):
+                return self._maybe_redirect(result, args, write, depth + 1)
+            return result
+        if msg.startswith("ASK"):
+            _, addr = _parse_redirect(msg)
+            self.redirects += 1
+            # One-shot: ASKING + command on the importing node, no cache
+            # (`CommandAsyncService.java:593-600`).
+            out = self._run_on(addr, "pipeline", [("ASKING",), tuple(args)])
+            result = out[1]
+            if isinstance(result, RespError):
+                raise result
+            return result
+        raise err
+
+    def execute(self, *args) -> Any:
+        name = str(args[0]).upper()
+        return self._execute_routed(args, write=name not in READ_COMMANDS)
+
+    def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
+        # Batches go to the master (cross-command atomicity expectations);
+        # per-slot splitting is the CommandBatchService refinement.
+        try:
+            return self._run_on(self._master, "pipeline", commands)
+        except (ConnectionError, OSError, TimeoutError):
+            if self._promote():
+                return self._run_on(self._master, "pipeline", commands)
+            raise
+
+    def execute_blocking(self, *args, response_timeout: float) -> Any:
+        return self._run_on(self._master, "execute_blocking", *args,
+                            response_timeout=response_timeout)

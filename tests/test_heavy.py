@@ -1,0 +1,261 @@
+"""Concurrency storms + fault injection (VERDICT r2 weak #5 / next #7).
+
+The reference's heavy tier: `RedissonLockHeavyTest`, `BaseConcurrentTest`
+N-thread × M-iteration closures, `RedissonConcurrentMapTest` (SURVEY §4).
+Same shapes here at CI-reduced N, parametrized over the engine and the
+redis passthrough (fake server) tiers, plus DROPCONN mid-traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+THREADS = 8
+ITERS = 25
+
+
+@pytest.fixture(scope="module", params=["local", "redis"])
+def client(request):
+    if request.param == "redis":
+        with EmbeddedRedis() as er:
+            cfg = Config()
+            cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+            c = RedissonTPU.create(cfg)
+            try:
+                yield c
+            finally:
+                c.shutdown()
+        return
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+def _storm(n_threads, fn):
+    errors = []
+
+    def run(i):
+        try:
+            fn(i)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors[:3]
+
+
+def test_lock_storm_counter_invariant(client):
+    """N threads × M iterations around one lock: the guarded counter must
+    equal N×M (RedissonLockHeavyTest shape)."""
+    lock = client.get_lock("heavy:lock")
+    counter = {"v": 0}
+
+    def worker(i):
+        for _ in range(ITERS):
+            lock.lock()
+            try:
+                v = counter["v"]
+                time.sleep(0)  # encourage interleaving
+                counter["v"] = v + 1
+            finally:
+                lock.unlock()
+
+    _storm(THREADS, worker)
+    assert counter["v"] == THREADS * ITERS
+    assert not lock.is_locked()
+
+
+def test_fair_lock_storm(client):
+    lock = client.get_fair_lock("heavy:fair")
+    held = {"n": 0, "max": 0}
+
+    def worker(i):
+        for _ in range(ITERS // 5):
+            assert lock.try_lock(5.0)
+            try:
+                held["n"] += 1
+                held["max"] = max(held["max"], held["n"])
+                time.sleep(0.001)
+                held["n"] -= 1
+            finally:
+                lock.unlock()
+
+    _storm(THREADS, worker)
+    assert held["max"] == 1  # never two holders
+
+
+def test_semaphore_storm_never_oversubscribed(client):
+    PERMITS = 3
+    sem = client.get_semaphore("heavy:sem")
+    sem.try_set_permits(PERMITS)
+    inside = {"n": 0, "max": 0}
+    guard = threading.Lock()
+
+    def worker(i):
+        for _ in range(ITERS // 5):
+            assert sem.try_acquire(timeout_s=10.0)
+            try:
+                with guard:
+                    inside["n"] += 1
+                    inside["max"] = max(inside["max"], inside["n"])
+                time.sleep(0.001)
+            finally:
+                with guard:
+                    inside["n"] -= 1
+                sem.release()
+
+    _storm(THREADS, worker)
+    assert 1 <= inside["max"] <= PERMITS
+    assert sem.available_permits() == PERMITS
+
+
+def test_map_cache_storm(client):
+    mc = client.get_map_cache("heavy:mc")
+
+    def worker(i):
+        for j in range(ITERS):
+            mc.put(f"k{i}:{j}", j, ttl_s=30.0)
+            assert mc.get(f"k{i}:{j}") == j
+        for j in range(0, ITERS, 2):
+            mc.remove(f"k{i}:{j}")
+
+    _storm(THREADS, worker)
+    assert mc.size() == THREADS * (ITERS // 2)
+
+
+def test_blocking_queue_storm_every_element_exactly_once(client):
+    """N producers × N consumers over one blocking queue: every produced
+    element is consumed exactly once."""
+    q = client.get_blocking_queue("heavy:bq")
+    produced = {f"{i}:{j}" for i in range(THREADS) for j in range(ITERS)}
+    consumed = []
+    consumed_lock = threading.Lock()
+
+    def producer(i):
+        for j in range(ITERS):
+            assert q.offer(f"{i}:{j}")
+
+    def consumer(i):
+        got = []
+        for _ in range(ITERS):
+            v = q.poll(timeout_s=30.0)
+            assert v is not None
+            got.append(v)
+        with consumed_lock:
+            consumed.extend(got)
+
+    with ThreadPoolExecutor(max_workers=THREADS * 2) as pool:
+        futs = [pool.submit(producer, i) for i in range(THREADS)]
+        futs += [pool.submit(consumer, i) for i in range(THREADS)]
+        for f in futs:
+            f.result(timeout=120)
+    assert sorted(consumed) == sorted(produced)
+    assert q.poll(timeout_s=0.05) is None
+
+
+# -- fault injection (redis tier only: DROPCONN mid-traffic) -----------------
+
+
+@pytest.fixture()
+def rclient():
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        rcfg = cfg.redis
+        rcfg.timeout_ms = 2000
+        rcfg.retry_interval_ms = 50
+        c = RedissonTPU.create(cfg)
+        try:
+            yield c, er
+        finally:
+            c.shutdown()
+
+
+def test_dropconn_storm_mid_pipeline(rclient):
+    """Connections dropped while N threads hammer idempotent ops: the
+    watchdog reconnects and every op eventually succeeds (the reference's
+    ConnectionWatchdog + retry machine, ConnectionWatchdog.java:71-114)."""
+    c, er = rclient
+    m = c.get_map("heavy:drop")
+    stop = threading.Event()
+
+    def dropper():
+        # Kill sockets server-side a few times while traffic flows.
+        for _ in range(5):
+            if stop.is_set():
+                return
+            time.sleep(0.15)
+            try:
+                c._resp.execute("DROPCONN")
+            except Exception:  # noqa: BLE001 - the drop IS the exception
+                pass
+
+    d = threading.Thread(target=dropper, daemon=True)
+    d.start()
+
+    def worker(i):
+        for j in range(ITERS):
+            # fast_put is idempotent: blind retry across drops is safe.
+            for attempt in range(8):
+                try:
+                    m.fast_put(f"k{i}:{j}", j)
+                    break
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.05)
+            else:
+                raise AssertionError(f"put never succeeded for k{i}:{j}")
+
+    _storm(4, worker)
+    stop.set()
+    d.join(timeout=5)
+    assert m.size() == 4 * ITERS
+
+
+def test_dropconn_mid_blocking_take_recovers(rclient):
+    """A parked BLPOP whose connection dies must recover (reattach-or-fail,
+    not hang): the offer after the drop is eventually consumed."""
+    c, er = rclient
+    q = c.get_blocking_queue("heavy:bq2")
+    got = []
+
+    def taker():
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                v = q.poll(timeout_s=2.0)
+            except Exception:  # noqa: BLE001 - dropped mid-take
+                continue
+            if v is not None:
+                got.append(v)
+                return
+
+    t = threading.Thread(target=taker, daemon=True)
+    t.start()
+    time.sleep(0.3)  # parked
+    # Drop every data connection server-side.
+    for w in list(er.server._writers):
+        try:
+            w.close()
+        except Exception:  # noqa: BLE001
+            pass
+    time.sleep(0.3)
+    for attempt in range(8):
+        try:
+            q.offer("recovered")
+            break
+        except Exception:  # noqa: BLE001 - the offer itself may hit the drop
+            time.sleep(0.1)
+    t.join(timeout=25)
+    assert got == ["recovered"]

@@ -164,3 +164,30 @@ def test_brpoplpush_redis_mode(rclient):
     q.offer("m1")
     assert q.poll_last_and_offer_first_to("bq:dst", timeout_s=1.0) == "m1"
     assert rclient.get_queue("bq:dst").peek() == "m1"
+
+
+def test_idle_connections_reaped_above_min_idle():
+    """Connections idle past idle_timeout are retired down to min_idle
+    (IdleConnectionWatcher.java:42-60)."""
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.pool import RespConnectionPool
+
+    with EmbeddedRedis() as er:
+        pool = RespConnectionPool(
+            host="127.0.0.1", port=er.port, size=4, min_idle=1,
+            idle_timeout=0.2)
+        pool.connect()
+        try:
+            # Grow the pool via exclusive checkouts returned to rotation.
+            for _ in range(3):
+                pool.execute_blocking("BLPOP", "nope", "0.05",
+                                      response_timeout=5.0)
+            assert pool.live_count >= 2
+            deadline = time.time() + 5
+            while time.time() < deadline and pool.live_count > 1:
+                time.sleep(0.1)
+            assert pool.live_count == 1       # reaped to the min-idle floor
+            assert pool.reaped >= 1
+            assert pool.execute("PING") == b"PONG"  # still serves traffic
+        finally:
+            pool.close()

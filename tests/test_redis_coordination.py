@@ -357,3 +357,147 @@ def test_unsupported_gates_removed(client):
     client.get_pattern_topic("gate:*")
     client.get_map_cache("gate:mc")
     client.get_script()
+
+
+# -- r3 regression pins (ADVICE round-2 findings) ---------------------------
+
+
+def test_rwlock_write_release_downgrades_to_read(client, client2):
+    """Writer that also holds a read lock releases its write hold: mode must
+    flip to 'read' (with a wake-up) so other readers proceed instead of
+    TTL-polling until the read hold lapses (r2 advisor finding #1)."""
+    rw1 = client.get_read_write_lock("rw:downgrade")
+    w = rw1.write_lock()
+    w.lock()
+    r = rw1.read_lock()
+    r.lock()          # writer-reads reentry
+    w.unlock()        # downgrade: only the read hold remains
+    other = client2.get_read_write_lock("rw:downgrade").read_lock()
+    assert other.try_lock(wait_time_s=2.0)   # must NOT block until lease expiry
+    other.unlock()
+    r.unlock()
+
+
+def test_redis_mapcache_auto_eviction(client, server):
+    """TTL'd entries vanish without manual evict_expired: the client's
+    EvictionScheduler sweeps redis-mode caches (r2 advisor finding #3)."""
+    mc = client.get_map_cache("mc:auto")
+    mc.put("gone", 1, ttl_s=0.2)
+    mc.put("stay", 2)
+    deadline = time.time() + 8
+    # Entry must disappear from the SERVER hash (physical removal), not just
+    # be filtered on read.
+    while time.time() < deadline:
+        raw = server.server.data.get(b"mc:auto")
+        if raw is not None and len(raw) == 1:
+            break
+        time.sleep(0.2)
+    raw = server.server.data.get(b"mc:auto")
+    assert raw is not None and len(raw) == 1, dict(raw or {})
+    assert mc.get("stay") == 2
+
+
+def test_parked_lock_waiter_survives_pubsub_dropconn(client, client2, server):
+    """DROPCONN on the subscribe connection while a waiter is parked: the
+    pub/sub client reconnects and replays subscriptions, so unlock still
+    wakes the waiter well before lease expiry (VERDICT r2 weak #8)."""
+    lock1 = client.get_lock("rlock:dropsub")
+    lock1.lock()
+    acquired = threading.Event()
+
+    def waiter():
+        lock2 = client2.get_lock("rlock:dropsub")
+        if lock2.try_lock(wait_time_s=20.0):
+            acquired.set()
+            lock2.unlock()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.4)  # waiter parks on the channel
+    # Kill client2's subscribe connection server-side.
+    ps = client2._redis_pubsub
+    ps.drop_for_test()
+    time.sleep(0.5)  # reconnect + resubscribe replay
+    lock1.unlock()
+    assert acquired.wait(10.0)
+    t.join(5.0)
+
+
+def test_pubsub_idle_drop_then_subscribe_redials(server):
+    """Subscribe connection drops while idle (zero subscriptions): a later
+    subscribe() must re-dial instead of recording the listener forever
+    (r2 advisor finding #2)."""
+    c = make_client(server)
+    try:
+        # Force the pubsub connection up, then drop it while idle.
+        scripts, ps, wd = c._redis_coordination()
+        ps.drop_for_test()
+        time.sleep(0.3)
+        got = threading.Event()
+        topic = c.get_topic("idle:topic")
+        topic.add_listener(lambda ch, msg: got.set())
+        deadline = time.time() + 5
+        while time.time() < deadline and not got.is_set():
+            c.get_topic("idle:topic").publish("ping")
+            time.sleep(0.2)
+        assert got.is_set()
+    finally:
+        c.shutdown()
+
+
+# -- cross-client RPC + cache manager over the (fake) server ----------------
+# (VERDICT r2 missing #4: the reference's entire point is two processes
+# coordinating through the server — RedissonRemoteService.java:96-226.)
+
+
+class _Calc:
+    def add(self, a, b):
+        return a + b
+
+    def boom(self):
+        raise ValueError("remote kaboom")
+
+
+def test_remote_service_cross_client(client, client2):
+    rs_server = client.get_remote_service("xrpc")
+    rs_server.register("Calc", _Calc(), workers=2)
+    try:
+        calc = client2.get_remote_service("xrpc").get("Calc")
+        assert calc.add(2, 40) == 42
+        from redisson_tpu.services.remote import (
+            RemoteInvocationOptions, RemoteServiceError)
+        with pytest.raises(RemoteServiceError, match="kaboom"):
+            calc.boom()
+        # Fire-and-forget: returns immediately, still executes server-side.
+        ff = client2.get_remote_service("xrpc").get(
+            "Calc", RemoteInvocationOptions.defaults().no_result())
+        assert ff.add(1, 1) is None
+    finally:
+        rs_server.shutdown()
+
+
+def test_remote_service_ack_timeout_no_worker(client2):
+    from redisson_tpu.services.remote import (
+        RemoteInvocationOptions, RemoteServiceAckTimeoutError)
+    ghost = client2.get_remote_service("xrpc-ghost").get(
+        "Nobody", RemoteInvocationOptions(ack_timeout_s=0.3,
+                                          execution_timeout_s=2.0))
+    with pytest.raises(RemoteServiceAckTimeoutError):
+        ghost.anything()
+
+
+def test_cache_manager_cross_client(client, client2):
+    cm1 = client.get_cache_manager({"users": {"ttl_s": 30.0}})
+    cm2 = client2.get_cache_manager({"users": {"ttl_s": 30.0}})
+    c1 = cm1.get_cache("users")
+    c1.put("alice", {"age": 30})
+    # Visible from the second client through the server.
+    c2 = cm2.get_cache("users")
+    assert c2.get("alice") == {"age": 30}
+    assert c2.put_if_absent("alice", {"age": 99}) == {"age": 30}
+    c2.evict("alice")
+    assert c1.get("alice") is None
+    # Policy-less cache rides a plain RMap.
+    p = cm1.get_cache("plain")
+    p.put("k", 1)
+    assert cm2.get_cache("plain").get("k") == 1

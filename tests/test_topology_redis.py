@@ -1,0 +1,218 @@
+"""Multi-endpoint redis topology: master/slave routing, failover promotion,
+MOVED/ASK redirects (VERDICT r2 missing #2 / next #4).
+
+Reference shapes: `connection/MasterSlaveEntry.java:53-250` (write/read
+split + changeMaster), `balancer/LoadBalancerManagerImpl.java:39-90`,
+`command/CommandAsyncService.java:593-685` (redirects). The reference never
+CI-tests real topologies (SURVEY §4 weak spot); these run against two
+in-process fake servers with write replication.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.fake_server import EmbeddedRedis
+from redisson_tpu.interop.pool import RespConnectionPool
+from redisson_tpu.interop.topology_redis import MasterSlaveRouter
+from redisson_tpu.ops import crc16
+
+
+def _fast_factory(host: str, port: int) -> RespConnectionPool:
+    return RespConnectionPool(
+        host=host, port=port, timeout=1.0, retry_attempts=1,
+        retry_interval=0.05, size=2, min_idle=1, failed_attempts=2,
+        reconnection_timeout=0.3)
+
+
+@pytest.fixture()
+def pair():
+    master, slave = EmbeddedRedis.pair()
+    try:
+        yield master, slave
+    finally:
+        slave.stop()
+        master.stop()
+
+
+def test_write_to_master_read_from_slave(pair):
+    master, slave = pair
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}",
+        [f"127.0.0.1:{slave.port}"], read_mode="SLAVE")
+    router.connect()
+    try:
+        router.execute("SET", "k", "v")
+        # Write landed on master and replicated to slave.
+        assert master.server.data.get(b"k") == b"v"
+        assert slave.server.data.get(b"k") == b"v"
+        # Read served by the slave: poison the value there to prove routing.
+        slave.server.data[b"k"] = b"from-slave"
+        assert router.execute("GET", "k") == b"from-slave"
+        assert master.server.data.get(b"k") == b"v"  # master untouched
+    finally:
+        router.close()
+
+
+def test_read_mode_master_never_touches_slave(pair):
+    master, slave = pair
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}",
+        [f"127.0.0.1:{slave.port}"], read_mode="MASTER")
+    router.connect()
+    try:
+        router.execute("SET", "k2", "v")
+        slave.server.data[b"k2"] = b"poison"
+        assert router.execute("GET", "k2") == b"v"
+    finally:
+        router.close()
+
+
+def test_kill_master_promotes_slave_reads_survive_writes_resume(pair):
+    """The VERDICT's done-criterion: kill-master shows reads surviving and
+    writes resuming after promotion."""
+    master, slave = pair
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}",
+        [f"127.0.0.1:{slave.port}"], read_mode="SLAVE")
+    router.connect()
+    try:
+        router.execute("SET", "fk", "before")
+        master.kill()  # kill the master server (loop stays up for the slave)
+        # Reads keep working off the slave throughout.
+        assert router.execute("GET", "fk") == b"before"
+        # Writes fail over: promotion happens on the first failed write.
+        deadline = time.time() + 10
+        wrote = False
+        while time.time() < deadline:
+            try:
+                router.execute("SET", "fk", "after")
+                wrote = True
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert wrote
+        assert router.promotions >= 1
+        assert router.master_address.endswith(str(slave.port))
+        assert router.execute("GET", "fk") == b"after"
+    finally:
+        router.close()
+
+
+def test_moved_redirect_follows_and_caches(pair):
+    master, slave = pair
+    # master disowns key "mk"'s slot; the slave owns it.
+    slot = crc16.key_slot("mk")
+    master.server.moved_slots[slot] = f"127.0.0.1:{slave.port}"
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}", [], read_mode="MASTER")
+    router.connect()
+    try:
+        router.execute("SET", "mk", "v1")
+        assert router.redirects == 1
+        assert slave.server.data.get(b"mk") == b"v1"
+        assert b"mk" not in master.server.data
+        # Slot now cached: the next command goes direct, no new redirect.
+        router.execute("SET", "mk", "v2")
+        assert router.redirects == 1
+        assert slave.server.data.get(b"mk") == b"v2"
+        assert router.execute("GET", "mk") == b"v2"
+    finally:
+        router.close()
+
+
+def test_ask_redirect_is_one_shot_with_asking(pair):
+    master, slave = pair
+    key = b"ak"
+    master.server.ask_keys[key] = f"127.0.0.1:{slave.port}"
+    slave.server.importing.add(key)  # target demands the ASKING prefix
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}", [], read_mode="MASTER")
+    router.connect()
+    try:
+        router.execute("SET", "ak", "mig")
+        assert router.redirects == 1
+        assert slave.server.data.get(key) == b"mig"
+        # ASK does not cache: migration ends, key is served by master again.
+        del master.server.ask_keys[key]
+        router.execute("SET", "ak", "home")
+        assert master.server.data.get(key) == b"home"
+        assert router.redirects == 1
+    finally:
+        router.close()
+
+
+def test_client_facade_over_master_slave(pair):
+    """End-to-end: RedissonTPU in redis mode with slave_addresses routes
+    through the router transparently."""
+    master, slave = pair
+    cfg = Config.from_dict({"redis": {
+        "address": f"redis://127.0.0.1:{master.port}",
+        "slave_addresses": [f"redis://127.0.0.1:{slave.port}"],
+        "read_mode": "SLAVE",
+        "timeout_ms": 1000, "failed_attempts": 2,
+    }})
+    c = RedissonTPU.create(cfg)
+    try:
+        m = c.get_map("tm")
+        m.fast_put("a", 1)
+        assert m.get("a") == 1            # read rides the slave (replicated)
+        assert b"tm" in master.server.data
+        assert b"tm" in slave.server.data
+        h = c.get_hyper_log_log("th")
+        h.add_all([f"k{i}" for i in range(100)])
+        assert abs(h.count() - 100) <= 2  # PFCOUNT served from the slave
+    finally:
+        c.shutdown()
+
+
+def test_topic_wakeups_survive_failover(pair):
+    """Pub/sub follows master promotion: the subscribe connection re-dials
+    the router's CURRENT master, so topic messages published after failover
+    still arrive (reference: pub/sub reattach on changeMaster,
+    MasterSlaveEntry.java:158-250)."""
+    import threading
+
+    master, slave = pair
+
+    def make(port_master):
+        cfg = Config.from_dict({"redis": {
+            "address": f"redis://127.0.0.1:{port_master}",
+            "slave_addresses": [f"redis://127.0.0.1:{slave.port}"],
+            "timeout_ms": 1000, "failed_attempts": 1,
+            "retry_attempts": 1, "retry_interval_ms": 50,
+        }})
+        return RedissonTPU.create(cfg)
+
+    c1, c2 = make(master.port), make(master.port)
+    try:
+        got = threading.Event()
+        c2.get_topic("ft").add_listener(lambda ch, msg: got.set())
+        master.kill()
+        # Drive both clients through promotion with a write each.
+        for c in (c1, c2):
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                try:
+                    c.get_bucket(f"poke:{id(c)}").set(1)
+                    break
+                except Exception:
+                    time.sleep(0.1)
+        assert c1._resp.promotions >= 1 and c2._resp.promotions >= 1
+        # Publish after failover: the subscriber must get it via the NEW
+        # master within the reconnect window.
+        deadline = time.time() + 10
+        while time.time() < deadline and not got.is_set():
+            try:
+                c1.get_topic("ft").publish("hello")
+            except Exception:
+                pass
+            time.sleep(0.2)
+        assert got.is_set()
+    finally:
+        c1.shutdown()
+        c2.shutdown()
