@@ -73,6 +73,19 @@ class Completer:
         self._q.put(None)
 
 
+def _format_runs(ops: List[Op]):
+    """Split a coalesced run into consecutive same-format groups (packed vs
+    bytes), preserving op order so positional result slicing stays valid."""
+    runs: List = []
+    for op in ops:
+        fmt = "packed" if "packed" in op.payload else "bytes"
+        if runs and runs[-1][0] == fmt:
+            runs[-1][1].append(op)
+        else:
+            runs.append((fmt, [op]))
+    return runs
+
+
 def _segments(arrays: List[np.ndarray], small: int) -> List[np.ndarray]:
     """Group row arrays for dispatch: runs of small arrays concatenate into
     one bucket-bound buffer (amortizing per-call overhead), large arrays
@@ -344,7 +357,10 @@ class TpuBackend:
                 return
             pos = 0
             for op in ops:
-                n = op.payload["idx"].shape[0] if "idx" in op.payload else op.payload["data"].shape[0]
+                p = op.payload
+                n = (p["idx"].shape[0] if "idx" in p
+                     else p["packed"].shape[0] if "packed" in p
+                     else p["data"].shape[0])
                 if not op.future.done():
                     op.future.set_result(flat[pos : pos + n].astype(bool))
                 pos += n
@@ -486,34 +502,54 @@ class TpuBackend:
             raise RuntimeError(f"bloom filter '{target}' is not initialized")
         return obj, obj.meta["size"], obj.meta["hash_iterations"]
 
-    def _op_bloom_add(self, target: str, ops: List[Op]) -> None:
+    def _bloom_run(self, target: str, ops: List[Op], mutate: bool) -> None:
+        """Shared bloom dispatch: a coalesced run is processed in op order
+        (positional result slicing), packed runs coalesce small arrays via
+        _segments (order-preserving concat) and chunk like the hll path,
+        byte runs coalesce through _coalesce_bytes."""
         obj, m, k = self._bloom_meta(target)
-        data, lengths, _ = self._coalesce_bytes(ops)
-        n = data.shape[0]
         outs, spans = [], []
-        for s, e in engine.chunk_spans(n):
-            pdata, plengths, valid = engine.pad_bytes(data[s:e], lengths[s:e])
-            new, added = engine.bloom_add_bytes(
-                obj.state, pdata, plengths, valid, k, m, self.seed
-            )
-            self.store.swap(target, new)
-            outs.append(added)
-            spans.append(e - s)
+
+        def emit(res, n):
+            if mutate:
+                new, res = res
+                self.store.swap(target, new)
+            outs.append(res)
+            spans.append(n)
+
+        for fmt, group in _format_runs(ops):
+            if fmt == "packed":
+                for packed in _segments(
+                    [op.payload["packed"] for op in group], engine.MIN_BUCKET
+                ):
+                    for s, e in engine.chunk_spans(packed.shape[0]):
+                        rows, count = engine.pad_rows(packed[s:e])
+                        if mutate:
+                            res = engine.bloom_add_packed(
+                                obj.state, rows, np.int32(count), k, m, self.seed)
+                        else:
+                            res = engine.bloom_contains_packed(
+                                obj.state, rows, np.int32(count), k, m, self.seed)
+                        emit(res, e - s)
+            else:
+                data, lengths, _ = self._coalesce_bytes(group)
+                for s, e in engine.chunk_spans(data.shape[0]):
+                    pdata, plengths, valid = engine.pad_bytes(
+                        data[s:e], lengths[s:e])
+                    if mutate:
+                        res = engine.bloom_add_bytes(
+                            obj.state, pdata, plengths, valid, k, m, self.seed)
+                    else:
+                        res = engine.bloom_contains_bytes(
+                            obj.state, pdata, plengths, valid, k, m, self.seed)
+                    emit(res, e - s)
         self.completer.submit(self._slice_results(ops, outs, spans))
 
+    def _op_bloom_add(self, target: str, ops: List[Op]) -> None:
+        self._bloom_run(target, ops, mutate=True)
+
     def _op_bloom_contains(self, target: str, ops: List[Op]) -> None:
-        obj, m, k = self._bloom_meta(target)
-        data, lengths, _ = self._coalesce_bytes(ops)
-        outs, spans = [], []
-        for s, e in engine.chunk_spans(data.shape[0]):
-            pdata, plengths, valid = engine.pad_bytes(data[s:e], lengths[s:e])
-            outs.append(
-                engine.bloom_contains_bytes(
-                    obj.state, pdata, plengths, valid, k, m, self.seed
-                )
-            )
-            spans.append(e - s)
-        self.completer.submit(self._slice_results(ops, outs, spans))
+        self._bloom_run(target, ops, mutate=False)
 
     def _op_bloom_meta(self, target: str, ops: List[Op]) -> None:
         obj, m, k = self._bloom_meta(target)

@@ -226,12 +226,9 @@ def bitset_length(bits):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
-)
-def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
-    """Bloom add of a padded byte-key batch -> (new_bits, added_mask)."""
-    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+def _bloom_add(bits, h1, h2, valid, k: int, m: int):
+    """Shared add core: k-index double hashing -> masked scatter-max ->
+    (new_bits, added_mask). Padded lanes write index 0 with value 0."""
     idx = bloom.indexes(h1, h2, k, m)
     idx = jnp.where(valid[:, None], idx, 0)
     old = bits[idx.reshape(-1)].reshape(idx.shape)
@@ -241,9 +238,45 @@ def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
     return new, added
 
 
-@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
-def bloom_contains_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
-    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+def _bloom_contains(bits, h1, h2, valid, k: int, m: int):
     idx = bloom.indexes(h1, h2, k, m)
     idx = jnp.where(valid[:, None], idx, 0)
     return bloom.contains(bits, idx) & valid
+
+
+def _packed_hashes(packed, count, seed):
+    """(h1, h2, valid) for the raw-LE-uint32-view key layout ([:,0]=lo,
+    [:,1]=hi) — identical hashing to the byte path on 8-byte LE keys."""
+    valid = jnp.arange(packed.shape[0], dtype=jnp.int32) < count
+    h1, h2 = hashing.murmur3_x64_128_u64(U64(packed[:, 1], packed[:, 0]), seed)
+    return h1, h2, valid
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+)
+def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+    """Bloom add of a padded byte-key batch -> (new_bits, added_mask)."""
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    return _bloom_add(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def bloom_contains_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    return _bloom_contains(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+)
+def bloom_add_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+    """Bloom add of uint64 keys in the zero-copy packed layout."""
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    return _bloom_add(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def bloom_contains_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    return _bloom_contains(bits, h1, h2, valid, k, m)

@@ -50,7 +50,32 @@ class RBloomFilter(RObject):
             nkeys=data.shape[0],
         )
 
+    def add_ints(self, values: np.ndarray) -> np.ndarray:
+        """TPU fast path: uint64 keys hashed as their 8-byte LE encodings on
+        device — identical membership to add_all() of the same .tobytes()
+        keys, with zero host-side per-key encoding. BORROW CONTRACT as
+        RHyperLogLog.add_ints_async: don't mutate `values` until resolved."""
+        return self.add_ints_async(values).result()
+
+    def add_ints_async(self, values: np.ndarray):
+        values = np.ascontiguousarray(values, np.uint64)
+        packed = values.view(np.uint32).reshape(-1, 2)
+        return self._executor.execute_async(
+            self.name, "bloom_add", {"packed": packed}, nkeys=values.shape[0]
+        )
+
     # -- membership ---------------------------------------------------------
+
+    def contains_ints(self, values: np.ndarray) -> np.ndarray:
+        return self.contains_ints_async(values).result()
+
+    def contains_ints_async(self, values: np.ndarray):
+        values = np.ascontiguousarray(values, np.uint64)
+        packed = values.view(np.uint32).reshape(-1, 2)
+        return self._executor.execute_async(
+            self.name, "bloom_contains", {"packed": packed},
+            nkeys=values.shape[0]
+        )
 
     def contains(self, value) -> bool:
         return bool(self.contains_all([value])[0])
