@@ -139,6 +139,21 @@ class RedissonTPU:
             )
 
         u = urlparse(rcfg.address)
+        if rcfg.sentinel_addresses:
+            from redisson_tpu.interop.resp_client import SyncPubSubClient
+            from redisson_tpu.interop.topology_redis import SentinelManager
+
+            def pubsub_factory(host: str, port: int) -> SyncPubSubClient:
+                return SyncPubSubClient(
+                    host=host, port=port, password=rcfg.password,
+                    timeout=rcfg.timeout_ms / 1000.0)
+
+            return SentinelManager(
+                factory, rcfg.sentinel_addresses, rcfg.master_name,
+                read_mode=rcfg.read_mode, pubsub_factory=pubsub_factory,
+                timeout=rcfg.timeout_ms / 1000.0,
+                sentinel_password=rcfg.password,
+            )
         if rcfg.slave_addresses:
             from redisson_tpu.interop.topology_redis import MasterSlaveRouter
 

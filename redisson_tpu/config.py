@@ -60,6 +60,11 @@ class RedisConfig:
     # (SLAVE | MASTER | MASTER_SLAVE). Empty = single endpoint.
     slave_addresses: List[str] = dataclasses.field(default_factory=list)
     read_mode: str = "SLAVE"
+    # Sentinel mode (SentinelServersConfig): discover the master/slaves by
+    # name from these sentinels and follow +switch-master events. When set,
+    # `address`/`slave_addresses` are ignored.
+    sentinel_addresses: List[str] = dataclasses.field(default_factory=list)
+    master_name: str = "mymaster"
     timeout_ms: int = 3000  # BaseConfig.timeout
     retry_attempts: int = 3  # BaseConfig.retryAttempts
     retry_interval_ms: int = 1000  # BaseConfig.retryInterval

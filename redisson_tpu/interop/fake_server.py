@@ -69,7 +69,8 @@ def _readonly_for_replication() -> frozenset:
     replication makes master/slave tests lie) plus pure-admin commands."""
     from redisson_tpu.interop.topology_redis import READ_COMMANDS
 
-    return READ_COMMANDS | {"ECHO", "SELECT", "AUTH", "SCRIPT", "PUBLISH"}
+    return READ_COMMANDS | {"ECHO", "SELECT", "AUTH", "SCRIPT", "PUBLISH",
+                            "SENTINEL"}
 
 
 class _ZSet(dict):
@@ -115,6 +116,12 @@ class FakeRedisServer:
         # on a connection that sent ASKING first.
         self.ask_keys: Dict[bytes, str] = {}
         self.importing: set = set()
+        # Sentinel fixture: this server answers SENTINEL queries for these
+        # monitored masters (name -> "host:port") and their slaves
+        # (name -> ["host:port", ...]); failover tests publish
+        # +switch-master on it like a real sentinel daemon.
+        self.sentinel_masters: Dict[str, str] = {}
+        self.sentinel_slaves: Dict[str, List[str]] = {}
 
     async def start(self) -> None:
         self._stopping = False
@@ -209,7 +216,7 @@ class FakeRedisServer:
     # Commands whose first arg is NOT a key (redirect check skips them).
     _UNKEYED = frozenset({
         "PING", "ECHO", "SELECT", "DBSIZE", "FLUSHALL", "KEYS", "SCRIPT",
-        "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN",
+        "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN", "SENTINEL",
     })
 
     def _redirect_for(self, name: str, a: List[bytes], asking: bool):
@@ -266,6 +273,30 @@ class FakeRedisServer:
             parser.close()
         popped_key = bytes(vals[0][0])
         self._replicate("LPOP" if name == "BLPOP" else "RPOP", [popped_key])
+
+    def _cmd_sentinel(self, a):
+        """SENTINEL GET-MASTER-ADDR-BY-NAME / SLAVES — the bootstrap
+        queries of `SentinelConnectionManager.java:74-105`."""
+        sub = bytes(a[0]).upper().decode()
+        name = bytes(a[1]).decode() if len(a) > 1 else ""
+        if sub == "GET-MASTER-ADDR-BY-NAME":
+            addr = self.sentinel_masters.get(name)
+            if addr is None:
+                return b"*-1\r\n"
+            host, _, port = addr.rpartition(":")
+            return _array([_bulk(host.encode()), _bulk(port.encode())])
+        if sub in ("SLAVES", "REPLICAS"):
+            rows = []
+            for s in self.sentinel_slaves.get(name, []):
+                host, _, port = s.rpartition(":")
+                rows.append(_array([
+                    _bulk(b"name"), _bulk(s.encode()),
+                    _bulk(b"ip"), _bulk(host.encode()),
+                    _bulk(b"port"), _bulk(port.encode()),
+                    _bulk(b"flags"), _bulk(b"slave"),
+                ]))
+            return _array(rows)
+        return _err(f"unknown SENTINEL subcommand {sub}")
 
     # -- command handlers ---------------------------------------------------
 

@@ -167,6 +167,35 @@ class MasterSlaveRouter:
         p = self._pools.get(addr)
         return p is not None and getattr(p, "frozen", False)
 
+    def set_master(self, addr: str) -> None:
+        """Externally-driven master change (sentinel +switch-master /
+        Elasticache role flip): the reference's `changeMaster`
+        (`MasterSlaveConnectionManager.java:585-587`). The old master joins
+        the slave rotation."""
+        addr = _addr_key(addr)
+        with self._lock:
+            if addr == self._master:
+                return
+            old = self._master
+            self._slaves = [a for a in self._slaves if a != addr] + [old]
+            self._master = addr
+            self.promotions += 1
+
+    def add_slave(self, addr: str) -> None:
+        """Sentinel +slave / -sdown: a replica (re)joins the read rotation
+        (`LoadBalancerManagerImpl.java:39-90` unfreeze/add)."""
+        addr = _addr_key(addr)
+        with self._lock:
+            if addr != self._master and addr not in self._slaves:
+                self._slaves.append(addr)
+
+    def remove_slave(self, addr: str) -> None:
+        """Sentinel +sdown on a slave: drop it from the read rotation
+        (`MasterSlaveEntry.slaveDown`, `MasterSlaveEntry.java:117-156`)."""
+        addr = _addr_key(addr)
+        with self._lock:
+            self._slaves = [a for a in self._slaves if a != addr]
+
     def _promote(self) -> bool:
         """Master unreachable: promote the first live slave
         (`MasterSlaveEntry.changeMaster` / `slaveDown` promotion,
@@ -251,3 +280,143 @@ class MasterSlaveRouter:
     def execute_blocking(self, *args, response_timeout: float) -> Any:
         return self._run_on(self._master, "execute_blocking", *args,
                             response_timeout=response_timeout)
+
+
+class SentinelManager:
+    """Sentinel-driven topology (`connection/SentinelConnectionManager.java:
+    50-192`): bootstrap master/slaves from any answering sentinel
+    (`SENTINEL GET-MASTER-ADDR-BY-NAME` + `SENTINEL SLAVES`), then keep a
+    subscribe connection to EVERY sentinel: `+switch-master` re-points the
+    master, `+slave`/`-sdown` (re)admit a replica to the read rotation,
+    `+sdown` drops it.
+
+    Wraps (and owns) a MasterSlaveRouter; exposes the same execute facade
+    by delegation, so it drops into the client's `_resp` seam.
+    """
+
+    def __init__(self, pool_factory, sentinel_addresses: Sequence[str],
+                 master_name: str, read_mode: str = "SLAVE",
+                 pubsub_factory=None, timeout: float = 3.0,
+                 sentinel_password: Optional[str] = None):
+        from redisson_tpu.interop.resp_client import SyncRespClient
+
+        self.master_name = master_name
+        self._sentinels = [_addr_key(a) for a in sentinel_addresses]
+        self._pubsub_factory = pubsub_factory
+        self._watchers: List[Any] = []
+        master = None
+        slaves: List[str] = []
+        errors: List[Exception] = []
+        for addr in self._sentinels:
+            host, _, port = addr.rpartition(":")
+            probe = SyncRespClient(host=host, port=int(port), timeout=timeout,
+                                   password=sentinel_password)
+            # Per-attempt isolation: a sentinel that answers half the
+            # bootstrap must not leak partial topology into the next try.
+            attempt_master = None
+            attempt_slaves: List[str] = []
+            try:
+                probe.connect()
+                reply = probe.execute(
+                    "SENTINEL", "GET-MASTER-ADDR-BY-NAME", master_name)
+                if reply is None:
+                    continue
+                attempt_master = (
+                    f"{bytes(reply[0]).decode()}:{bytes(reply[1]).decode()}")
+                for info in probe.execute("SENTINEL", "SLAVES", master_name) or []:
+                    # flat field-value pairs per slave, like real sentinel
+                    d = {bytes(info[i]): bytes(info[i + 1])
+                         for i in range(0, len(info), 2)}
+                    attempt_slaves.append(
+                        f"{d[b'ip'].decode()}:{d[b'port'].decode()}")
+                master, slaves = attempt_master, attempt_slaves
+                break
+            except Exception as e:  # noqa: BLE001 - try the next sentinel
+                errors.append(e)
+            finally:
+                probe.close()
+        if master is None:
+            raise ConnectionError(
+                f"no sentinel answered for master '{master_name}' "
+                f"({errors[:1]!r})")
+        self.router = MasterSlaveRouter(
+            pool_factory, master, slaves, read_mode=read_mode)
+
+    def connect(self) -> None:
+        self.router.connect()
+        self._watch_sentinels()
+
+    def _watch_sentinels(self) -> None:
+        """Subscribe to every sentinel's event channels
+        (`SentinelConnectionManager.java:143-192`)."""
+        if self._pubsub_factory is None:
+            return
+        for addr in self._sentinels:
+            host, _, port = addr.rpartition(":")
+            try:
+                ps = self._pubsub_factory(host, int(port))
+                ps.connect()
+                ps.subscribe("+switch-master", self._on_switch_master)
+                ps.subscribe("+slave", self._on_slave_event)
+                ps.subscribe("-sdown", self._on_slave_event)
+                ps.subscribe("+sdown", self._on_sdown)
+                self._watchers.append(ps)
+            except Exception:  # noqa: BLE001 - a dead sentinel is tolerated
+                pass
+
+    def _on_switch_master(self, channel: str, payload: bytes) -> None:
+        # "+switch-master <name> <oldip> <oldport> <newip> <newport>"
+        parts = payload.decode("utf-8", "replace").split()
+        if len(parts) >= 5 and parts[0] == self.master_name:
+            self.router.set_master(f"{parts[3]}:{parts[4]}")
+
+    def _slave_of_mine(self, payload: bytes) -> Optional[str]:
+        # "slave <name> <ip> <port> @ <master-name> <master-ip> <...>"
+        parts = payload.decode("utf-8", "replace").split()
+        if (len(parts) >= 6 and parts[0] == "slave" and parts[4] == "@"
+                and parts[5] == self.master_name):
+            return f"{parts[2]}:{parts[3]}"
+        return None
+
+    def _on_slave_event(self, channel: str, payload: bytes) -> None:
+        addr = self._slave_of_mine(payload)
+        if addr is not None:
+            self.router.add_slave(addr)
+
+    def _on_sdown(self, channel: str, payload: bytes) -> None:
+        addr = self._slave_of_mine(payload)
+        if addr is not None:
+            self.router.remove_slave(addr)
+
+    # -- facade delegation ---------------------------------------------------
+
+    @property
+    def master_address(self) -> str:
+        return self.router.master_address
+
+    @property
+    def promotions(self) -> int:
+        return self.router.promotions
+
+    @property
+    def timeout(self) -> float:
+        return self.router.timeout
+
+    def execute(self, *args):
+        return self.router.execute(*args)
+
+    def pipeline(self, commands):
+        return self.router.pipeline(commands)
+
+    def execute_blocking(self, *args, response_timeout: float):
+        return self.router.execute_blocking(
+            *args, response_timeout=response_timeout)
+
+    def close(self) -> None:
+        for ps in self._watchers:
+            try:
+                ps.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._watchers.clear()
+        self.router.close()

@@ -15,7 +15,7 @@ from typing import Iterable
 
 import numpy as np
 
-from redisson_tpu.models.object import RObject
+from redisson_tpu.models.object import RObject, pack_u64
 
 
 class RBloomFilter(RObject):
@@ -53,15 +53,14 @@ class RBloomFilter(RObject):
     def add_ints(self, values: np.ndarray) -> np.ndarray:
         """TPU fast path: uint64 keys hashed as their 8-byte LE encodings on
         device — identical membership to add_all() of the same .tobytes()
-        keys, with zero host-side per-key encoding. BORROW CONTRACT as
-        RHyperLogLog.add_ints_async: don't mutate `values` until resolved."""
+        keys, with zero host-side per-key encoding (pack_u64 borrow
+        contract applies)."""
         return self.add_ints_async(values).result()
 
     def add_ints_async(self, values: np.ndarray):
-        values = np.ascontiguousarray(values, np.uint64)
-        packed = values.view(np.uint32).reshape(-1, 2)
+        packed = pack_u64(values)
         return self._executor.execute_async(
-            self.name, "bloom_add", {"packed": packed}, nkeys=values.shape[0]
+            self.name, "bloom_add", {"packed": packed}, nkeys=packed.shape[0]
         )
 
     # -- membership ---------------------------------------------------------
@@ -70,11 +69,10 @@ class RBloomFilter(RObject):
         return self.contains_ints_async(values).result()
 
     def contains_ints_async(self, values: np.ndarray):
-        values = np.ascontiguousarray(values, np.uint64)
-        packed = values.view(np.uint32).reshape(-1, 2)
+        packed = pack_u64(values)
         return self._executor.execute_async(
             self.name, "bloom_contains", {"packed": packed},
-            nkeys=values.shape[0]
+            nkeys=packed.shape[0]
         )
 
     def contains(self, value) -> bool:

@@ -13,7 +13,7 @@ from typing import Iterable
 
 import numpy as np
 
-from redisson_tpu.models.object import RObject
+from redisson_tpu.models.object import RObject, pack_u64
 
 
 class RHyperLogLog(RObject):
@@ -43,20 +43,13 @@ class RHyperLogLog(RObject):
         return self.add_ints_async(values).result()
 
     def add_ints_async(self, values: np.ndarray):
-        # Zero-copy ingest: ship the keys' raw little-endian uint32 view
-        # ([:, 0]=lo, [:, 1]=hi); the lane split and the validity mask are
-        # computed on device (engine.hll_add_packed). The host never touches
-        # the payload beyond the (elided when already uint64-contiguous)
-        # dtype normalization — this is the 100M/s surface.
-        #
-        # BORROW CONTRACT: the array is enqueued by reference, not copied —
-        # the caller must not mutate `values` until the returned future
-        # resolves (copy first if reusing the buffer; add_all() is the
-        # always-copies path).
-        values = np.ascontiguousarray(values, np.uint64)
-        packed = values.view(np.uint32).reshape(-1, 2)
+        # Zero-copy ingest (pack_u64 borrow contract applies): lane split
+        # and validity mask happen on device (engine.hll_add_packed) — the
+        # host touches only the 8 B/key payload once, for the DMA. This is
+        # the 100M/s surface.
+        packed = pack_u64(values)
         return self._executor.execute_async(
-            self.name, "hll_add", {"packed": packed}, nkeys=values.shape[0]
+            self.name, "hll_add", {"packed": packed}, nkeys=packed.shape[0]
         )
 
     # -- reads --------------------------------------------------------------

@@ -41,6 +41,21 @@ def map_future(f, fn):
     return out
 
 
+def pack_u64(values) -> "np.ndarray":
+    """uint64 keys -> their raw little-endian uint32 view [n, 2]
+    ([:, 0]=lo, [:, 1]=hi): the zero-copy device-ingest layout shared by
+    the HLL and Bloom int fast paths.
+
+    BORROW CONTRACT: when `values` is already uint64-contiguous no copy is
+    taken — the caller of the enqueueing API must not mutate the source
+    array until the op's future resolves (copy first to reuse the buffer;
+    the byte-key APIs always copy)."""
+    import numpy as np
+
+    values = np.ascontiguousarray(values, np.uint64)
+    return values.view(np.uint32).reshape(-1, 2)
+
+
 class RObject:
     """name + codec + executor; all state lives behind the executor."""
 

@@ -216,3 +216,125 @@ def test_topic_wakeups_survive_failover(pair):
     finally:
         c1.shutdown()
         c2.shutdown()
+
+
+# -- sentinel mode ----------------------------------------------------------
+
+
+@pytest.fixture()
+def sentinel_setup():
+    """master + slave (replicating pair) + one sentinel server that
+    monitors them — three in-process servers, the topology the reference
+    can only test with disabled hardcoded configs (SURVEY §4)."""
+    master, slave = EmbeddedRedis.pair()
+    sentinel = EmbeddedRedis(share_with=master)
+    sentinel.server.sentinel_masters["mymaster"] = f"127.0.0.1:{master.port}"
+    sentinel.server.sentinel_slaves["mymaster"] = [f"127.0.0.1:{slave.port}"]
+    try:
+        yield master, slave, sentinel
+    finally:
+        sentinel.stop()
+        slave.stop()
+        master.stop()
+
+
+def test_sentinel_bootstrap_and_routing(sentinel_setup):
+    """SentinelManager discovers master/slaves by name
+    (SentinelConnectionManager.java:74-105) and routes like the
+    master/slave router."""
+    master, slave, sentinel = sentinel_setup
+    cfg = Config.from_dict({"redis": {
+        "address": "redis://ignored:1",     # sentinel mode overrides this
+        "sentinel_addresses": [f"redis://127.0.0.1:{sentinel.port}"],
+        "master_name": "mymaster",
+        "timeout_ms": 1000, "failed_attempts": 2,
+    }})
+    c = RedissonTPU.create(cfg)
+    try:
+        assert c._resp.master_address.endswith(str(master.port))
+        b = c.get_bucket("sb")
+        b.set("v")
+        assert b.get() == "v"
+        assert b"sb" in master.server.data       # write hit the real master
+        assert b"sb" in slave.server.data        # replicated
+    finally:
+        c.shutdown()
+
+
+def test_sentinel_switch_master_event(sentinel_setup):
+    """+switch-master published by the sentinel re-points writes at the new
+    master without any failed command (SentinelConnectionManager.java:
+    143-192 event path)."""
+    master, slave, sentinel = sentinel_setup
+    cfg = Config.from_dict({"redis": {
+        "sentinel_addresses": [f"redis://127.0.0.1:{sentinel.port}"],
+        "master_name": "mymaster",
+        "timeout_ms": 1000, "failed_attempts": 2,
+    }})
+    c = RedissonTPU.create(cfg)
+    try:
+        c.get_bucket("sw").set(1)
+        # Sentinel announces the switch (as after a real failover vote).
+        from redisson_tpu.interop.resp_client import SyncRespClient
+
+        pub = SyncRespClient(port=sentinel.port)
+        pub.connect()
+        try:
+            pub.execute(
+                "PUBLISH", "+switch-master",
+                f"mymaster 127.0.0.1 {master.port} 127.0.0.1 {slave.port}")
+        finally:
+            pub.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and not c._resp.master_address.endswith(
+                str(slave.port)):
+            time.sleep(0.05)
+        assert c._resp.master_address.endswith(str(slave.port))
+        # Real failover re-points replication (REPLICAOF): the promoted
+        # node now feeds the demoted one, so slave-routed reads see writes.
+        slave.server.replicas.append(master.server)
+        master.server.replicas.clear()
+        # Writes now land on the promoted node.
+        c.get_bucket("after").set(2)
+        assert b"after" in slave.server.data
+        assert c.get_bucket("after").get() == 2
+    finally:
+        c.shutdown()
+
+
+def test_sentinel_slave_events_update_rotation(sentinel_setup):
+    """+sdown drops a replica from the read rotation; -sdown / +slave
+    re-admit it (SentinelConnectionManager slave up/down handling)."""
+    master, slave, sentinel = sentinel_setup
+    cfg = Config.from_dict({"redis": {
+        "sentinel_addresses": [f"redis://127.0.0.1:{sentinel.port}"],
+        "master_name": "mymaster",
+        "timeout_ms": 1000, "failed_attempts": 2,
+    }})
+    c = RedissonTPU.create(cfg)
+    try:
+        from redisson_tpu.interop.resp_client import SyncRespClient
+
+        router = c._resp.router
+        assert any(a.endswith(str(slave.port)) for a in router._slaves)
+        pub = SyncRespClient(port=sentinel.port)
+        pub.connect()
+        try:
+            pub.execute("PUBLISH", "+sdown",
+                        f"slave s1 127.0.0.1 {slave.port} @ mymaster "
+                        f"127.0.0.1 {master.port}")
+            deadline = time.time() + 5
+            while time.time() < deadline and router._slaves:
+                time.sleep(0.05)
+            assert not router._slaves
+            pub.execute("PUBLISH", "-sdown",
+                        f"slave s1 127.0.0.1 {slave.port} @ mymaster "
+                        f"127.0.0.1 {master.port}")
+            deadline = time.time() + 5
+            while time.time() < deadline and not router._slaves:
+                time.sleep(0.05)
+            assert any(a.endswith(str(slave.port)) for a in router._slaves)
+        finally:
+            pub.close()
+    finally:
+        c.shutdown()
