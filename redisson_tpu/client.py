@@ -155,14 +155,22 @@ class RedissonTPU:
                 sentinel_password=rcfg.password,
             )
         if rcfg.slave_addresses:
-            from redisson_tpu.interop.topology_redis import MasterSlaveRouter
+            from redisson_tpu.interop.topology_redis import (
+                MasterSlaveRouter, RolePollingMonitor)
 
-            return MasterSlaveRouter(
+            router = MasterSlaveRouter(
                 factory,
                 f"{u.hostname or '127.0.0.1'}:{u.port or 6379}",
                 rcfg.slave_addresses,
                 read_mode=rcfg.read_mode,
             )
+            if rcfg.role_scan_interval_ms > 0:
+                self._role_monitor = RolePollingMonitor(
+                    router,
+                    scan_interval_s=rcfg.role_scan_interval_ms / 1000.0,
+                    timeout=rcfg.timeout_ms / 1000.0,
+                )
+            return router
         pool = factory(u.hostname, u.port)
         return pool
 
@@ -174,6 +182,11 @@ class RedissonTPU:
         try:
             self._resp.connect()
         except Exception:
+            # Reclaim every background resource already started (the role
+            # monitor thread would otherwise poll forever).
+            if getattr(self, "_role_monitor", None) is not None:
+                self._role_monitor.close()
+                self._role_monitor = None
             self._resp.close()  # reclaim the IO-loop thread
             raise
         self._backend = self._routing = RedisBackend(self._resp)
@@ -562,6 +575,9 @@ class RedissonTPU:
             except Exception:
                 pass
             self._durability = None
+        if getattr(self, "_role_monitor", None) is not None:
+            self._role_monitor.close()
+            self._role_monitor = None
         if getattr(self, "_redis_watchdog", None) is not None:
             self._redis_watchdog.shutdown()
             self._redis_watchdog = None

@@ -65,6 +65,10 @@ class RedisConfig:
     # `address`/`slave_addresses` are ignored.
     sentinel_addresses: List[str] = dataclasses.field(default_factory=list)
     master_name: str = "mymaster"
+    # Elasticache-style detection (ElasticacheServersConfig.scanInterval):
+    # poll INFO replication roles every N ms (0 = off); needs
+    # slave_addresses. Catches AWS-side promotions no sentinel announces.
+    role_scan_interval_ms: int = 0
     timeout_ms: int = 3000  # BaseConfig.timeout
     retry_attempts: int = 3  # BaseConfig.retryAttempts
     retry_interval_ms: int = 1000  # BaseConfig.retryInterval

@@ -70,7 +70,7 @@ def _readonly_for_replication() -> frozenset:
     from redisson_tpu.interop.topology_redis import READ_COMMANDS
 
     return READ_COMMANDS | {"ECHO", "SELECT", "AUTH", "SCRIPT", "PUBLISH",
-                            "SENTINEL"}
+                            "SENTINEL", "INFO"}
 
 
 class _ZSet(dict):
@@ -122,6 +122,10 @@ class FakeRedisServer:
         # +switch-master on it like a real sentinel daemon.
         self.sentinel_masters: Dict[str, str] = {}
         self.sentinel_slaves: Dict[str, List[str]] = {}
+        # INFO replication role: None = master; set to the master's
+        # "host:port" when this server is a replica (EmbeddedRedis.pair
+        # sets it; Elasticache-style role polling reads it).
+        self.replicating_from: Optional[str] = None
 
     async def start(self) -> None:
         self._stopping = False
@@ -216,7 +220,7 @@ class FakeRedisServer:
     # Commands whose first arg is NOT a key (redirect check skips them).
     _UNKEYED = frozenset({
         "PING", "ECHO", "SELECT", "DBSIZE", "FLUSHALL", "KEYS", "SCRIPT",
-        "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN", "SENTINEL",
+        "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN", "SENTINEL", "INFO",
     })
 
     def _redirect_for(self, name: str, a: List[bytes], asking: bool):
@@ -273,6 +277,14 @@ class FakeRedisServer:
             parser.close()
         popped_key = bytes(vals[0][0])
         self._replicate("LPOP" if name == "BLPOP" else "RPOP", [popped_key])
+
+    def _cmd_info(self, a):
+        """INFO [section] — enough of the replication section for role
+        polling (`ElasticacheConnectionManager.java` reads role:)."""
+        role = "slave" if self.replicating_from is not None else "master"
+        body = (f"# Replication\r\nrole:{role}\r\n"
+                f"connected_slaves:{len(self.replicas)}\r\n")
+        return _bulk(body.encode())
 
     def _cmd_sentinel(self, a):
         """SENTINEL GET-MASTER-ADDR-BY-NAME / SLAVES — the bootstrap
@@ -1613,6 +1625,7 @@ class EmbeddedRedis:
         master = cls(password=password)
         slave = cls(password=password, share_with=master)
         master.server.replicas.append(slave.server)
+        slave.server.replicating_from = f"127.0.0.1:{master.port}"
         return master, slave
 
     @property

@@ -420,3 +420,52 @@ class SentinelManager:
                 pass
         self._watchers.clear()
         self.router.close()
+
+
+class RolePollingMonitor:
+    """Elasticache-style failure detection
+    (`connection/ElasticacheConnectionManager.java`): no sentinel protocol —
+    poll `INFO replication` on every known endpoint and re-point the router
+    when the AWS-side (or test-side) promotion flips a replica's role to
+    master while the configured master stopped answering as one."""
+
+    def __init__(self, router: MasterSlaveRouter, scan_interval_s: float = 1.0,
+                 timeout: float = 2.0):
+        self.router = router
+        self.scan_interval_s = scan_interval_s
+        self.timeout = timeout
+        self.scans = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="rtpu-role-poll", daemon=True)
+        self._thread.start()
+
+    def _role_of(self, addr: str) -> Optional[str]:
+        """INFO through the router's per-endpoint pool: the pool carries
+        the credentials and freeze/re-probe state, and the probe reuses its
+        live connections instead of dialing fresh sockets every scan."""
+        try:
+            info = self.router._pool(addr).execute("INFO", "replication")
+            for line in bytes(info).decode("utf-8", "replace").splitlines():
+                if line.startswith("role:"):
+                    return line.split(":", 1)[1].strip()
+            return None
+        except Exception:  # noqa: BLE001 - unreachable node has no role
+            return None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.scan_interval_s):
+            self.scans += 1
+            master = self.router.master_address
+            if self._role_of(master) == "master":
+                continue  # configured master still answers as master
+            with self.router._lock:
+                candidates = list(self.router._slaves)
+            for addr in candidates:
+                if self._role_of(addr) == "master":
+                    self.router.set_master(addr)
+                    break
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

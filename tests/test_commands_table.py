@@ -1,0 +1,53 @@
+"""The command table must match the backends exactly, both directions —
+the executable analogue of the reference's static RedisCommands.java table
+(VERDICT rows 8: 'op vocabulary still implicit string kinds')."""
+
+import re
+
+from redisson_tpu.commands import OP_TABLE, kinds_for_tier
+
+
+def _ops_of(path: str) -> set:
+    with open(path) as f:
+        return set(re.findall(r"def _op_(\w+)\(", f.read()))
+
+
+def test_engine_tier_complete():
+    impl = _ops_of("redisson_tpu/structures/engine.py") | _ops_of(
+        "redisson_tpu/structures/extended.py")
+    table = kinds_for_tier("engine")
+    impl |= {"keys"}  # keyspace scan is served by RoutingBackend/fan-out
+    assert impl - table == set(), f"undocumented engine ops: {impl - table}"
+    assert table - impl == set(), f"phantom engine ops: {table - impl}"
+
+
+def test_tpu_tier_complete():
+    impl = _ops_of("redisson_tpu/backend_tpu.py")
+    table = kinds_for_tier("tpu")
+    # delete/exists/flushall/keys route through RoutingBackend for sketches.
+    impl |= {"keys"}
+    assert impl - table == set(), f"undocumented tpu ops: {impl - table}"
+    assert table - impl == set(), f"phantom tpu ops: {table - impl}"
+
+
+def test_redis_tier_complete():
+    impl = _ops_of("redisson_tpu/interop/backend_redis.py")
+    table = kinds_for_tier("redis")
+    assert impl - table == set(), f"undocumented redis ops: {impl - table}"
+    assert table - impl == set(), f"phantom redis ops: {table - impl}"
+
+
+def test_coord_tier_is_lua_objects():
+    """Every coord-tier kind must have an engine implementation (the coord
+    tier replaces the executor path with Lua objects in redis mode)."""
+    engine = kinds_for_tier("engine")
+    for k in kinds_for_tier("coord"):
+        assert k in engine, k
+
+
+def test_descriptor_sanity():
+    assert len(OP_TABLE) >= 150
+    for k, d in OP_TABLE.items():
+        assert d.kind == k
+        assert d.redis_name
+        assert d.tiers

@@ -338,3 +338,31 @@ def test_sentinel_slave_events_update_rotation(sentinel_setup):
             pub.close()
     finally:
         c.shutdown()
+
+
+def test_role_polling_detects_external_promotion(pair):
+    """No sentinel, no failed write: an external role flip (the AWS-side
+    Elasticache promotion) is detected by INFO-replication polling and the
+    router re-points (ElasticacheConnectionManager.java behavior)."""
+    from redisson_tpu.interop.topology_redis import RolePollingMonitor
+
+    master, slave = pair
+    router = MasterSlaveRouter(
+        _fast_factory, f"127.0.0.1:{master.port}",
+        [f"127.0.0.1:{slave.port}"], read_mode="SLAVE")
+    router.connect()
+    mon = RolePollingMonitor(router, scan_interval_s=0.2)
+    try:
+        router.execute("SET", "rp", "v")
+        # External promotion: roles flip without any client-side failure.
+        slave.server.replicating_from = None            # now a master
+        master.server.replicating_from = f"127.0.0.1:{slave.port}"
+        deadline = time.time() + 10
+        while time.time() < deadline and not router.master_address.endswith(
+                str(slave.port)):
+            time.sleep(0.1)
+        assert router.master_address.endswith(str(slave.port))
+        assert mon.scans >= 1
+    finally:
+        mon.close()
+        router.close()
