@@ -176,12 +176,16 @@ class TpuBackend:
         packed_ops = [op for op in ops if "packed" in op.payload]
         int_ops = [op for op in ops if "hi" in op.payload]
         byte_ops = [op for op in ops if "data" in op.payload]
+        device_ops = [op for op in ops if "device_packed" in op.payload]
         for group in (packed_ops, int_ops, byte_ops):
             if group:
                 self._hll_add_group(target, group)
+        if device_ops:
+            self._hll_add_device(target, device_ops)
         leftover = [
             op for op in ops
-            if not ({"packed", "hi", "data"} & op.payload.keys())
+            if not ({"packed", "hi", "data", "device_packed"}
+                    & op.payload.keys())
         ]
         for op in leftover:  # fail loudly, never strand a future
             op.future.set_exception(
@@ -226,6 +230,29 @@ class TpuBackend:
                 pdata, plengths, valid = engine.pad_bytes(data[s:e], lengths[s:e])
                 new, changed = engine.hll_add_bytes(
                     obj.state, pdata, plengths, valid, self.hll_impl, self.seed
+                )
+                self.store.swap(target, new)
+                parts.append(changed)
+        self.completer.submit(
+            _complete_all(ops, lambda: any(bool(c) for c in parts))
+        )
+
+    def _hll_add_device(self, target: str, ops: List[Op]) -> None:
+        """Device-resident ingest: the payload array is already on the
+        chip, so each op is one kernel dispatch at its own (padded) shape —
+        no host copy, no transfer, no concatenation."""
+        obj = self._hll(target)
+        parts = []
+        for op in ops:
+            arr = op.payload["device_packed"]
+            for s, e in engine.chunk_spans(int(arr.shape[0])):
+                packed = arr[s:e]
+                n = e - s
+                b = engine.bucket_size(n)
+                if n != b:
+                    packed = jnp.zeros((b, 2), jnp.uint32).at[:n].set(packed)
+                new, changed = engine.hll_add_packed(
+                    obj.state, packed, np.int32(n), self.hll_impl, self.seed
                 )
                 self.store.swap(target, new)
                 parts.append(changed)

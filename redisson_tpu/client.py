@@ -168,7 +168,6 @@ class RedissonTPU:
                 self._role_monitor = RolePollingMonitor(
                     router,
                     scan_interval_s=rcfg.role_scan_interval_ms / 1000.0,
-                    timeout=rcfg.timeout_ms / 1000.0,
                 )
             return router
         pool = factory(u.hostname, u.port)

@@ -551,10 +551,15 @@ class RedisBackend:
             data, lengths = p["data"], p["lengths"]
             keys = [bytes(data[i, :lengths[i]].tobytes())
                     for i in range(data.shape[0])]
-        elif "packed" in p:  # raw LE uint32 view of uint64 keys
+        elif "packed" in p or "device_packed" in p:
+            # Raw LE uint32 view of uint64 keys; a device-resident array is
+            # materialized to the host first (the wire tier has no device).
             import numpy as np
 
-            vals = np.ascontiguousarray(p["packed"]).view(np.uint64).reshape(-1)
+            raw = p.get("packed")
+            if raw is None:
+                raw = np.asarray(p["device_packed"])
+            vals = np.ascontiguousarray(raw).view(np.uint64).reshape(-1)
             keys = [v.tobytes() for v in vals]
         else:  # pre-hashed ints: feed their LE bytes
             import numpy as np

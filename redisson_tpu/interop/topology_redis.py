@@ -429,11 +429,9 @@ class RolePollingMonitor:
     when the AWS-side (or test-side) promotion flips a replica's role to
     master while the configured master stopped answering as one."""
 
-    def __init__(self, router: MasterSlaveRouter, scan_interval_s: float = 1.0,
-                 timeout: float = 2.0):
+    def __init__(self, router: MasterSlaveRouter, scan_interval_s: float = 1.0):
         self.router = router
         self.scan_interval_s = scan_interval_s
-        self.timeout = timeout
         self.scans = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(

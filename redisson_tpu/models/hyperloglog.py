@@ -52,6 +52,21 @@ class RHyperLogLog(RObject):
             self.name, "hll_add", {"packed": packed}, nkeys=packed.shape[0]
         )
 
+    def add_device(self, packed) -> bool:
+        """Ingest keys already resident on the device: `packed` is a
+        uint32 [n, 2] jax Array in the pack_u64 layout ([:, 0]=lo,
+        [:, 1]=hi). No host staging, no transfer — the path for pipelines
+        that generate keys on-device (the device-side analogue of the
+        reference accepting an iterator; bench reports this rate as
+        `device_ingest`)."""
+        return self.add_device_async(packed).result()
+
+    def add_device_async(self, packed):
+        return self._executor.execute_async(
+            self.name, "hll_add", {"device_packed": packed},
+            nkeys=int(packed.shape[0]),
+        )
+
     # -- reads --------------------------------------------------------------
 
     def count(self) -> int:

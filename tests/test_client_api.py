@@ -266,3 +266,40 @@ def test_batch_covers_structure_objects(client):
     assert client.get_list("bt:l").get(0) == "item"
     assert client.get_scored_sorted_set("bt:z").get_score("m") == 1.5
     assert client.get_hyper_log_log("bt:h").count() == 2
+
+
+def test_add_device_resident(client):
+    """Device-resident ingest (add_device) matches the host packed path."""
+    import jax
+    import numpy as np
+
+    from redisson_tpu.models.object import pack_u64
+
+    h = client.get_hyper_log_log("hll:dev")
+    keys = np.arange(1, 50_001, dtype=np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+    dev_arr = jax.device_put(pack_u64(keys))
+    assert h.add_device(dev_arr) is True
+    est_dev = h.count()
+    h2 = client.get_hyper_log_log("hll:host")
+    h2.add_ints(keys)
+    assert h2.count() == est_dev  # identical registers -> identical estimate
+    # Ragged (non-bucket) device batch pads on device.
+    h3 = client.get_hyper_log_log("hll:devragged")
+    assert h3.add_device(jax.device_put(pack_u64(keys[:1111]))) is True
+    assert abs(h3.count() - 1111) / 1111 < 0.05
+
+
+def test_add_device_larger_than_max_bucket(client, monkeypatch):
+    """Device batches above the chunk cap split like the host path."""
+    import jax
+    import numpy as np
+
+    from redisson_tpu import engine
+    from redisson_tpu.models.object import pack_u64
+
+    monkeypatch.setattr(engine, "MAX_BUCKET", 1 << 12)
+    h = client.get_hyper_log_log("hll:devbig")
+    n = (1 << 12) * 2 + 77
+    keys = np.arange(1, n + 1, dtype=np.uint64) * np.uint64(0x2545F4914F6CDD1D)
+    assert h.add_device(jax.device_put(pack_u64(keys))) is True
+    assert abs(h.count() - n) / n < 0.05

@@ -46,7 +46,7 @@ def test_coord_tier_is_lua_objects():
 
 
 def test_descriptor_sanity():
-    assert len(OP_TABLE) >= 150
+    assert len(OP_TABLE) >= 155
     for k, d in OP_TABLE.items():
         assert d.kind == k
         assert d.redis_name

@@ -82,3 +82,4 @@ def test_merge_many():
     assert np.array_equal(np.asarray(merged), np.max(np.stack(stacks), axis=0))
     est = float(hll.count_jit(merged))
     assert abs(est - 9000) / 9000 < 0.05
+
