@@ -233,6 +233,9 @@ def config4(full: bool):
             if b % 8 == 7:
                 seen_estimates.append(
                     float(sharded.bank_count_all(backend.bank, backend.mesh)))
+            if b and b % 100 == 0:
+                print(f"#   streamed {b * batch_n / 1e6:.0f}M/"
+                      f"{total / 1e6:.0f}M keys", file=sys.stderr)
         backend.bank.block_until_ready()
         dt = time.perf_counter() - t0
         return {"config": 4, "total_keys": nbatches * batch_n,
@@ -297,25 +300,49 @@ def main():
 
     which = sorted(CONFIGS) if args.all else [args.config or 1]
     results = {}
+    failures = {}
     for i in which:
         print(f"# running config {i} ...", file=sys.stderr)
         t0 = time.perf_counter()
-        results[str(i)] = CONFIGS[i](args.full)
+        try:
+            results[str(i)] = CONFIGS[i](args.full)
+        except Exception as exc:  # noqa: BLE001 — a late config crashing
+            # (e.g. a tunnel stall at the 1B mark) must not lose the
+            # finished full-scale results of earlier configs.
+            failures[str(i)] = repr(exc)
+            print(f"# config {i} FAILED: {exc!r}", file=sys.stderr)
+            continue
         results[str(i)]["wall_s"] = time.perf_counter() - t0
         print(json.dumps(results[str(i)]), flush=True)
+        if args.publish:
+            try:
+                _publish(results, failures, args.full)
+            except Exception as exc:  # noqa: BLE001 — keep running configs
+                print(f"# publish failed: {exc!r}", file=sys.stderr)
+    if args.publish and failures:
+        # Record trailing failures (success paths published in-loop).
+        _publish(results, failures, args.full)
+    if failures:
+        sys.exit(1)  # partial results are published, but signal the crash
 
-    if args.publish:
-        path = os.path.join(REPO, "BASELINE.json")
-        with open(path) as f:
-            doc = json.load(f)
-        doc.setdefault("published", {}).update(results)
-        doc["published"]["_meta"] = {
-            "full_scale": args.full,
-            "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
-        }
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=2)
-        print(f"# published -> {path}", file=sys.stderr)
+
+def _publish(results, failures, full: bool):
+    """Incrementally merge finished configs into BASELINE.json —
+    atomically (temp + rename), so a mid-write kill can't truncate it."""
+    path = os.path.join(REPO, "BASELINE.json")
+    with open(path) as f:
+        doc = json.load(f)
+    doc.setdefault("published", {}).update(results)
+    doc["published"]["_meta"] = {
+        "full_scale": full,
+        "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        **({"failed_configs": failures} if failures else {}),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+    print(f"# published -> {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

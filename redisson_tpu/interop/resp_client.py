@@ -330,6 +330,12 @@ class SyncRespClient:
         return (c.retry_attempts + 1) * per_attempt
 
     def _run(self, coro, extra_timeout: float = 30.0):
+        if self._loop.is_closed():
+            # Close the never-awaited coroutine cleanly instead of letting
+            # run_coroutine_threadsafe raise with it dangling (the
+            # "coroutine was never awaited" warning on post-close calls).
+            coro.close()
+            raise ConnectionClosed("client is closed")
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         # The coroutine has its own response timeouts; this outer bound only
         # guards against a wedged/dead IO loop thread, so it must sit above
@@ -375,6 +381,8 @@ class SyncRespClient:
         return self._run(self._client.pipeline(commands), extra_timeout=30.0 + scale)
 
     def close(self) -> None:
+        if self._loop.is_closed():
+            return  # idempotent: a second close() is a no-op
         try:
             self._run(self._client.close())
         finally:
