@@ -421,6 +421,16 @@ class RedissonTPU:
     def get_list_multimap(self, name: str, codec=None) -> RListMultimap:
         return RListMultimap(name, self._executor, self._resolve_codec(codec), self._widths)
 
+    def get_set_multimap_cache(self, name: str, codec=None):
+        from redisson_tpu.models.multimap import RSetMultimapCache
+
+        return RSetMultimapCache(name, self._executor, self._resolve_codec(codec), self._widths)
+
+    def get_list_multimap_cache(self, name: str, codec=None):
+        from redisson_tpu.models.multimap import RListMultimapCache
+
+        return RListMultimapCache(name, self._executor, self._resolve_codec(codec), self._widths)
+
     def get_geo(self, name: str, codec=None) -> RGeo:
         return RGeo(name, self._executor, self._resolve_codec(codec), self._widths)
 

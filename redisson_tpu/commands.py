@@ -164,6 +164,8 @@ OP_TABLE = {d.kind: d for d in [
     _d("mm_contains_value", "SISMEMBER", False, _ALL),
     _d("mm_contains_entry", "SISMEMBER", False, _ALL),
     _d("mm_entries", "SMEMBERS", False, _ALL),
+    _d("mm_expire_key", "LUA", True, _ALL),
+    _d("mm_delete", "LUA", True, _ALL),
     # -- geo (RGeo) ---------------------------------------------------------
     _d("geoadd", "GEOADD", True, _ALL),
     _d("geopos", "GEOPOS", False, _ALL),

@@ -893,10 +893,14 @@ class RedisBackend:
     # layout (RedissonSetMultimap/RedissonListMultimap keep hashed
     # sub-collection keys) --------------------------------------------------
 
-    def _mm_sub(self, key: str, field: bytes) -> str:
-        return f"{key}:mm:{_b(field).hex()}"
+    def _mm_sub(self, key: str, field) -> bytes:
+        # Raw concatenation, exactly the reference's subkey layout
+        # ('{name}:' .. field in its Lua, RedissonMultimapCache.java) so the
+        # TTL purge/delete scripts can rebuild subkey names server-side.
+        return _b(key) + b":mm:" + _b(field)
 
     def _op_mm_put(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         f = op.payload["key"]
         sub = self._mm_sub(key, f)
         self._x("SADD", key, f)
@@ -907,6 +911,7 @@ class RedisBackend:
             op.future.set_result(self._x("SADD", sub, op.payload["value"]) > 0)
 
     def _op_mm_get_all(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         sub = self._mm_sub(key, op.payload["key"])
         if op.payload.get("list"):
             op.future.set_result([bytes(v) for v in self._x("LRANGE", sub, 0, -1)])
@@ -914,6 +919,7 @@ class RedisBackend:
             op.future.set_result([bytes(v) for v in self._x("SMEMBERS", sub)])
 
     def _op_mm_remove(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         f = op.payload["key"]
         sub = self._mm_sub(key, f)
         if op.payload.get("list"):
@@ -923,28 +929,31 @@ class RedisBackend:
             ok = self._x("SREM", sub, op.payload["value"]) > 0
             empty = self._x("SCARD", sub) == 0
         if empty:
-            self._x("DEL", sub)
-            self._x("SREM", key, f)
+            self.client.pipeline([("DEL", sub), ("SREM", key, f),
+                                  ("ZREM", self._mm_ttl_key(key), f)])
         op.future.set_result(ok)
 
     def _op_mm_remove_all(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         f = op.payload["key"]
         sub = self._mm_sub(key, f)
         if op.payload.get("list"):
             old = [bytes(v) for v in self._x("LRANGE", sub, 0, -1)]
         else:
             old = [bytes(v) for v in self._x("SMEMBERS", sub)]
-        self._x("DEL", sub)
-        self._x("SREM", key, f)
+        self.client.pipeline([("DEL", sub), ("SREM", key, f),
+                              ("ZREM", self._mm_ttl_key(key), f)])
         op.future.set_result(old)
 
     def _op_mm_keys(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         op.future.set_result([bytes(f) for f in self._x("SMEMBERS", key)])
 
     def _mm_fields(self, key: str) -> List[bytes]:
         return [bytes(f) for f in self._x("SMEMBERS", key)]
 
     def _op_mm_size(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         fields = self._mm_fields(key)
         if not fields:
             op.future.set_result(0)
@@ -955,12 +964,15 @@ class RedisBackend:
         op.future.set_result(sum(_ck(c) for c in counts))
 
     def _op_mm_key_size(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         op.future.set_result(self._x("SCARD", key))
 
     def _op_mm_contains_key(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         op.future.set_result(self._x("SISMEMBER", key, op.payload["key"]) == 1)
 
     def _op_mm_contains_value(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         v = op.payload["value"]
         fields = self._mm_fields(key)
         if not fields:
@@ -977,6 +989,7 @@ class RedisBackend:
             op.future.set_result(any(_ck(h) == 1 for h in hits))
 
     def _op_mm_contains_entry(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         sub = self._mm_sub(key, op.payload["key"])
         if op.payload.get("list"):
             vals = [bytes(x) for x in self._x("LRANGE", sub, 0, -1)]
@@ -986,6 +999,7 @@ class RedisBackend:
                 self._x("SISMEMBER", sub, op.payload["value"]) == 1)
 
     def _op_mm_entries(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
         fields = self._mm_fields(key)
         if not fields:
             op.future.set_result([])
@@ -1039,3 +1053,61 @@ class RedisBackend:
             m, d, coord = row[0], float(row[1]), row[2]
             hits.append((bytes(m), d, (float(coord[0]), float(coord[1]))))
         op.future.set_result(hits)
+
+    # -- multimap cache: per-key TTL via a timeout zset, the reference's own
+    # layout (RedissonMultimapCache.java EVAL_EXPIRE_KEY) -------------------
+
+    def _mm_ttl_key(self, key: str) -> str:
+        return f"{key}:mmttl"
+
+    MM_PURGE = (
+        "local doomed = redis.call('zrangebyscore', KEYS[2], '-inf', ARGV[1]) "
+        "for i = 1, #doomed do "
+        "  redis.call('srem', KEYS[1], doomed[i]) "
+        "  redis.call('del', KEYS[1] .. ':mm:' .. doomed[i]) "
+        "  redis.call('zrem', KEYS[2], doomed[i]) "
+        "end "
+        "return #doomed")
+
+    # Mirrors the reference's EVAL_EXPIRE_KEY (RedissonMultimapCache.java).
+    MM_EXPIRE_KEY = (
+        "if redis.call('sismember', KEYS[1], ARGV[2]) == 1 then "
+        "  if tonumber(ARGV[1]) > 0 then "
+        "    redis.call('zadd', KEYS[2], ARGV[1], ARGV[2]) "
+        "  else "
+        "    redis.call('zrem', KEYS[2], ARGV[2]) "
+        "  end "
+        "  return 1 "
+        "else return 0 end")
+
+    # Mirrors the reference's multimap deleteAsync (index + ttl zset +
+    # every subkey in one atomic script).
+    MM_DELETE = (
+        "local fields = redis.call('smembers', KEYS[1]) "
+        "local n = 0 "
+        "for i = 1, #fields do "
+        "  n = n + redis.call('del', KEYS[1] .. ':mm:' .. fields[i]) "
+        "end "
+        "redis.call('del', KEYS[2]) "
+        "return n + redis.call('del', KEYS[1])")
+
+    def _mm_purge_expired(self, key: str, op: Op) -> None:
+        """Atomically drop multimap keys whose deadline passed. Only cache
+        variants pay for this (plain multimaps never set TTLs and skip the
+        round trip via the payload flag)."""
+        if not op.payload.get("cache"):
+            return
+        self._eval(self.MM_PURGE, [key, self._mm_ttl_key(key)],
+                   [_fmt_num(self._now_ms())])
+
+    def _op_mm_expire_key(self, key: str, op: Op) -> None:
+        self._mm_purge_expired(key, op)
+        ttl_ms = op.payload.get("ttl_ms")
+        deadline = self._now_ms() + int(ttl_ms) if ttl_ms and ttl_ms > 0 else 0
+        res = self._eval(self.MM_EXPIRE_KEY, [key, self._mm_ttl_key(key)],
+                         [_fmt_num(deadline), op.payload["key"]])
+        op.future.set_result(res == 1)
+
+    def _op_mm_delete(self, key: str, op: Op) -> None:
+        op.future.set_result(
+            self._eval(self.MM_DELETE, [key, self._mm_ttl_key(key)], []) > 0)

@@ -680,6 +680,8 @@ class FakeRedisServer:
             if bytes(m) in v:
                 v.discard(bytes(m))
                 n += 1
+        if not v:  # real Redis deletes a set that empties
+            self.data.pop(bytes(a[0]), None)
         return _int(n)
 
     def _cmd_sismember(self, a):

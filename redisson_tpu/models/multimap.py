@@ -77,6 +77,12 @@ class _RMultimap(RExpirable):
         raw = self._executor.execute_sync(self.name, "mm_entries", self._p())
         return [(self._d(k), self._d(v)) for k, v in raw]
 
+    def delete(self) -> bool:
+        """Delete the multimap including its sub-collections and TTL state
+        (the reference's multimap deleteAsync Lua — a bare DEL of the index
+        would orphan subkeys and the timeout zset in redis mode)."""
+        return self._executor.execute_sync(self.name, "mm_delete", self._p())
+
 
 class RSetMultimap(_RMultimap):
     """Values per key form a set (duplicate entries collapse)."""
@@ -90,4 +96,34 @@ class RSetMultimap(_RMultimap):
 class RListMultimap(_RMultimap):
     """Values per key form a list (duplicates and order preserved)."""
 
+    _IS_LIST = True
+
+
+class _RMultimapCache(_RMultimap):
+    """Multimap with per-key TTL (reference `RedissonSetMultimapCache.java`
+    / `RedissonListMultimapCache.java` over `RedissonMultimapCache.java`'s
+    timeout zset; here: engine mm_expiry / redis `{name}:mmttl` zset).
+
+    The cache flag in every payload tells the redis tier to run its lazy
+    TTL purge — plain multimaps never pay that round trip."""
+
+    def _p(self, **kw) -> dict:
+        kw = super()._p(**kw)
+        kw["cache"] = True
+        return kw
+
+    def expire_key(self, key: Any, ttl_s: float) -> bool:
+        """Per-key TTL; True only when the key currently exists. ttl <= 0
+        clears a previously set TTL (expireKeyAsync contract)."""
+        return self._executor.execute_sync(
+            self.name, "mm_expire_key",
+            self._p(key=self._ek(key), ttl_ms=int(ttl_s * 1000)),
+        )
+
+
+class RSetMultimapCache(_RMultimapCache, RSetMultimap):
+    _IS_LIST = False
+
+
+class RListMultimapCache(_RMultimapCache, RListMultimap):
     _IS_LIST = True

@@ -247,6 +247,12 @@ class RedissonTPUReactive:
     def get_list_multimap(self, name: str, codec=None) -> AsyncProxy:
         return AsyncProxy(self._client.get_list_multimap(name, codec))
 
+    def get_set_multimap_cache(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_set_multimap_cache(name, codec))
+
+    def get_list_multimap_cache(self, name: str, codec=None) -> AsyncProxy:
+        return AsyncProxy(self._client.get_list_multimap_cache(name, codec))
+
     def get_geo(self, name: str, codec=None) -> AsyncProxy:
         return AsyncProxy(self._client.get_geo(name, codec))
 

@@ -73,6 +73,9 @@ class KV:
     # scan, dropped on deletion, never renumbered (see Engine._scan_page).
     scan_seq: Optional[Dict[Any, int]] = None
     scan_next: int = 1
+    # Multimap-cache per-key expiry (key -> deadline ms): the engine-side
+    # analogue of the reference's timeout zset (RedissonMultimapCache.java).
+    mm_expiry: Optional[Dict[Any, int]] = None
 
 
 @dataclass

@@ -254,8 +254,55 @@ class ExtendedOps:
 
         return T.MULTIMAP_LIST if op.payload.get("list") else T.MULTIMAP_SET
 
+    def _mm_reap(self, key: str, kv) -> None:
+        """Drop multimap keys whose per-key TTL passed (the multimap-cache
+        timeout-zset sweep, RedissonMultimapCache.java, done lazily); a
+        multimap whose last key expires disappears, as in redis mode."""
+        if kv is None or kv.mm_expiry is None:
+            return
+        from redisson_tpu.structures.engine import now_ms
+
+        t = now_ms()
+        for k in [k for k, dl in kv.mm_expiry.items() if dl <= t]:
+            kv.value.pop(k, None)
+            del kv.mm_expiry[k]
+        self._drop_if_empty(key, kv)
+
+    def _mm_entry(self, key: str, op: Op):
+        kv = self._entry(key, self._mm_type(op))
+        self._mm_reap(key, kv)
+        return kv
+
+    def _op_mm_delete(self, key: str, op: Op) -> None:
+        """Delete the multimap + its TTL state (reference deleteAsync)."""
+        from redisson_tpu.structures.engine import T
+
+        kv = self._entry(key)
+        op.future.set_result(kv is not None and self._drop(key))
+
+    def _op_mm_expire_key(self, key: str, op: Op) -> None:
+        """expireKey(key, ttl): per-key TTL, True only when the key exists
+        (RedissonMultimapCache.expireKeyAsync contract)."""
+        from redisson_tpu.structures.engine import now_ms
+
+        kv = self._mm_entry(key, op)
+        k = op.payload["key"]
+        if kv is None or k not in kv.value:
+            op.future.set_result(False)
+            return
+        ttl_ms = op.payload.get("ttl_ms")
+        if not ttl_ms or ttl_ms <= 0:
+            if kv.mm_expiry is not None:
+                kv.mm_expiry.pop(k, None)
+        else:
+            if kv.mm_expiry is None:
+                kv.mm_expiry = {}
+            kv.mm_expiry[k] = now_ms() + int(ttl_ms)
+        op.future.set_result(True)
+
     def _op_mm_put(self, key: str, op: Op) -> None:
         kv = self._create(key, self._mm_type(op), dict)
+        self._mm_reap(key, kv)
         k = op.payload["key"]
         if op.payload.get("list"):
             bucket = kv.value.setdefault(k, deque())
@@ -268,7 +315,7 @@ class ExtendedOps:
             op.future.set_result(len(bucket) != before)
 
     def _op_mm_get_all(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         if kv is None:
             op.future.set_result([])
             return
@@ -276,7 +323,7 @@ class ExtendedOps:
         op.future.set_result([] if bucket is None else list(bucket))
 
     def _op_mm_remove(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         if kv is None:
             op.future.set_result(False)
             return
@@ -291,46 +338,50 @@ class ExtendedOps:
             ok = False
         if not bucket:
             del kv.value[op.payload["key"]]
+            if kv.mm_expiry is not None:
+                kv.mm_expiry.pop(op.payload["key"], None)
         self._drop_if_empty(key, kv)
         op.future.set_result(ok)
 
     def _op_mm_remove_all(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         if kv is None:
             op.future.set_result([])
             return
         bucket = kv.value.pop(op.payload["key"], None)
+        if kv.mm_expiry is not None:
+            kv.mm_expiry.pop(op.payload["key"], None)
         self._drop_if_empty(key, kv)
         op.future.set_result([] if bucket is None else list(bucket))
 
     def _op_mm_keys(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         op.future.set_result([] if kv is None else list(kv.value.keys()))
 
     def _op_mm_size(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         op.future.set_result(0 if kv is None else sum(len(b) for b in kv.value.values()))
 
     def _op_mm_key_size(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         op.future.set_result(0 if kv is None else len(kv.value))
 
     def _op_mm_contains_key(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         op.future.set_result(kv is not None and op.payload["key"] in kv.value)
 
     def _op_mm_contains_value(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         v = op.payload["value"]
         op.future.set_result(kv is not None and any(v in b for b in kv.value.values()))
 
     def _op_mm_contains_entry(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         bucket = None if kv is None else kv.value.get(op.payload["key"])
         op.future.set_result(bucket is not None and op.payload["value"] in bucket)
 
     def _op_mm_entries(self, key: str, op: Op) -> None:
-        kv = self._entry(key, self._mm_type(op))
+        kv = self._mm_entry(key, op)
         if kv is None:
             op.future.set_result([])
             return

@@ -515,3 +515,73 @@ def test_set_cache_ttl(client):
     assert set(sc.read_all()) == {"keep"}
     assert sc.remove("keep")
     assert not sc.remove("keep")
+
+
+# ---- multimap cache (per-key TTL, RedissonMultimapCache contract) ----------
+
+
+def test_set_multimap_cache_expire_key(client):
+    mm = client.get_set_multimap_cache("mmc")
+    mm.put("k1", "a")
+    mm.put("k1", "b")
+    mm.put("k2", "z")
+    assert not mm.expire_key("missing", 1.0)   # only existing keys
+    assert mm.expire_key("k1", 0.15)
+    assert mm.get_all("k1") == {"a", "b"}      # still live
+    time.sleep(0.25)
+    assert mm.get_all("k1") == set()           # key expired wholesale
+    assert not mm.contains_key("k1")
+    assert mm.get_all("k2") == {"z"}           # untouched
+    assert mm.size() == 1
+    # TTL cleared before deadline keeps the key alive.
+    mm.put("k3", "v")
+    assert mm.expire_key("k3", 0.15)
+    assert mm.expire_key("k3", 0)              # clear
+    time.sleep(0.25)
+    assert mm.get_all("k3") == {"v"}
+
+
+def test_list_multimap_cache_expire_key(client):
+    mm = client.get_list_multimap_cache("lmmc")
+    mm.put("k", "a")
+    mm.put("k", "a")
+    assert mm.expire_key("k", 0.15)
+    time.sleep(0.25)
+    assert mm.get_all("k") == []
+    assert mm.key_size() == 0
+
+
+def test_multimap_cache_stale_ttl_does_not_kill_reinserted_key(client):
+    """remove/remove_all/delete must clear the key's TTL state: a stale
+    deadline must never delete a freshly re-inserted key (r3 review pins)."""
+    mm = client.get_set_multimap_cache("mmc2")
+    # remove_all clears the deadline
+    mm.put("k", "a")
+    mm.put("other", "x")          # keeps the structure alive
+    assert mm.expire_key("k", 0.15)
+    mm.remove_all("k")
+    mm.put("k", "fresh")
+    time.sleep(0.25)
+    assert mm.get_all("k") == {"fresh"}
+    # remove() of the last value clears the deadline too
+    assert mm.expire_key("k", 0.15)
+    assert mm.remove("k", "fresh")
+    mm.put("k", "fresh2")
+    time.sleep(0.25)
+    assert mm.get_all("k") == {"fresh2"}
+    # delete() clears everything including TTL state
+    assert mm.expire_key("k", 0.15)
+    assert mm.delete()
+    mm2 = client.get_set_multimap_cache("mmc2")
+    mm2.put("k", "reborn")
+    time.sleep(0.25)
+    assert mm2.get_all("k") == {"reborn"}
+
+
+def test_multimap_cache_all_keys_expired_drops_structure(client):
+    mm = client.get_set_multimap_cache("mmc3")
+    mm.put("k", "v")
+    assert mm.expire_key("k", 0.1)
+    time.sleep(0.2)
+    assert mm.key_size() == 0
+    assert "mmc3" not in client.get_keys().get_keys("mmc3")
