@@ -74,6 +74,39 @@ def bench_kernel(jax, dev, n, reps):
     return rates
 
 
+INGEST_CHOICE = {}
+
+
+def _report_ingest_choice(n):
+    """Print (and record for the JSON line) which ingest path the backend's
+    auto policy picks for this bench's batch size — same gates as
+    TpuBackend._use_hostfold (native lib, min-keys, link probe), so the
+    recorded path is the one the measured batches actually took."""
+    try:
+        import jax
+
+        from redisson_tpu import backend_tpu, native
+
+        prof = backend_tpu.link_profile(jax.devices()[0])
+        INGEST_CHOICE.update(
+            path="hostfold" if (
+                native.available()
+                and n >= backend_tpu.HOSTFOLD_MIN_KEYS
+                and prof.prefer_hostfold)
+            else "device",
+            transfer_mb_per_s=round(1e3 / prof.transfer_ns_per_byte, 1),
+            fold_mkeys_per_s=round(1e3 / prof.fold_ns_per_key, 1),
+        )
+        print(
+            f"# ingest[auto] -> {INGEST_CHOICE['path']}: link "
+            f"{INGEST_CHOICE['transfer_mb_per_s']} MB/s, native fold "
+            f"{INGEST_CHOICE['fold_mkeys_per_s']} M keys/s",
+            file=sys.stderr,
+        )
+    except Exception as exc:  # noqa: BLE001
+        print(f"# ingest probe failed: {exc!r}", file=sys.stderr)
+
+
 def bench_end_to_end(n, reps):
     """Client-path rate: add_ints() through the coalescing executor.
 
@@ -81,9 +114,11 @@ def bench_end_to_end(n, reps):
     against a 59 G/s kernel because the dispatcher synced the device per
     chunk (`bool(changed)`) and the client copied hi/lo splits per batch.
     Round 3 ships the keys' raw uint32 view (zero host copies), masks
-    validity on device, and resolves futures on a completer thread — the
-    dispatcher free-runs, so the rate is bounded by host→device transfer
-    bandwidth (8 B/key), not by sync round-trips.
+    validity on device, resolves futures on a completer thread with D2H
+    copies started at dispatch, and — when the link probe says transfers
+    are the bottleneck (tunneled devices run ~10 MB/s) — folds each run
+    into 16 KB of registers natively and ships the sketch instead of the
+    keys (backend_tpu hostfold; same registers, golden-tested).
     """
     from redisson_tpu.client import RedissonTPU
 
@@ -91,6 +126,7 @@ def bench_end_to_end(n, reps):
     try:
         h = client.get_hyper_log_log("bench:e2e")
         rng = np.random.default_rng(7)
+        _report_ingest_choice(n)
         batches = [
             rng.integers(0, 2**63, size=n, dtype=np.uint64) for _ in range(reps)
         ]
@@ -261,6 +297,8 @@ def main():
         e2e, err = bench_end_to_end(n, reps)
         result["value"] = round(e2e, 1)
         result["cardinality_rel_err"] = round(err, 5)
+        if INGEST_CHOICE:
+            result["ingest"] = dict(INGEST_CHOICE)
     except Exception as exc:  # noqa: BLE001
         print(f"# end-to-end bench failed: {exc!r}", file=sys.stderr)
         # Fall back to the kernel rate so a transient client failure still

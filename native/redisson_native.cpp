@@ -19,6 +19,7 @@
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <thread>
 #include <vector>
 #include <string>
 
@@ -435,6 +436,89 @@ RTPU_EXPORT void rtpu_hll_fold_batch(const uint8_t* data,
     int rank = 1;
     while (rank <= 50 && !(rest & 1)) { rest >>= 1; rank++; }
     if ((uint8_t)rank > regs[bucket]) regs[bucket] = (uint8_t)rank;
+  }
+}
+
+// Specialized murmur3_x64_128 h1 for one u64 key hashed as its 8-byte LE
+// encoding: the whole key is the tail (no body blocks), so the canonical
+// algorithm collapses to one k1 mix + finalization. Must stay bit-identical
+// to ops/hashing.py::murmur3_x64_128_u64 (golden-tested both ways).
+static inline uint64_t mm3_h1_u64(uint64_t key, uint64_t seed) {
+  const uint64_t c1 = 0x87c37b91114253d5ULL;
+  const uint64_t c2 = 0x4cf5ad432745937fULL;
+  uint64_t k1 = key;
+  k1 *= c1; k1 = rotl64(k1, 31); k1 *= c2;
+  uint64_t h1 = seed ^ k1;
+  uint64_t h2 = seed;
+  h1 ^= 8; h2 ^= 8;
+  h1 += h2;
+  h2 += h1;
+  h1 = fmix64(h1);
+  h2 = fmix64(h2);
+  return h1 + h2;
+}
+
+static void hll_fold_u64_range(const uint64_t* keys, int64_t n, uint64_t seed,
+                               uint8_t* regs) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1 = mm3_h1_u64(keys[i], seed);
+    uint32_t bucket = (uint32_t)(h1 & 16383u);
+    // rank = ctz((h1 >> 14) | 2^50) + 1, range [1, 51] (ops/hll.py
+    // bucket_rank; Redis hllPatLen).
+    uint64_t rest = (h1 >> 14) | (1ULL << 50);
+    uint8_t rank = (uint8_t)(__builtin_ctzll(rest) + 1);
+    if (rank > regs[bucket]) regs[bucket] = rank;
+  }
+}
+
+// Host-side HLL fold over u64 keys: the transfer-adaptive ingest path.
+// When the host->device link is slow (e.g. a tunneled device), shipping
+// 8 B/key loses to folding locally and shipping the 16 KB sketch — the
+// same move-the-reduction-across-the-slow-link trick as PFMERGE across
+// ICI. Threads fold disjoint slices into private register arrays, merged
+// by elementwise max (HLL folds are commutative).
+RTPU_EXPORT void rtpu_hll_fold_u64(const uint64_t* keys, int64_t n,
+                                   uint64_t seed, uint8_t* regs /*16384*/,
+                                   int32_t nthreads) {
+  const int64_t kMinPerThread = 1 << 16;
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads > (int32_t)(n / kMinPerThread))
+    nthreads = (int32_t)(n / kMinPerThread);
+  if (nthreads <= 1) {
+    hll_fold_u64_range(keys, n, seed, regs);
+    return;
+  }
+  std::vector<std::vector<uint8_t>> scratch(
+      (size_t)(nthreads - 1), std::vector<uint8_t>(16384, 0));
+  std::vector<std::thread> threads;
+  int64_t per = n / nthreads;
+  for (int32_t t = 1; t < nthreads; t++) {
+    int64_t s = per * t;
+    int64_t e = (t == nthreads - 1) ? n : per * (t + 1);
+    threads.emplace_back([keys, s, e, seed, &scratch, t] {
+      hll_fold_u64_range(keys + s, e - s, seed, scratch[(size_t)t - 1].data());
+    });
+  }
+  hll_fold_u64_range(keys, per, seed, regs);
+  for (auto& th : threads) th.join();
+  for (auto& sc : scratch)
+    for (int i = 0; i < 16384; i++)
+      if (sc[(size_t)i] > regs[i]) regs[i] = sc[(size_t)i];
+}
+
+// Row-layout byte-key fold: keys arrive as the executor's padded [n, w]
+// uint8 matrix + per-key lengths (no re-concatenation needed on the
+// dispatcher). Same register semantics as rtpu_hll_fold_u64.
+RTPU_EXPORT void rtpu_hll_fold_rows(const uint8_t* data, int64_t w,
+                                    const int32_t* lengths, int64_t n,
+                                    uint64_t seed, uint8_t* regs /*16384*/) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    murmur3_x64_128_one(data + i * w, lengths[i], seed, &h1, &h2);
+    uint32_t bucket = (uint32_t)(h1 & 16383u);
+    uint64_t rest = (h1 >> 14) | (1ULL << 50);
+    uint8_t rank = (uint8_t)(__builtin_ctzll(rest) + 1);
+    if (rank > regs[bucket]) regs[bucket] = rank;
   }
 }
 

@@ -77,7 +77,8 @@ class RedissonTPU:
             device = jax.devices()[min(tcfg.device_index, len(jax.devices()) - 1)]
             self._store = SketchStore(device=device)
             sketch = TpuBackend(
-                self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed
+                self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed,
+                ingest=getattr(tcfg, "ingest", "auto"),
             )
         self._routing = RoutingBackend(sketch)
         self._backend = self._routing

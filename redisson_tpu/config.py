@@ -36,6 +36,10 @@ class TpuConfig:
 
     device_index: int = 0
     hll_impl: str = "scatter"  # "scatter" | "sort"; scatter ~30 us vs sort ~75 ms per 1M-key batch on v5e (ops/hll.py)
+    # HLL key ingest: "device" ships raw keys (8 B/key) and hashes on-chip;
+    # "hostfold" folds into a 16 KB sketch natively and ships that; "auto"
+    # probes the link once and picks (backend_tpu.LinkProfile).
+    ingest: str = "auto"
     hash_seed: int = 0
     max_batch_keys: int = 1 << 21
     key_width_buckets: tuple = (16, 32, 64, 128, 256)

@@ -124,6 +124,19 @@ def hll_add_packed(regs, packed, count, impl: str = "scatter", seed: int = 0):
     return _hll_add(regs, h1, valid, impl)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def hll_absorb(regs, folded_u8):
+    """Merge a host-folded uint8 sketch into the device registers.
+
+    The device half of the transfer-adaptive ingest path: when the
+    host->device link is slow, the backend folds the key batch into 16 KB
+    of registers natively (native.hll_fold_u64) and ships only the sketch —
+    the same move-the-reduction-across-the-slow-link design as cross-shard
+    PFMERGE over ICI. Returns (new_regs, changed)."""
+    f = folded_u8.astype(jnp.int32)
+    return jnp.maximum(regs, f), jnp.any(f > regs)
+
+
 def _hll_add(regs, h1, valid, impl):
     p = regs.shape[0].bit_length() - 1
     bucket, rank = hll.bucket_rank(h1, p)

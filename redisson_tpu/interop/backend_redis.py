@@ -893,17 +893,28 @@ class RedisBackend:
     # layout (RedissonSetMultimap/RedissonListMultimap keep hashed
     # sub-collection keys) --------------------------------------------------
 
+    @staticmethod
+    def _mm_enc(field) -> bytes:
+        # Hex-encode the field segment: the index set, the TTL zset and the
+        # subkey suffix all carry this form, so the purge/delete Lua can
+        # rebuild subkey names by plain concatenation (the reference's
+        # '{name}:' .. field trick, RedissonMultimapCache.java) while a ':'
+        # inside a field can never collide two (key, field) pairs onto one
+        # subkey.
+        return _b(field).hex().encode()
+
+    @staticmethod
+    def _mm_dec(member: bytes) -> bytes:
+        return bytes.fromhex(bytes(member).decode())
+
     def _mm_sub(self, key: str, field) -> bytes:
-        # Raw concatenation, exactly the reference's subkey layout
-        # ('{name}:' .. field in its Lua, RedissonMultimapCache.java) so the
-        # TTL purge/delete scripts can rebuild subkey names server-side.
-        return _b(key) + b":mm:" + _b(field)
+        return _b(key) + b":mm:" + self._mm_enc(field)
 
     def _op_mm_put(self, key: str, op: Op) -> None:
         self._mm_purge_expired(key, op)
         f = op.payload["key"]
         sub = self._mm_sub(key, f)
-        self._x("SADD", key, f)
+        self._x("SADD", key, self._mm_enc(f))
         if op.payload.get("list"):
             self._x("RPUSH", sub, op.payload["value"])
             op.future.set_result(True)
@@ -929,8 +940,9 @@ class RedisBackend:
             ok = self._x("SREM", sub, op.payload["value"]) > 0
             empty = self._x("SCARD", sub) == 0
         if empty:
-            self.client.pipeline([("DEL", sub), ("SREM", key, f),
-                                  ("ZREM", self._mm_ttl_key(key), f)])
+            ef = self._mm_enc(f)
+            self.client.pipeline([("DEL", sub), ("SREM", key, ef),
+                                  ("ZREM", self._mm_ttl_key(key), ef)])
         op.future.set_result(ok)
 
     def _op_mm_remove_all(self, key: str, op: Op) -> None:
@@ -941,16 +953,17 @@ class RedisBackend:
             old = [bytes(v) for v in self._x("LRANGE", sub, 0, -1)]
         else:
             old = [bytes(v) for v in self._x("SMEMBERS", sub)]
-        self.client.pipeline([("DEL", sub), ("SREM", key, f),
-                              ("ZREM", self._mm_ttl_key(key), f)])
+        ef = self._mm_enc(f)
+        self.client.pipeline([("DEL", sub), ("SREM", key, ef),
+                              ("ZREM", self._mm_ttl_key(key), ef)])
         op.future.set_result(old)
 
     def _op_mm_keys(self, key: str, op: Op) -> None:
         self._mm_purge_expired(key, op)
-        op.future.set_result([bytes(f) for f in self._x("SMEMBERS", key)])
+        op.future.set_result(self._mm_fields(key))
 
     def _mm_fields(self, key: str) -> List[bytes]:
-        return [bytes(f) for f in self._x("SMEMBERS", key)]
+        return [self._mm_dec(f) for f in self._x("SMEMBERS", key)]
 
     def _op_mm_size(self, key: str, op: Op) -> None:
         self._mm_purge_expired(key, op)
@@ -969,7 +982,8 @@ class RedisBackend:
 
     def _op_mm_contains_key(self, key: str, op: Op) -> None:
         self._mm_purge_expired(key, op)
-        op.future.set_result(self._x("SISMEMBER", key, op.payload["key"]) == 1)
+        op.future.set_result(
+            self._x("SISMEMBER", key, self._mm_enc(op.payload["key"])) == 1)
 
     def _op_mm_contains_value(self, key: str, op: Op) -> None:
         self._mm_purge_expired(key, op)
@@ -1105,7 +1119,7 @@ class RedisBackend:
         ttl_ms = op.payload.get("ttl_ms")
         deadline = self._now_ms() + int(ttl_ms) if ttl_ms and ttl_ms > 0 else 0
         res = self._eval(self.MM_EXPIRE_KEY, [key, self._mm_ttl_key(key)],
-                         [_fmt_num(deadline), op.payload["key"]])
+                         [_fmt_num(deadline), self._mm_enc(op.payload["key"])])
         op.future.set_result(res == 1)
 
     def _op_mm_delete(self, key: str, op: Op) -> None:

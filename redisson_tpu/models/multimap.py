@@ -114,10 +114,14 @@ class _RMultimapCache(_RMultimap):
 
     def expire_key(self, key: Any, ttl_s: float) -> bool:
         """Per-key TTL; True only when the key currently exists. ttl <= 0
-        clears a previously set TTL (expireKeyAsync contract)."""
+        clears a previously set TTL (expireKeyAsync contract). A strictly
+        positive sub-millisecond ttl rounds up to 1 ms — truncating to 0
+        would silently flip "expire almost now" into "never expire"."""
+        ttl_ms = int(ttl_s * 1000)
+        if ttl_s > 0 and ttl_ms == 0:
+            ttl_ms = 1
         return self._executor.execute_sync(
-            self.name, "mm_expire_key",
-            self._p(key=self._ek(key), ttl_ms=int(ttl_s * 1000)),
+            self.name, "mm_expire_key", self._p(key=self._ek(key), ttl_ms=ttl_ms),
         )
 
 

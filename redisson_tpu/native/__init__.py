@@ -91,6 +91,10 @@ def _load():
         lib.rtpu_resp_parser_take.restype = ctypes.c_int64
         lib.rtpu_hll_fold_batch.argtypes = [
             u8p, i64p, ctypes.c_int64, ctypes.c_uint64, u8p]
+        lib.rtpu_hll_fold_u64.argtypes = [
+            u64p, ctypes.c_int64, ctypes.c_uint64, u8p, ctypes.c_int32]
+        lib.rtpu_hll_fold_rows.argtypes = [
+            u8p, ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_uint64, u8p]
         lib.rtpu_version.restype = ctypes.c_char_p
         _lib = lib
         AVAILABLE = True
@@ -399,6 +403,71 @@ def hll_fold(keys: Sequence[bytes], regs: np.ndarray, seed: int = 0) -> np.ndarr
     lib.rtpu_hll_fold_batch(
         _u8p(data), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(keys), ctypes.c_uint64(seed), _u8p(regs))
+    return regs
+
+
+def hll_fold_u64(
+    keys: np.ndarray, regs: np.ndarray, seed: int = 0, nthreads: int = 0
+) -> np.ndarray:
+    """Fold u64 keys (hashed as 8-byte LE murmur3_x64_128) into a
+    16384-register uint8 array in-place — the transfer-adaptive ingest
+    path's host half (ship the 16 KB sketch, not 8 B/key; the merge runs
+    on device). Accepts uint64 [n] or the pack_u64 uint32 [n, 2] layout
+    (same memory). Releases the GIL for the native call, so the fold
+    overlaps the submitting thread. nthreads=0 -> os.cpu_count()."""
+    assert regs.dtype == np.uint8 and regs.shape == (16384,)
+    if keys.dtype == np.uint64:
+        keys = np.ascontiguousarray(keys)
+    elif keys.dtype == np.uint32 and keys.ndim == 2 and keys.shape[1] == 2:
+        keys = np.ascontiguousarray(keys).view(np.uint64).reshape(-1)
+    else:
+        # Anything else (e.g. default int64) would truncate through a u32
+        # cast and pair adjacent values into garbage keys — a silently
+        # skewed estimate. Refuse.
+        raise TypeError(
+            f"hll_fold_u64 wants uint64 [n] or packed uint32 [n, 2] keys, "
+            f"got {keys.dtype} {keys.shape}"
+        )
+    if nthreads <= 0:
+        nthreads = os.cpu_count() or 1
+    lib = _load()
+    if lib is None:
+        from redisson_tpu.native._pyfallback import murmur3_x64_128 as g
+        for k in keys.tolist():
+            h1, _ = g(int(k).to_bytes(8, "little"), seed)
+            bucket = h1 & 16383
+            rest = (h1 >> 14) | (1 << 50)
+            rank = 1
+            while not (rest & 1):
+                rest >>= 1
+                rank += 1
+            if rank > regs[bucket]:
+                regs[bucket] = rank
+        return regs
+    lib.rtpu_hll_fold_u64(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        keys.shape[0], ctypes.c_uint64(seed), _u8p(regs),
+        ctypes.c_int32(nthreads))
+    return regs
+
+
+def hll_fold_rows(
+    data: np.ndarray, lengths: np.ndarray, regs: np.ndarray, seed: int = 0
+) -> Optional[np.ndarray]:
+    """Fold padded byte-key rows ([n, w] uint8 + [n] int32 lengths) into a
+    16384-register uint8 array in-place. Returns None when the native
+    library is unavailable (callers fall back to the device path; unlike
+    hll_fold_u64 there is no python fallback worth running per-key here)."""
+    assert regs.dtype == np.uint8 and regs.shape == (16384,)
+    lib = _load()
+    if lib is None:
+        return None
+    data = np.ascontiguousarray(data, np.uint8)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    lib.rtpu_hll_fold_rows(
+        _u8p(data), data.shape[1],
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.shape[0], ctypes.c_uint64(seed), _u8p(regs))
     return regs
 
 

@@ -275,8 +275,6 @@ class ExtendedOps:
 
     def _op_mm_delete(self, key: str, op: Op) -> None:
         """Delete the multimap + its TTL state (reference deleteAsync)."""
-        from redisson_tpu.structures.engine import T
-
         kv = self._entry(key)
         op.future.set_result(kv is not None and self._drop(key))
 
@@ -301,8 +299,11 @@ class ExtendedOps:
         op.future.set_result(True)
 
     def _op_mm_put(self, key: str, op: Op) -> None:
+        # Reap BEFORE _create: reaping afterwards could drop a newly
+        # re-registered (emptied) multimap from the store and lose this
+        # put into the detached KV.
+        self._mm_reap(key, self._entry(key, self._mm_type(op)))
         kv = self._create(key, self._mm_type(op), dict)
-        self._mm_reap(key, kv)
         k = op.payload["key"]
         if op.payload.get("list"):
             bucket = kv.value.setdefault(k, deque())

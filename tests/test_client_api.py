@@ -303,3 +303,53 @@ def test_add_device_larger_than_max_bucket(client, monkeypatch):
     keys = np.arange(1, n + 1, dtype=np.uint64) * np.uint64(0x2545F4914F6CDD1D)
     assert h.add_device(jax.device_put(pack_u64(keys))) is True
     assert abs(h.count() - n) / n < 0.05
+
+
+class TestHostfoldIngest:
+    """Transfer-adaptive ingest (backend_tpu hostfold path): forced on, the
+    client must produce the same estimates and changed-bits as the device
+    path — they are drop-in replacements chosen by the link probe."""
+
+    @pytest.fixture(scope="class")
+    def hf_client(self):
+        from redisson_tpu.config import TpuConfig
+
+        c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="hostfold")))
+        if not __import__("redisson_tpu.native", fromlist=["available"]).available():
+            c.shutdown()
+            pytest.skip("native library unavailable")
+        yield c
+        c.shutdown()
+
+    def test_add_ints_roundtrip(self, hf_client):
+        h = hf_client.get_hyper_log_log("hf:ints")
+        keys = np.random.default_rng(3).integers(
+            0, 2**63, size=200_000, dtype=np.uint64)
+        assert h.add_ints(keys) is True
+        assert h.add_ints(keys) is False  # replay raises nothing
+        err = abs(h.count() - 200_000) / 200_000
+        assert err < 0.02
+
+    def test_matches_device_path(self, hf_client):
+        from redisson_tpu.config import TpuConfig
+
+        dev_client = RedissonTPU.create(Config(tpu=TpuConfig(ingest="device")))
+        try:
+            keys = np.random.default_rng(5).integers(
+                0, 2**63, size=150_000, dtype=np.uint64)
+            a = hf_client.get_hyper_log_log("hf:match")
+            b = dev_client.get_hyper_log_log("hf:match")
+            a.add_ints(keys)
+            b.add_ints(keys)
+            assert a.count() == b.count()
+        finally:
+            dev_client.shutdown()
+
+    def test_byte_keys_roundtrip(self, hf_client):
+        h = hf_client.get_hyper_log_log("hf:bytes")
+        # Force the rows fold by exceeding HOSTFOLD_MIN_KEYS in one call.
+        from redisson_tpu import backend_tpu
+
+        n = backend_tpu.HOSTFOLD_MIN_KEYS + 5
+        h.add_all([f"k{i}" for i in range(n)])
+        assert abs(h.count() - n) / n < 0.03

@@ -181,3 +181,57 @@ def test_hll_fold_estimate_sane():
     native.hll_fold(keys, regs)
     est = float(hll.count(jnp.asarray(regs.astype(np.int32))))
     assert abs(est - n) / n < 0.02
+
+
+def test_hll_fold_u64_matches_device_path():
+    """The native u64 fold must be register-identical to the device ingest
+    kernel (engine.hll_add_packed) — the transfer-adaptive path swaps them
+    freely, so any divergence would silently skew estimates."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax.numpy as jnp
+
+    from redisson_tpu import engine
+    from redisson_tpu.models.object import pack_u64
+
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 2**64, size=50_000, dtype=np.uint64)
+    for seed in (0, 7):
+        dev, _ = engine.hll_add_packed(
+            jnp.zeros((16384,), jnp.int32), pack_u64(keys),
+            np.int32(keys.shape[0]), "scatter", seed)
+        host = np.zeros(16384, np.uint8)
+        native.hll_fold_u64(keys, host, seed=seed)
+        np.testing.assert_array_equal(np.asarray(dev).astype(np.uint8), host)
+    # packed [n, 2] uint32 layout is the same memory as uint64 [n]
+    host2 = np.zeros(16384, np.uint8)
+    native.hll_fold_u64(pack_u64(keys), host2, seed=0)
+    ref = np.zeros(16384, np.uint8)
+    native.hll_fold_u64(keys, ref, seed=0)
+    np.testing.assert_array_equal(host2, ref)
+
+
+def test_hll_fold_u64_threads_match_single():
+    rng = np.random.default_rng(12)
+    keys = rng.integers(0, 2**64, size=300_000, dtype=np.uint64)
+    a = np.zeros(16384, np.uint8)
+    b = np.zeros(16384, np.uint8)
+    native.hll_fold_u64(keys, a, nthreads=1)
+    native.hll_fold_u64(keys, b, nthreads=4)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_hll_fold_rows_matches_byte_fold():
+    if not native.available():
+        return
+    keys = [f"user:{i}".encode() for i in range(8000)]
+    w = 16
+    data = np.zeros((len(keys), w), np.uint8)
+    lengths = np.zeros((len(keys),), np.int32)
+    for i, k in enumerate(keys):
+        data[i, : len(k)] = np.frombuffer(k, np.uint8)
+        lengths[i] = len(k)
+    rows = np.zeros(16384, np.uint8)
+    assert native.hll_fold_rows(data, lengths, rows) is not None
+    ref = np.zeros(16384, np.uint8)
+    native.hll_fold(keys, ref)
+    np.testing.assert_array_equal(rows, ref)

@@ -170,3 +170,31 @@ def test_reversed_zrange_matches_engine_semantics(rclient):
     assert z.value_range(0, 1, reversed=True) == ["c", "b"]
     assert z.value_range(-1, -1, reversed=True) == ["a"]
     assert z.add_all([]) == 0  # empty ZADD must not hit the wire
+
+
+def test_multimap_colon_fields_do_not_collide(rclient):
+    """(review r3) Fields containing ':' must not collide two (key, field)
+    pairs onto one subkey: 'a' + 'b:mm:c' vs 'a:mm:b' + 'c' were one Redis
+    key under raw concatenation; the hex-encoded field segment keeps them
+    apart and keeps the purge/delete Lua able to rebuild subkey names."""
+    m1 = rclient.get_set_multimap("a")
+    m2 = rclient.get_set_multimap("a:mm:" + "6263")  # hex('bc')-shaped name
+    m1.put("bc", "v1")
+    m2.put("bc", "v2")
+    assert m1.get_all("bc") == {"v1"}
+    assert m2.get_all("bc") == {"v2"}
+    assert set(m1.key_set()) == {"bc"}
+    assert m1.contains_key("bc")
+    m1.delete()
+    assert m2.get_all("bc") == {"v2"}  # deleting m1 must not touch m2
+
+
+def test_multimap_cache_colon_field_ttl(rclient):
+    import time
+
+    mm = rclient.get_set_multimap_cache("rm:mmc2")
+    mm.put("a:mm:b", "v")
+    assert mm.contains_key("a:mm:b")
+    assert mm.expire_key("a:mm:b", 0.0005)  # sub-ms rounds up to 1 ms
+    time.sleep(0.05)
+    assert mm.get_all("a:mm:b") == set()

@@ -262,3 +262,27 @@ def test_executor_shutdown_race_returns_failed_future():
     assert post.done()
     with pytest.raises(RuntimeError, match="shut down"):
         post.result()
+
+
+def test_hll_add_empty_batch_returns_false(client):
+    """Empty key batch: no chunks dispatch, changed must be False (review
+    r3: functools.reduce over zero parts raised TypeError)."""
+    import numpy as np
+
+    h = client.get_hyper_log_log("regr:empty")
+    assert h.add_ints(np.array([], dtype=np.uint64)) is False
+    assert h.count() == 0
+
+
+def test_multimap_cache_put_after_full_expiry(client):
+    """Put into a multimap whose last key just expired must survive (review
+    r3: reap-after-create dropped the re-registered KV, losing the write)."""
+    import time
+
+    mm = client.get_set_multimap_cache("regr:mmc")
+    mm.put("k", "v")
+    assert mm.expire_key("k", 0.03)
+    time.sleep(0.06)
+    assert mm.put("k", "new") is True
+    assert mm.get_all("k") == {"new"}
+    assert mm.contains_key("k") is True
