@@ -15,11 +15,11 @@ Two rates are measured:
 The HEADLINE is the end-to-end rate; the kernel rate and the PFMERGE(1000)
 latency print on stderr and ride along as extra JSON keys.
 
-Why 'scatter' vs 'sort' differ ~400x (VERDICT r1 weak #2): 'scatter' lowers
-to XLA's vectorized combining max-scatter on TPU (~30 us per 1M-key batch);
-'sort' pre-compresses the batch through jnp.sort, and XLA's 1-D sort lowers
-to a bitonic network on TPU (~75 ms per 1M batch) — the sort path exists
-only as a fallback/debugging aid (see redisson_tpu/ops/hll.py docstring).
+'scatter' lowers to XLA's combining max-scatter on TPU (~9 ms per 1M-key
+batch measured by the device-loop method below — r1/r2's "30 us" was a
+block_until_ready artifact on this tunneled platform); 'sort' pre-compresses
+the batch through jnp.sort (bitonic on TPU) and lands ~2x slower. The sort
+path exists as a fallback/debugging aid (redisson_tpu/ops/hll.py).
 
 Backend acquisition goes through redisson_tpu.tpu_boot: subprocess-probed
 init with retry/backoff, CPU fallback — this script must never exit non-zero
@@ -36,39 +36,60 @@ import numpy as np
 
 
 def bench_kernel(jax, dev, n, reps):
-    """Device-resident kernel rate for both HLL insert impls."""
+    """Device-resident kernel rate for both HLL insert impls.
+
+    Measurement methodology (round 3): on this tunneled platform
+    `block_until_ready()` does not reliably wait, so dispatch-all-sync-once
+    loops report fantasy rates (r2's 59 G/s was such an artifact). Instead
+    the whole measurement runs ON DEVICE as one jitted lax.fori_loop whose
+    carry chains the register buffer, and the clock stops only when the
+    final registers' scalar count reads back — nothing can be skipped.
+    Each iteration XORs the batch with the loop counter so the hash chain
+    is not loop-invariant (XLA would hoist it otherwise).
+    """
+    import functools
+
+    import jax.numpy as jnp
+    from jax import lax
+
     from redisson_tpu import engine
-    from redisson_tpu.ops import hll
+    from redisson_tpu.ops import hashing, hll
+    from redisson_tpu.ops.u64 import U64
 
     rng = np.random.default_rng(42)
-    batches = []
-    for _ in range(reps):
-        keys = rng.integers(0, 2**63, size=n, dtype=np.uint64)
-        hi = (keys >> np.uint64(32)).astype(np.uint32)
-        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        batches.append((jax.device_put(hi, dev), jax.device_put(lo, dev)))
-    valid = jax.device_put(np.ones((n,), bool), dev)
+    keys = rng.integers(0, 2**63, size=n, dtype=np.uint64)
+    packed = jax.device_put(
+        keys.view(np.uint32).reshape(-1, 2), dev)
+
+    @functools.partial(jax.jit, static_argnames=("impl", "iters"))
+    def insert_loop(regs, packed, impl, iters):
+        def body(i, regs):
+            # Perturb keys per iteration (defeats loop-invariant hoisting;
+            # still n distinct keys per pass).
+            p = packed.at[:, 0].set(packed[:, 0] ^ i.astype(jnp.uint32))
+            h1, _ = hashing.murmur3_x64_128_u64(U64(p[:, 1], p[:, 0]), 0)
+            return hll.add_hashes(regs, h1, impl)
+        regs = lax.fori_loop(0, iters, body, regs)
+        return regs, hll.count(regs)
 
     rates = {}
     for impl in ("scatter", "sort"):
+        iters = reps if impl == "scatter" else max(2, reps // 8)
         regs = jax.device_put(hll.make(), dev)
-        regs, _ = engine.hll_add_u64(regs, *batches[0], valid, impl, 0)
-        regs.block_until_ready()
+        _, est = insert_loop(regs, packed, impl, iters)
+        float(est)  # compile + warm
         rate = 0.0
-        # Pipelined rounds (dispatch all, sync once); best-of-3 rides over
-        # intermittent ~70 ms tunnel dispatch stalls.
-        for _ in range(3):
+        for _ in range(2):  # best-of rides over tunnel stalls
+            regs = jax.device_put(hll.make(), dev)
             t0 = time.perf_counter()
-            for r in range(1, reps):
-                regs, _ = engine.hll_add_u64(regs, *batches[r], valid, impl, 0)
-            regs.block_until_ready()
+            regs, est = insert_loop(regs, packed, impl, iters)
+            est = float(est)  # the only sync: after ALL iterations
             dt = time.perf_counter() - t0
-            rate = max(rate, (reps - 1) * n / dt)
+            rate = max(rate, iters * n / dt)
         rates[impl] = rate
-        est = float(engine.hll_count(regs))
         print(
-            f"# hll_add[{impl}]: {rate/1e6:.1f} M inserts/s; "
-            f"count est {est/1e6:.2f}M (true ~{reps*n/1e6:.2f}M)",
+            f"# hll_add[{impl}]: {rate/1e6:.1f} M inserts/s "
+            f"(device loop, {iters}x{n/1e6:.0f}M keys; est {est/1e6:.2f}M)",
             file=sys.stderr,
         )
     return rates
@@ -87,13 +108,11 @@ def _report_ingest_choice(n):
 
         from redisson_tpu import backend_tpu, native
 
-        prof = backend_tpu.link_profile(jax.devices()[0])
+        dev = jax.devices()[0]
+        prof = backend_tpu.link_profile(dev)
         INGEST_CHOICE.update(
-            path="hostfold" if (
-                native.available()
-                and n >= backend_tpu.HOSTFOLD_MIN_KEYS
-                and prof.prefer_hostfold)
-            else "device",
+            path="hostfold"
+            if backend_tpu.hostfold_policy("auto", n, dev) else "device",
             transfer_mb_per_s=round(1e3 / prof.transfer_ns_per_byte, 1),
             fold_mkeys_per_s=round(1e3 / prof.fold_ns_per_key, 1),
         )

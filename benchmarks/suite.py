@@ -136,29 +136,70 @@ def config2(full: bool):
         contains_dt = time.perf_counter() - t0
         assert hits.all(), "false negatives!"
 
-        rng2 = np.random.default_rng(72)
+        # FPR probe. Probes live in [2^63, 2^64), inserts in [0, 2^63) —
+        # disjoint by construction, so every probe hit is a genuine false
+        # positive. At full scale, 1B probes = 7.6 GB of host key traffic,
+        # which a tunneled link cannot move in reasonable time; the probes
+        # are synthetic, so full runs draw them on-accelerator and use the
+        # contains_count reduce (a 4-byte scalar per batch comes back).
+        import jax
+
+        devgen = full and jax.default_backend() != "cpu"
+        if devgen:
+            import jax.numpy as jnp
+
+            @jax.jit
+            def gen_probe(gk):
+                k1, k2, k3 = jax.random.split(gk, 3)
+                lo = jax.random.bits(k1, (step,), jnp.uint32)
+                # force the top bit so hi in [2^31, 2^32) -> key >= 2^63
+                hi = jax.random.bits(k2, (step,), jnp.uint32) | jnp.uint32(
+                    0x80000000)
+                return jnp.stack([lo, hi], axis=1), k3
+
+            genkey = jax.random.PRNGKey(72)
+            # Compile gen + count kernels OUTSIDE the timed region (config4
+            # pattern) so probe_dt measures probes, not XLA.
+            warm, genkey = gen_probe(genkey)
+            bf.contains_count_device_async(warm).result()
+
+            def probe_batch(s):
+                nonlocal genkey
+                fresh, genkey = gen_probe(genkey)
+                return bf.contains_count_device_async(fresh), step
+        else:
+            rng2 = np.random.default_rng(72)
+
+            def probe_batch(s):
+                fresh = rng2.integers(2**63, 2**64, min(step, n_probe - s),
+                                      dtype=np.uint64)
+                return bf.contains_ints_async(fresh), fresh.size
+
+        def drain(pending):
+            return sum(int(np.sum(p.result())) for p in pending)
+
         false_hits = 0
         probed = 0
-        t0 = time.perf_counter()
         pending = []
+        t0 = time.perf_counter()
         for s in range(0, n_probe, step):
-            fresh = rng2.integers(2**63, 2**64, min(step, n_probe - s),
-                                  dtype=np.uint64)
-            pending.append(bf.contains_ints_async(fresh))
-            probed += fresh.size
+            fut, size = probe_batch(s)
+            pending.append(fut)
+            probed += size
             if len(pending) >= 8:
-                false_hits += int(sum(p.result().sum() for p in pending))
+                false_hits += drain(pending)
                 pending = []
             if s and s % (100 * step) == 0:
                 print(f"#   fpr probe {probed/1e6:.0f}M/{n_probe/1e6:.0f}M",
                       file=sys.stderr)
-        false_hits += int(sum(p.result().sum() for p in pending))
+        false_hits += drain(pending)
         probe_dt = time.perf_counter() - t0
         fpr = false_hits / probed
         return {"config": 2, "n_keys": n, "m_bits": size, "k": k,
                 "insert_keys_per_sec": n / insert_dt,
                 "contains_keys_per_sec": sample.size / contains_dt,
                 "fpr_probes": probed,
+                "fpr_probe_source": "device" if devgen else "host",
                 "fpr_probe_keys_per_sec": probed / probe_dt,
                 "measured_fpr": fpr}
     finally:
@@ -182,8 +223,13 @@ def config3(full: bool):
         add_dt = time.perf_counter() - t0
 
         dest = c.get_hyper_log_log("b3:merged")
+        names = [f"b3:s{s}" for s in range(sketches)]
+        # Warm the merge/count kernels at this sketch-count shape so the
+        # timed pass measures the operation, not its one-time XLA compile.
+        c.get_hyper_log_log("b3:warm").merge_with(*names)
+        c.get_hyper_log_log("b3:warm").count()
         t0 = time.perf_counter()
-        dest.merge_with(*[f"b3:s{s}" for s in range(sketches)])
+        dest.merge_with(*names)
         union = dest.count()
         merge_dt = time.perf_counter() - t0
         return {"config": 3, "sketches": sketches, "keys_per_sketch": per,
@@ -215,19 +261,49 @@ def config4(full: bool):
         backend = c._backend.sketch
         from redisson_tpu.parallel import sharded
 
+        import jax
+        import jax.numpy as jnp
+
+        # At BASELINE scale the stream must not be bounded by the host link
+        # (a tunneled device moves ~10-30 MB/s; 1 B keys of host traffic is
+        # hours of DMA alone). The keys are *synthetic* by spec, so full
+        # runs draw them on-accelerator: same Zipf-ish skew, same insert
+        # path, zero host->device key traffic. CI-sized runs keep the
+        # host-streamed path covered.
+        devgen = full and jax.default_backend() != "cpu"
+
         rng = np.random.default_rng(4)
         seen_estimates = []
-        t0 = time.perf_counter()
         nbatches = total // batch_n
         distinct_space = total // 10
+
+        if devgen:
+            @jax.jit
+            def gen_batch(key):
+                k1, k2 = jax.random.split(key)
+                raw = jax.random.pareto(k1, 1.1, (batch_n,), jnp.float32)
+                scaled = raw / jnp.max(raw) * distinct_space
+                lo = scaled.astype(jnp.uint32)  # space < 2^32 by construction
+                rows = (lo % n_sketches).astype(jnp.int32)
+                return lo, rows, k2
+            genkey = jax.random.PRNGKey(4)
+            hi0 = jnp.zeros((batch_n,), jnp.uint32)
+            valid0 = jnp.ones((batch_n,), bool)
+            gen_batch(genkey)  # compile outside the timed region
+
+        t0 = time.perf_counter()
         for b in range(nbatches):
-            # Zipf-ish skew: exponential of pareto draw bounded to the space
-            raw = rng.pareto(1.1, batch_n)
-            keys = (raw / raw.max() * distinct_space).astype(np.uint64)
-            hi = (keys >> np.uint64(32)).astype(np.uint32)
-            lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            rows = (keys % np.uint64(n_sketches)).astype(np.int32)
-            valid = np.ones(batch_n, bool)
+            if devgen:
+                lo, rows, genkey = gen_batch(genkey)
+                hi, valid = hi0, valid0
+            else:
+                # Zipf-ish skew: pareto draw bounded to the distinct space
+                raw = rng.pareto(1.1, batch_n)
+                keys = (raw / raw.max() * distinct_space).astype(np.uint64)
+                hi = (keys >> np.uint64(32)).astype(np.uint32)
+                lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                rows = (keys % np.uint64(n_sketches)).astype(np.int32)
+                valid = np.ones(batch_n, bool)
             backend.bank, _ = sharded.bank_insert(
                 backend.bank, hi, lo, rows, valid, backend.mesh, backend.seed)
             if b % 8 == 7:
@@ -241,6 +317,7 @@ def config4(full: bool):
         return {"config": 4, "total_keys": nbatches * batch_n,
                 "sharded_hlls": n_sketches,
                 "keys_per_sec": nbatches * batch_n / dt,
+                "key_source": "device" if devgen else "host",
                 "final_estimate": seen_estimates[-1] if seen_estimates else None,
                 "periodic_merges": len(seen_estimates)}
     finally:
@@ -273,9 +350,14 @@ def config5(full: bool):
             backend.bank, hi, lo, rows, valid, backend.mesh, backend.seed)
         backend.bank.block_until_ready()
 
-        t0 = time.perf_counter()
-        est = float(sharded.bank_count_all(backend.bank, backend.mesh))
-        merge_dt = time.perf_counter() - t0
+        # Compile outside the timed region; time the steady-state merge
+        # (best of 3 rides over tunnel dispatch stalls, like bench.py).
+        float(sharded.bank_count_all(backend.bank, backend.mesh))
+        merge_dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            est = float(sharded.bank_count_all(backend.bank, backend.mesh))
+            merge_dt = min(merge_dt, time.perf_counter() - t0)
         err = abs(est - keys.size) / keys.size
         return {"config": 5, "sketches": n_sketches,
                 "cross_slot_merge_count_ms": merge_dt * 1000,

@@ -208,6 +208,22 @@ def link_profile(device) -> LinkProfile:
 HOSTFOLD_MIN_KEYS = 1 << 16
 
 
+def hostfold_policy(ingest: str, nkeys: int, device) -> bool:
+    """THE ingest decision, shared by the backend and any reporter (bench):
+    duplicating these gates drifts."""
+    if ingest == "device":
+        return False
+    from redisson_tpu import native as native_mod
+
+    if not native_mod.available():
+        return False
+    if ingest == "hostfold":
+        return True
+    if nkeys < HOSTFOLD_MIN_KEYS:
+        return False
+    return link_profile(device).prefer_hostfold
+
+
 class TpuBackend:
     """Stateless op interpreter over a SketchStore (all state lives there)."""
 
@@ -240,17 +256,7 @@ class TpuBackend:
         self.completer = Completer()
 
     def _use_hostfold(self, nkeys: int) -> bool:
-        if self.ingest == "device":
-            return False
-        from redisson_tpu import native as native_mod
-
-        if not native_mod.available():
-            return False
-        if self.ingest == "hostfold":
-            return True
-        if nkeys < HOSTFOLD_MIN_KEYS:
-            return False
-        return link_profile(self.store.device).prefer_hostfold
+        return hostfold_policy(self.ingest, nkeys, self.store.device)
 
     # -- dispatch -----------------------------------------------------------
 
@@ -733,6 +739,32 @@ class TpuBackend:
 
     def _op_bloom_contains(self, target: str, ops: List[Op]) -> None:
         self._bloom_run(target, ops, mutate=False)
+
+    def _op_bloom_contains_count(self, target: str, ops: List[Op]) -> None:
+        """Hit count per op (host-packed or device-resident keys): chunks
+        reduce on device, one int32 scalar rides back per op."""
+        obj, m, k = self._bloom_meta(target)
+        for op in ops:
+            parts = []
+            if "device_packed" in op.payload:
+                arr = op.payload["device_packed"]
+                for s, e in engine.chunk_spans(int(arr.shape[0])):
+                    chunk = arr[s:e]
+                    n = e - s
+                    b = engine.bucket_size(n)
+                    if n != b:
+                        chunk = jnp.zeros((b, 2), jnp.uint32).at[:n].set(chunk)
+                    parts.append(engine.bloom_contains_count_packed(
+                        obj.state, chunk, np.int32(n), k, m, self.seed))
+            else:
+                packed = op.payload["packed"]
+                for s, e in engine.chunk_spans(packed.shape[0]):
+                    rows, count = engine.pad_rows(packed[s:e])
+                    parts.append(engine.bloom_contains_count_packed(
+                        obj.state, rows, np.int32(count), k, m, self.seed))
+            total = _start_d2h(functools.reduce(jnp.add, parts)) if parts else 0
+            self.completer.submit(
+                _complete_all([op], lambda t=total: int(t)))
 
     def _op_bloom_meta(self, target: str, ops: List[Op]) -> None:
         obj, m, k = self._bloom_meta(target)

@@ -213,6 +213,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("bloom_init", "LUA", True, "tpu"),
     _d("bloom_add", "SETBIT", True, "tpu"),
     _d("bloom_contains", "GETBIT", False, "tpu"),
+    _d("bloom_contains_count", "BITCOUNT", False, "tpu"),
     _d("bloom_count", "BITCOUNT", False, "tpu"),
     _d("bloom_meta", "HGETALL", False, "tpu"),
 ]}

@@ -35,7 +35,7 @@ class TpuConfig:
     """Single-chip sketch engine."""
 
     device_index: int = 0
-    hll_impl: str = "scatter"  # "scatter" | "sort"; scatter ~30 us vs sort ~75 ms per 1M-key batch on v5e (ops/hll.py)
+    hll_impl: str = "scatter"  # "scatter" | "sort"; scatter ~2x faster at 1M-key batches on v5e (ops/hll.py)
     # HLL key ingest: "device" ships raw keys (8 B/key) and hashes on-chip;
     # "hostfold" folds into a 16 KB sketch natively and ships that; "auto"
     # probes the link once and picks (backend_tpu.LinkProfile).

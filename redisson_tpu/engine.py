@@ -293,3 +293,12 @@ def bloom_add_packed(bits, packed, count, k: int, m: int, seed: int = 0):
 def bloom_contains_packed(bits, packed, count, k: int, m: int, seed: int = 0):
     h1, h2, valid = _packed_hashes(packed, count, seed)
     return _bloom_contains(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def bloom_contains_count_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+    """Membership COUNT of a packed batch — a server-side reduce in the
+    reference's sense (BITCOUNT-style): only a 4-byte scalar leaves the
+    device, which is what makes the FPR@1B probe feasible on a slow link."""
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    return jnp.sum(_bloom_contains(bits, h1, h2, valid, k, m).astype(jnp.int32))

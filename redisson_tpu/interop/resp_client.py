@@ -168,7 +168,13 @@ class RespClient:
         while self._pending:
             fut = self._pending.popleft()
             if not fut.done():
-                fut.set_exception(exc)
+                try:
+                    fut.set_exception(exc)
+                except RuntimeError:
+                    # The future's loop is already closed (interpreter /
+                    # fixture teardown finishing while the read loop drains)
+                    # — nobody is left to observe the failure.
+                    pass
 
     async def _reconnect(self) -> None:
         """Exponential backoff dial loop (ConnectionWatchdog semantics)."""

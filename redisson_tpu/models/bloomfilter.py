@@ -75,6 +75,26 @@ class RBloomFilter(RObject):
             nkeys=packed.shape[0]
         )
 
+    def contains_count_ints(self, values: np.ndarray) -> int:
+        """Membership COUNT of a uint64 key batch — only a scalar returns
+        (the BITCOUNT-style server-side reduce; what an FPR probe wants)."""
+        return self.contains_count_ints_async(values).result()
+
+    def contains_count_ints_async(self, values: np.ndarray):
+        packed = pack_u64(values)
+        return self._executor.execute_async(
+            self.name, "bloom_contains_count", {"packed": packed},
+            nkeys=packed.shape[0]
+        )
+
+    def contains_count_device_async(self, packed):
+        """Same, for keys already resident on device in the pack_u64
+        layout (uint32 [n, 2]) — no host key traffic at all."""
+        return self._executor.execute_async(
+            self.name, "bloom_contains_count", {"device_packed": packed},
+            nkeys=int(packed.shape[0])
+        )
+
     def contains(self, value) -> bool:
         return bool(self.contains_all([value])[0])
 

@@ -87,3 +87,30 @@ def test_int_fast_path_matches_byte_path():
         assert bf.contains_ints(fresh).mean() < 0.05
     finally:
         c.shutdown()
+
+
+def test_contains_count_matches_per_key():
+    """contains_count (the scalar reduce) must equal sum(contains) for the
+    same batch, on both the host-packed and device-resident payloads."""
+    import jax
+    import numpy as np
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.models.object import pack_u64
+
+    client = RedissonTPU.create()
+    try:
+        bf = client.get_bloom_filter("bloom:cc")
+        bf.try_init(expected_insertions=10_000, false_probability=0.01)
+        rng = np.random.default_rng(21)
+        ins = rng.integers(0, 2**62, 5_000, np.uint64)
+        bf.add_ints(ins)
+        probe = np.concatenate([ins[:2_000],
+                                rng.integers(2**62, 2**63, 3_000, np.uint64)])
+        per_key = int(bf.contains_ints(probe).sum())
+        assert bf.contains_count_ints(probe) == per_key
+        dev = jax.device_put(pack_u64(probe))
+        assert bf.contains_count_device_async(dev).result() == per_key
+        assert per_key >= 2_000  # no false negatives on the inserted prefix
+    finally:
+        client.shutdown()

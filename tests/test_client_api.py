@@ -312,12 +312,15 @@ class TestHostfoldIngest:
 
     @pytest.fixture(scope="class")
     def hf_client(self):
+        from redisson_tpu import native
         from redisson_tpu.config import TpuConfig
 
-        c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="hostfold")))
-        if not __import__("redisson_tpu.native", fromlist=["available"]).available():
-            c.shutdown()
+        # Check availability BEFORE create(): forced hostfold without the
+        # native lib raises by contract, and this guard exists to skip
+        # (not error) on hosts that cannot build it.
+        if not native.available():
             pytest.skip("native library unavailable")
+        c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="hostfold")))
         yield c
         c.shutdown()
 

@@ -39,10 +39,20 @@ def pair():
         master.stop()
 
 
+def _patient_factory(host: str, port: int) -> RespConnectionPool:
+    """For routing-only tests: generous timeouts so a loaded 1-core CI host
+    can't trip the freeze threshold and silently fall reads back to the
+    master (which is exactly what these tests assert does NOT happen)."""
+    return RespConnectionPool(
+        host=host, port=port, timeout=5.0, retry_attempts=2,
+        retry_interval=0.1, size=2, min_idle=1, failed_attempts=10,
+        reconnection_timeout=0.3)
+
+
 def test_write_to_master_read_from_slave(pair):
     master, slave = pair
     router = MasterSlaveRouter(
-        _fast_factory, f"127.0.0.1:{master.port}",
+        _patient_factory, f"127.0.0.1:{master.port}",
         [f"127.0.0.1:{slave.port}"], read_mode="SLAVE")
     router.connect()
     try:
