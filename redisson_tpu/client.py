@@ -140,6 +140,25 @@ class RedissonTPU:
             )
 
         u = urlparse(rcfg.address)
+        if rcfg.cluster_addresses:
+            from redisson_tpu.interop.topology_redis import (
+                ClusterRouter, ClusterTopologyManager)
+
+            router = ClusterRouter(factory, rcfg.cluster_addresses)
+            mgr = ClusterTopologyManager(
+                router,
+                scan_interval_s=rcfg.cluster_scan_interval_ms / 1000.0)
+            try:
+                mgr.bootstrap()
+            except Exception:
+                # bootstrap dialed pools through the router; nobody above
+                # holds a reference yet, so reclaim them (and the scan
+                # thread) here or they leak per failed create().
+                mgr.close()
+                router.close()
+                raise
+            self._cluster_manager = mgr
+            return router
         if rcfg.sentinel_addresses:
             from redisson_tpu.interop.resp_client import SyncPubSubClient
             from redisson_tpu.interop.topology_redis import SentinelManager
@@ -187,6 +206,9 @@ class RedissonTPU:
             if getattr(self, "_role_monitor", None) is not None:
                 self._role_monitor.close()
                 self._role_monitor = None
+            if getattr(self, "_cluster_manager", None) is not None:
+                self._cluster_manager.close()
+                self._cluster_manager = None
             self._resp.close()  # reclaim the IO-loop thread
             raise
         self._backend = self._routing = RedisBackend(self._resp)
@@ -588,6 +610,9 @@ class RedissonTPU:
         if getattr(self, "_role_monitor", None) is not None:
             self._role_monitor.close()
             self._role_monitor = None
+        if getattr(self, "_cluster_manager", None) is not None:
+            self._cluster_manager.close()
+            self._cluster_manager = None
         if getattr(self, "_redis_watchdog", None) is not None:
             self._redis_watchdog.shutdown()
             self._redis_watchdog = None

@@ -64,6 +64,13 @@ class RedisConfig:
     # (SLAVE | MASTER | MASTER_SLAVE). Empty = single endpoint.
     slave_addresses: List[str] = dataclasses.field(default_factory=list)
     read_mode: str = "SLAVE"
+    # Cluster mode (ClusterServersConfig): bootstrap the slot topology with
+    # CLUSTER NODES from any of these seeds, route keyed commands by CRC16
+    # slot, and re-scan every cluster_scan_interval_ms (the reference's
+    # scanInterval; 0 = bootstrap only). Takes precedence over sentinel and
+    # master/slave settings.
+    cluster_addresses: List[str] = dataclasses.field(default_factory=list)
+    cluster_scan_interval_ms: int = 1000
     # Sentinel mode (SentinelServersConfig): discover the master/slaves by
     # name from these sentinels and follow +switch-master events. When set,
     # `address`/`slave_addresses` are ignored.

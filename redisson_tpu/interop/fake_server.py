@@ -70,7 +70,7 @@ def _readonly_for_replication() -> frozenset:
     from redisson_tpu.interop.topology_redis import READ_COMMANDS
 
     return READ_COMMANDS | {"ECHO", "SELECT", "AUTH", "SCRIPT", "PUBLISH",
-                            "SENTINEL", "INFO"}
+                            "SENTINEL", "INFO", "CLUSTER"}
 
 
 class _ZSet(dict):
@@ -116,6 +116,12 @@ class FakeRedisServer:
         # on a connection that sent ASKING first.
         self.ask_keys: Dict[bytes, str] = {}
         self.importing: set = set()
+        # Cluster fixture: shared ClusterState + this node's own address.
+        # When set, keyed commands for slots this node does not own reply
+        # -MOVED to the owner, and CLUSTER NODES renders the shared table
+        # (`cluster/ClusterConnectionManager.java:599-637` parse format).
+        self.cluster_state: Optional["ClusterState"] = None
+        self.cluster_self: Optional[str] = None
         # Sentinel fixture: this server answers SENTINEL queries for these
         # monitored masters (name -> "host:port") and their slaves
         # (name -> ["host:port", ...]); failover tests publish
@@ -187,15 +193,22 @@ class FakeRedisServer:
                             writer.write(self._do_subscribe(name, args, writer))
                         elif name in ("BLPOP", "BRPOP", "BRPOPLPUSH"):
                             reply = await self._blocking_pop(name, args)
-                            writer.write(reply)
+                            # Replicate BEFORE the reply hits the wire:
+                            # write() flushes eagerly, so a client that
+                            # acts on the reply must already see replica
+                            # state (synchronous replication — determinism
+                            # the test fixture exists to provide; replying
+                            # first raced every read-your-replica assert).
                             self._replicate_blocking_pop(name, args, reply)
+                            writer.write(reply)
                         else:
                             redirect = self._redirect_for(name, args, asking)
                             if redirect is not None:
                                 writer.write(redirect)
                             else:
-                                writer.write(self._dispatch(name, args))
+                                reply = self._dispatch(name, args)
                                 self._replicate(name, args)
+                                writer.write(reply)
                                 # Wake parked blocking-pop waiters to re-check.
                                 async with self._push_cond:
                                     self._push_cond.notify_all()
@@ -221,6 +234,7 @@ class FakeRedisServer:
     _UNKEYED = frozenset({
         "PING", "ECHO", "SELECT", "DBSIZE", "FLUSHALL", "KEYS", "SCRIPT",
         "EVAL", "EVALSHA", "PUBLISH", "AUTH", "SCAN", "SENTINEL", "INFO",
+        "CLUSTER",
     })
 
     def _redirect_for(self, name: str, a: List[bytes], asking: bool):
@@ -244,6 +258,13 @@ class FakeRedisServer:
             slot = crc16.key_slot(key.decode("utf-8", "replace"))
             owner = self.moved_slots.get(slot)
             if owner is not None:
+                return f"-MOVED {slot} {owner}\r\n".encode()
+        if self.cluster_state is not None and self.cluster_self is not None:
+            from redisson_tpu.ops import crc16
+
+            slot = crc16.key_slot(key.decode("utf-8", "replace"))
+            owner = self.cluster_state.owner_of(slot)
+            if owner is not None and owner != self.cluster_self:
                 return f"-MOVED {slot} {owner}\r\n".encode()
         return None
 
@@ -285,6 +306,18 @@ class FakeRedisServer:
         body = (f"# Replication\r\nrole:{role}\r\n"
                 f"connected_slaves:{len(self.replicas)}\r\n")
         return _bulk(body.encode())
+
+    def _cmd_cluster(self, a):
+        """CLUSTER NODES — renders the shared fixture topology in the wire
+        format the reference parses (`ClusterConnectionManager.java:599-637`,
+        `ClusterNodeInfo.java`)."""
+        sub = bytes(a[0]).upper().decode() if a else ""
+        if sub == "NODES":
+            if self.cluster_state is None:
+                return _err("this instance has cluster support disabled")
+            return _bulk(
+                self.cluster_state.nodes_text(self.cluster_self).encode())
+        return _err(f"unsupported CLUSTER subcommand {sub!r}")
 
     def _cmd_sentinel(self, a):
         """SENTINEL GET-MASTER-ADDR-BY-NAME / SLAVES — the bootstrap
@@ -1646,6 +1679,141 @@ class EmbeddedRedis:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
             self._loop.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ClusterState:
+    """Shared fixture topology for an in-process cluster: slot-range
+    ownership + roles, rendered as CLUSTER NODES wire text.
+
+    The reference never CI-tests a real cluster (SURVEY §4 weak spot —
+    its cluster methods are @Test-disabled); this state object plus N
+    FakeRedisServers on one event loop is the in-process fake topology the
+    survey calls for. Mutations (move_slots, fail_over) take effect on
+    every node at once, like a settled cluster epoch.
+    """
+
+    MAX_SLOT = 16384
+
+    def __init__(self):
+        # addr -> {"id": str, "role": "master"|"slave", "master": addr|None}
+        self.nodes: Dict[str, Dict] = {}
+        # (start, end) inclusive -> master addr
+        self.ranges: List[Tuple[int, int, str]] = []
+
+    def add_master(self, addr: str, ranges: List[Tuple[int, int]]) -> None:
+        self.nodes[addr] = {"id": hashlib.sha1(addr.encode()).hexdigest(),
+                            "role": "master", "master": None}
+        for s, e in ranges:
+            self.ranges.append((s, e, addr))
+
+    def add_slave(self, addr: str, master_addr: str) -> None:
+        self.nodes[addr] = {"id": hashlib.sha1(addr.encode()).hexdigest(),
+                            "role": "slave", "master": master_addr}
+
+    def owner_of(self, slot: int) -> Optional[str]:
+        for s, e, addr in self.ranges:
+            if s <= slot <= e:
+                return addr
+        return None
+
+    def move_slots(self, start: int, end: int, new_owner: str) -> None:
+        """Live slot migration (ClusterConnectionManager.java:508-541): the
+        [start, end] range changes hands; every node redirects at once."""
+        out: List[Tuple[int, int, str]] = []
+        for s, e, addr in self.ranges:
+            if e < start or s > end:
+                out.append((s, e, addr))
+                continue
+            if s < start:
+                out.append((s, start - 1, addr))
+            if e > end:
+                out.append((end + 1, e, addr))
+        out.append((start, end, new_owner))
+        self.ranges = out
+
+    def fail_over(self, master_addr: str, slave_addr: str) -> None:
+        """Swap roles: the slave takes the master's ranges (the settled
+        state after a cluster failover; ClusterConnectionManager.java:
+        429-455 diffs exactly this)."""
+        self.ranges = [(s, e, slave_addr if a == master_addr else a)
+                       for s, e, a in self.ranges]
+        self.nodes[slave_addr]["role"] = "master"
+        self.nodes[slave_addr]["master"] = None
+        self.nodes[master_addr]["role"] = "slave"
+        self.nodes[master_addr]["master"] = slave_addr
+
+    def nodes_text(self, self_addr: Optional[str]) -> str:
+        """CLUSTER NODES format: `<id> <addr> <flags> <master-id|-> <ping>
+        <pong> <epoch> <state> [slots...]` per node."""
+        lines = []
+        for addr, n in self.nodes.items():
+            flags = n["role"]
+            if addr == self_addr:
+                flags = "myself," + flags
+            master_id = "-"
+            if n["master"] is not None:
+                master_id = self.nodes[n["master"]]["id"]
+            slots = ""
+            if n["role"] == "master":
+                parts = [f"{s}-{e}" if s != e else str(s)
+                         for s, e, a in sorted(self.ranges) if a == addr]
+                slots = " " + " ".join(parts) if parts else ""
+            lines.append(
+                f"{n['id']} {addr} {flags} {master_id} 0 0 1 connected{slots}")
+        return "\n".join(lines) + "\n"
+
+
+class ClusterFixture:
+    """N fake masters on one event loop, slots split evenly, shared
+    ClusterState — stop() tears all of them down."""
+
+    def __init__(self, n_masters: int = 3):
+        self.state = ClusterState()
+        self.embedded: List[EmbeddedRedis] = []
+        first = EmbeddedRedis()
+        self.embedded.append(first)
+        for _ in range(n_masters - 1):
+            self.embedded.append(EmbeddedRedis(share_with=first))
+        per = ClusterState.MAX_SLOT // n_masters
+        for i, er in enumerate(self.embedded):
+            start = i * per
+            end = (i + 1) * per - 1 if i < n_masters - 1 else ClusterState.MAX_SLOT - 1
+            addr = f"127.0.0.1:{er.port}"
+            self.state.add_master(addr, [(start, end)])
+            er.server.cluster_state = self.state
+            er.server.cluster_self = addr
+        self.addresses = [f"127.0.0.1:{er.port}" for er in self.embedded]
+
+    def server_for(self, addr: str) -> FakeRedisServer:
+        for er in self.embedded:
+            if f"127.0.0.1:{er.port}" == addr:
+                return er.server
+        raise KeyError(addr)
+
+    def add_replica(self, master_addr: str) -> str:
+        """Boot a replica of `master_addr`, register it in the topology."""
+        er = EmbeddedRedis(share_with=self.embedded[0])
+        self.embedded.append(er)
+        addr = f"127.0.0.1:{er.port}"
+        master = self.server_for(master_addr)
+        master.replicas.append(er.server)
+        er.server.replicating_from = master_addr
+        er.server.cluster_state = self.state
+        er.server.cluster_self = addr
+        self.state.add_slave(addr, master_addr)
+        self.addresses.append(addr)
+        return addr
+
+    def stop(self) -> None:
+        for er in reversed(self.embedded[1:]):
+            er.kill()
+        self.embedded[0].stop()
 
     def __enter__(self):
         return self

@@ -467,3 +467,188 @@ class RolePollingMonitor:
     def close(self) -> None:
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+def parse_cluster_nodes(text: str) -> List[Dict]:
+    """Parse CLUSTER NODES wire text into partitions:
+    [{"master": addr, "slaves": [addr...], "ranges": [(s, e)...]}].
+
+    Format per node line (`cluster/ClusterNodeInfo.java` fields):
+    `<id> <addr> <flags,csv> <master-id|-> <ping> <pong> <epoch> <state>
+    [slot | start-end | [importing/migrating annotations]]...`. Nodes
+    flagged fail/noaddr are skipped like the reference's FAIL filter
+    (`ClusterConnectionManager.java:581-587`).
+    """
+    masters: Dict[str, Dict] = {}   # node-id -> partition
+    slaves: List[Tuple[str, str]] = []  # (addr, master-id)
+    for line in text.strip().splitlines():
+        parts = line.split()
+        if len(parts) < 8:
+            continue
+        node_id, addr, flags = parts[0], parts[1], set(parts[2].split(","))
+        # cluster-enabled redis reports addr as ip:port@cport; strip @cport
+        addr = addr.split("@", 1)[0]
+        if {"fail", "noaddr", "handshake"} & flags:
+            continue
+        if "master" in flags:
+            ranges: List[Tuple[int, int]] = []
+            for tok in parts[8:]:
+                if tok.startswith("["):  # migrating/importing annotation
+                    continue
+                if "-" in tok:
+                    s, _, e = tok.partition("-")
+                    ranges.append((int(s), int(e)))
+                else:
+                    ranges.append((int(tok), int(tok)))
+            masters[node_id] = {"master": addr, "slaves": [], "ranges": ranges}
+        elif "slave" in flags and parts[3] != "-":
+            slaves.append((addr, parts[3]))
+    for addr, master_id in slaves:
+        if master_id in masters:
+            masters[master_id]["slaves"].append(addr)
+    return list(masters.values())
+
+
+class ClusterRouter(MasterSlaveRouter):
+    """Slot-table-first router for cluster topologies.
+
+    Where MasterSlaveRouter learns slot owners lazily from MOVED replies,
+    this router is seeded with the full 16384-slot table by the
+    ClusterTopologyManager (the reference routes every keyed command
+    through its slot->MasterSlaveEntry map, `MasterSlaveConnectionManager
+    .java:125` + `calcSlot`); MOVED replies still update single entries
+    between rescans. Keyed pipelines split per owner and reassemble in
+    submission order (`CommandBatchService.java:142-182` semantics).
+    """
+
+    def __init__(self, pool_factory: Callable[[str, int], Any],
+                 seed_addresses: Sequence[str]):
+        seeds = [_addr_key(a) for a in seed_addresses]
+        super().__init__(pool_factory, seeds[0], [], read_mode="MASTER")
+        self.seeds = seeds
+        self.topology_applied = 0
+
+    def apply_topology(self, partitions: List[Dict]) -> None:
+        """Install a freshly scanned topology (full slot table swap)."""
+        table: Dict[int, str] = {}
+        masters: List[str] = []
+        for p in partitions:
+            addr = _addr_key(p["master"])
+            masters.append(addr)
+            for s, e in p["ranges"]:
+                for slot in range(s, e + 1):
+                    table[slot] = addr
+        if not masters:
+            return
+        with self._lock:
+            self._slot_table = table
+            self._master = masters[0]
+            # Other masters join _slaves only as fallback endpoints for
+            # unkeyed reads; keyed routing always goes via the table.
+            self._slaves = masters[1:]
+            self.topology_applied += 1
+
+    def known_addresses(self) -> List[str]:
+        with self._lock:
+            return list({*self.seeds, self._master, *self._slaves,
+                         *self._slot_table.values()})
+
+    def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
+        """Split a keyed pipeline by slot owner; unkeyed commands ride with
+        the first group. Results return in submission order. Per-command
+        MOVED/ASK replies are resent individually to the right node — the
+        reference's batch redirect contract (`CommandBatchService.java:
+        184-293` clears errors and resends only unfinished commands)."""
+        groups: Dict[str, List[int]] = {}
+        for i, cmd in enumerate(commands):
+            addr = self._endpoint_for(cmd, write=True)
+            groups.setdefault(addr, []).append(i)
+        if len(groups) == 1:
+            # One owner: whole pipeline to THAT owner (not _master — the
+            # table already knows where these keys live).
+            out = list(self._run_on(next(iter(groups)), "pipeline", commands))
+        else:
+            out = [None] * len(commands)
+            for addr, idxs in groups.items():
+                replies = self._run_on(addr, "pipeline",
+                                       [commands[i] for i in idxs])
+                for i, r in zip(idxs, replies):
+                    out[i] = r
+        for i, r in enumerate(out):
+            if isinstance(r, RespError) and (
+                str(r).startswith("MOVED") or str(r).startswith("ASK")
+            ):
+                out[i] = self._maybe_redirect(r, tuple(commands[i]),
+                                              write=True, depth=0)
+        return out
+
+    def execute_blocking(self, *args, response_timeout: float) -> Any:
+        # Blocking pops are keyed: route to the key's owner.
+        addr = self._endpoint_for(args, write=True)
+        return self._run_on(addr, "execute_blocking", *args,
+                            response_timeout=response_timeout)
+
+
+class ClusterTopologyManager:
+    """The cluster control plane: bootstrap from CLUSTER NODES on any seed,
+    then re-scan on an interval and swap the router's slot table when the
+    topology diffs — failover, slot migration, node add/remove
+    (`cluster/ClusterConnectionManager.java:64-117` bootstrap, `:265-341`
+    scheduled check, `:429-541` diff handling)."""
+
+    def __init__(self, router: ClusterRouter, scan_interval_s: float = 0.0):
+        self.router = router
+        self.scan_interval_s = scan_interval_s
+        self.scans = 0
+        self.changes = 0
+        self._last: List[Dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def bootstrap(self) -> None:
+        last_exc: Optional[Exception] = None
+        for addr in self.router.seeds:
+            try:
+                self._scan_from(addr)
+                if self.scan_interval_s > 0:
+                    self._thread = threading.Thread(
+                        target=self._loop, name="rtpu-cluster-scan",
+                        daemon=True)
+                    self._thread.start()
+                return
+            except Exception as exc:  # noqa: BLE001 - try the next seed
+                last_exc = exc
+        raise ConnectionError(
+            f"no cluster seed answered CLUSTER NODES: {last_exc!r}")
+
+    def _scan_from(self, addr: str) -> None:
+        text = bytes(
+            self.router._pool(addr).execute("CLUSTER", "NODES")
+        ).decode("utf-8", "replace")
+        partitions = parse_cluster_nodes(text)
+        if not partitions:
+            raise ConnectionError(f"{addr} reported an empty topology")
+        key = sorted((p["master"], tuple(sorted(p["ranges"])),
+                      tuple(sorted(p["slaves"]))) for p in partitions)
+        old = sorted((p["master"], tuple(sorted(p["ranges"])),
+                      tuple(sorted(p["slaves"]))) for p in self._last)
+        if key != old:
+            self.router.apply_topology(partitions)
+            if self._last:
+                self.changes += 1
+            self._last = partitions
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.scan_interval_s):
+            self.scans += 1
+            for addr in self.router.known_addresses():
+                try:
+                    self._scan_from(addr)
+                    break
+                except Exception:  # noqa: BLE001 - rotate to the next node
+                    continue
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,263 @@
+"""Cluster-mode redis tier: CLUSTER NODES bootstrap, slot-table routing,
+topology rescan (failover + live slot migration), per-owner pipelines.
+
+Reference shapes: `cluster/ClusterConnectionManager.java:64-117` (bootstrap
+parse), `:265-341` (scheduled topology check), `:429-541` (failover / slot
+migration diffs), `:543-558` (CRC16 routing); parse format per
+`ClusterNodeInfo.java`. The reference never CI-tests a real cluster (its
+cluster tests are @Test-disabled, SURVEY §4) — these run against N
+in-process fake masters sharing a ClusterState.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.fake_server import ClusterFixture
+from redisson_tpu.interop.pool import RespConnectionPool
+from redisson_tpu.interop.topology_redis import (
+    ClusterRouter, ClusterTopologyManager, parse_cluster_nodes)
+from redisson_tpu.ops import crc16
+
+
+def _factory(host: str, port: int) -> RespConnectionPool:
+    return RespConnectionPool(
+        host=host, port=port, timeout=5.0, retry_attempts=2,
+        retry_interval=0.05, size=2, min_idle=1, failed_attempts=10,
+        reconnection_timeout=0.3)
+
+
+@pytest.fixture()
+def cluster():
+    with ClusterFixture(n_masters=3) as cf:
+        yield cf
+
+
+def _router(cf, scan_interval_s=0.0):
+    r = ClusterRouter(_factory, cf.addresses)
+    mgr = ClusterTopologyManager(r, scan_interval_s=scan_interval_s)
+    mgr.bootstrap()
+    return r, mgr
+
+
+def _key_for_slot_range(cf, addr):
+    """A key whose slot lands in `addr`'s range (probe k0, k1, ...)."""
+    for i in range(10000):
+        k = f"k{i}"
+        if cf.state.owner_of(crc16.key_slot(k)) == addr:
+            return k
+    raise AssertionError("no key found for range")
+
+
+def test_parse_cluster_nodes_reference_format():
+    text = (
+        "07c37dfeb235213a872192d90877d0cd55635b91 127.0.0.1:30004@31004 "
+        "slave e7d1eecce10fd6bb5eb35b9f99a514335d9ba9ca 0 1426238317239 4 connected\n"
+        "67ed2db8d677e59ec4a4cefb06858cf2a1a89fa1 127.0.0.1:30002 "
+        "master - 0 1426238316232 2 connected 5461-10922\n"
+        "e7d1eecce10fd6bb5eb35b9f99a514335d9ba9ca 127.0.0.1:30001 "
+        "myself,master - 0 0 1 connected 0-5460 15495 [15495->-importing]\n"
+        "6ec23923021cf3ffec47632106199cb7f496ce01 127.0.0.1:30005 "
+        "slave 67ed2db8d677e59ec4a4cefb06858cf2a1a89fa1 0 1426238316232 5 connected\n"
+        "dead0000000000000000000000000000deadbeef 127.0.0.1:30009 "
+        "master,fail - 0 1426238317741 9 connected 10923-16383\n"
+    )
+    parts = parse_cluster_nodes(text)
+    by_master = {p["master"]: p for p in parts}
+    assert set(by_master) == {"127.0.0.1:30001", "127.0.0.1:30002"}
+    assert by_master["127.0.0.1:30001"]["ranges"] == [(0, 5460), (15495, 15495)]
+    assert by_master["127.0.0.1:30001"]["slaves"] == ["127.0.0.1:30004"]
+    assert by_master["127.0.0.1:30002"]["slaves"] == ["127.0.0.1:30005"]
+
+
+def test_bootstrap_routes_by_slot_without_redirects(cluster):
+    router, mgr = _router(cluster)
+    try:
+        # One key per shard; each must land on its owner directly.
+        for addr in cluster.addresses:
+            k = _key_for_slot_range(cluster, addr)
+            router.execute("SET", k, f"v@{addr}")
+            assert cluster.server_for(addr).data.get(k.encode()) == \
+                f"v@{addr}".encode()
+        assert router.redirects == 0  # slot table made every hop direct
+        assert router.topology_applied == 1
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_moved_updates_between_scans(cluster):
+    router, mgr = _router(cluster)
+    try:
+        a0, a1 = cluster.addresses[0], cluster.addresses[1]
+        k = _key_for_slot_range(cluster, a0)
+        slot = crc16.key_slot(k)
+        router.execute("SET", k, "before")
+        #
+
+        # Migrate the slot; the stale table entry now draws a MOVED, which
+        # the router follows and caches (CommandAsyncService.java:657-685).
+        cluster.state.move_slots(slot, slot, a1)
+        router.execute("SET", k, "after")
+        assert router.redirects == 1
+        assert cluster.server_for(a1).data.get(k.encode()) == b"after"
+        # Cached: the next hit is direct.
+        router.execute("SET", k, "again")
+        assert router.redirects == 1
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_rescan_applies_slot_migration(cluster):
+    router, mgr = _router(cluster, scan_interval_s=0.05)
+    try:
+        a0, a2 = cluster.addresses[0], cluster.addresses[2]
+        k = _key_for_slot_range(cluster, a0)
+        slot = crc16.key_slot(k)
+        cluster.state.move_slots(slot, slot, a2)
+        deadline = time.time() + 5
+        while mgr.changes == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert mgr.changes >= 1, "rescan never observed the migration"
+        router.execute("SET", k, "v")
+        assert cluster.server_for(a2).data.get(k.encode()) == b"v"
+        assert router.redirects == 0  # learned from the scan, not a MOVED
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_rescan_follows_failover(cluster):
+    router, mgr = _router(cluster, scan_interval_s=0.05)
+    try:
+        a0 = cluster.addresses[0]
+        replica = cluster.add_replica(a0)
+        k = _key_for_slot_range(cluster, a0)
+        router.execute("SET", k, "v1")
+        assert cluster.server_for(replica).data.get(k.encode()) == b"v1"
+
+        cluster.state.fail_over(a0, replica)
+        deadline = time.time() + 5
+        while mgr.changes == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert mgr.changes >= 1
+        router.execute("SET", k, "v2")
+        assert cluster.server_for(replica).data.get(k.encode()) == b"v2"
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_pipeline_splits_per_owner(cluster):
+    router, mgr = _router(cluster)
+    try:
+        keys = [_key_for_slot_range(cluster, a) for a in cluster.addresses]
+        cmds = [("SET", k, f"pv{i}") for i, k in enumerate(keys)]
+        cmds.append(("GET", keys[0]))
+        out = router.pipeline(cmds)
+        assert out[3] == b"pv0"  # reassembled in submission order
+        for i, (k, addr) in enumerate(zip(keys, cluster.addresses)):
+            assert cluster.server_for(addr).data.get(k.encode()) == \
+                f"pv{i}".encode()
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_client_end_to_end_over_cluster(cluster):
+    cfg = Config()
+    r = cfg.use_redis()
+    r.cluster_addresses = list(cluster.addresses)
+    r.cluster_scan_interval_ms = 0  # bootstrap only
+    c = RedissonTPU.create(cfg)
+    try:
+        # Buckets hash across all three shards; everything must route.
+        for i in range(30):
+            c.get_bucket(f"cb:{i}").set({"i": i})
+        for i in range(30):
+            assert c.get_bucket(f"cb:{i}").get() == {"i": i}
+        # Data actually spread over the shards (not all on one node).
+        counts = [len(cluster.server_for(a).data) for a in cluster.addresses]
+        assert sum(1 for n in counts if n > 0) >= 2, counts
+        # A structure object with Lua-free ops works cross-slot too.
+        al = c.get_atomic_long("cb:ctr")
+        assert al.increment_and_get() == 1
+    finally:
+        c.shutdown()
+
+
+def test_bootstrap_survives_dead_seed(cluster):
+    dead = "127.0.0.1:1"  # nothing listens there
+    router = ClusterRouter(_factory, [dead] + list(cluster.addresses))
+    mgr = ClusterTopologyManager(router)
+    try:
+        mgr.bootstrap()  # rotates past the dead seed
+        assert router.topology_applied == 1
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_pipeline_per_command_moved_is_resent(cluster):
+    """A stale slot-table entry surfaces as a per-command MOVED inside a
+    pipeline reply; the router must resend that command to the owner
+    (CommandBatchService.java:184-293) instead of raising it to the caller."""
+    router, mgr = _router(cluster)
+    try:
+        a0, a1 = cluster.addresses[0], cluster.addresses[1]
+        k = _key_for_slot_range(cluster, a0)
+        slot = crc16.key_slot(k)
+        cluster.state.move_slots(slot, slot, a1)  # table now stale
+        out = router.pipeline([("SET", k, "pv"), ("GET", k)])
+        assert out[0] == b"OK" or out[0] is True or out[0] == "OK", out
+        assert out[1] == b"pv"
+        assert cluster.server_for(a1).data.get(k.encode()) == b"pv"
+        assert router.redirects >= 1
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_single_owner_pipeline_goes_direct(cluster):
+    """A one-owner pipeline must hit that owner, not masters[0] — sending
+    it to the wrong master turns every command into a MOVED resend."""
+    router, mgr = _router(cluster)
+    try:
+        addr = cluster.addresses[2]
+        k = _key_for_slot_range(cluster, addr)
+        out = router.pipeline([("SET", k, "a"), ("APPEND", k, "b"),
+                               ("GET", k)])
+        assert out[2] == b"ab"
+        assert router.redirects == 0
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_create_against_non_cluster_does_not_leak(cluster):
+    import threading
+
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+    with EmbeddedRedis() as plain:  # CLUSTER support disabled on this one
+        cfg = Config()
+        r = cfg.use_redis()
+        r.cluster_addresses = [f"127.0.0.1:{plain.port}"]
+        before = {t.name for t in threading.enumerate()}
+        with pytest.raises(Exception):
+            RedissonTPU.create(cfg)
+        import time as _t
+
+        deadline = _t.time() + 3
+        while _t.time() < deadline:
+            leaked = {t.name for t in threading.enumerate()} - before
+            if not any("pool" in n or "cluster" in n for n in leaked):
+                break
+            _t.sleep(0.05)
+        leaked = {t.name for t in threading.enumerate()} - before
+        assert not any("pool" in n or "cluster" in n for n in leaked), leaked
