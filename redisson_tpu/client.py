@@ -168,21 +168,27 @@ class RedissonTPU:
                     host=host, port=port, password=rcfg.password,
                     timeout=rcfg.timeout_ms / 1000.0)
 
+            from redisson_tpu.interop.topology_redis import make_balancer
+
             return SentinelManager(
                 factory, rcfg.sentinel_addresses, rcfg.master_name,
                 read_mode=rcfg.read_mode, pubsub_factory=pubsub_factory,
                 timeout=rcfg.timeout_ms / 1000.0,
                 sentinel_password=rcfg.password,
+                balancer=make_balancer(rcfg.load_balancer, rcfg.slave_weights,
+                                       rcfg.default_slave_weight),
             )
         if rcfg.slave_addresses:
             from redisson_tpu.interop.topology_redis import (
-                MasterSlaveRouter, RolePollingMonitor)
+                MasterSlaveRouter, RolePollingMonitor, make_balancer)
 
             router = MasterSlaveRouter(
                 factory,
                 f"{u.hostname or '127.0.0.1'}:{u.port or 6379}",
                 rcfg.slave_addresses,
                 read_mode=rcfg.read_mode,
+                balancer=make_balancer(rcfg.load_balancer, rcfg.slave_weights,
+                                       rcfg.default_slave_weight),
             )
             if rcfg.role_scan_interval_ms > 0:
                 self._role_monitor = RolePollingMonitor(

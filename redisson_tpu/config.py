@@ -64,6 +64,12 @@ class RedisConfig:
     # (SLAVE | MASTER | MASTER_SLAVE). Empty = single endpoint.
     slave_addresses: List[str] = dataclasses.field(default_factory=list)
     read_mode: str = "SLAVE"
+    # Slave read balancing (reference `connection/balancer/`):
+    # "round_robin" | "random" | "weighted" (weighted uses slave_weights,
+    # address -> weight, with default_slave_weight for unlisted addresses).
+    load_balancer: str = "round_robin"
+    slave_weights: Dict[str, int] = dataclasses.field(default_factory=dict)
+    default_slave_weight: int = 1
     # Cluster mode (ClusterServersConfig): bootstrap the slot topology with
     # CLUSTER NODES from any of these seeds, route keyed commands by CRC16
     # slot, and re-scan every cluster_scan_interval_ms (the reference's

@@ -60,6 +60,61 @@ UNKEYED_COMMANDS = frozenset({
 })
 
 
+class RoundRobinBalancer:
+    """Cycle through live slaves (`RoundRobinLoadBalancer.java`)."""
+
+    def __init__(self):
+        self._i = 0
+
+    def choose(self, live: List[str]) -> str:
+        self._i += 1
+        return live[self._i % len(live)]
+
+
+class RandomBalancer:
+    """Uniform random choice (`RandomLoadBalancer.java`)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        import random
+
+        self._rng = random.Random(seed)
+
+    def choose(self, live: List[str]) -> str:
+        return self._rng.choice(live)
+
+
+class WeightedRoundRobinBalancer:
+    """Weighted rotation (`WeightedRoundRobinBalancer.java`): each address
+    appears `weights.get(addr, default_weight)` times per cycle. Weight
+    keys accept any address form the config does ('redis://h:p', 'h:p') —
+    normalized here so a weight can never be silently ignored."""
+
+    def __init__(self, weights: Dict[str, int], default_weight: int = 1):
+        self.weights = {_addr_key(k): max(1, int(v))
+                        for k, v in weights.items()}
+        self.default_weight = max(1, int(default_weight))
+        self._i = 0
+
+    def choose(self, live: List[str]) -> str:
+        wheel: List[str] = []
+        for a in live:
+            wheel.extend([a] * self.weights.get(a, self.default_weight))
+        self._i += 1
+        return wheel[self._i % len(wheel)]
+
+
+def make_balancer(spec: str, weights: Optional[Dict[str, int]] = None,
+                  default_weight: int = 1):
+    """'round_robin' | 'random' | 'weighted' -> balancer instance."""
+    if spec == "round_robin":
+        return RoundRobinBalancer()
+    if spec == "random":
+        return RandomBalancer()
+    if spec == "weighted":
+        return WeightedRoundRobinBalancer(weights or {}, default_weight)
+    raise ValueError(f"unknown load balancer {spec!r}")
+
+
 def _addr_key(addr: str) -> str:
     """Normalize 'redis://h[:p]' / 'h[:p]' to 'h:p' (default port 6379)."""
     a = addr
@@ -88,14 +143,15 @@ class MasterSlaveRouter:
     def __init__(self, pool_factory: Callable[[str, int], Any],
                  master_address: str,
                  slave_addresses: Sequence[str] = (),
-                 read_mode: str = "SLAVE"):
+                 read_mode: str = "SLAVE",
+                 balancer=None):
         self._factory = pool_factory
         self._lock = threading.Lock()
         self._pools: Dict[str, Any] = {}  # "host:port" -> pool
         self._master = _addr_key(master_address)
         self._slaves: List[str] = [_addr_key(a) for a in slave_addresses]
         self.read_mode = read_mode.upper()
-        self._rr = 0
+        self.balancer = balancer if balancer is not None else RoundRobinBalancer()
         self._slot_table: Dict[int, str] = {}  # slot -> "host:port" (MOVED)
         self.promotions = 0  # observability: master changes
         self.redirects = 0   # observability: MOVED/ASK followed
@@ -108,7 +164,18 @@ class MasterSlaveRouter:
             if p is None:
                 host, _, port = addr.rpartition(":")
                 p = self._factory(host, int(port))
-                p.connect()
+                try:
+                    p.connect()
+                except Exception:
+                    # Reclaim the pool's IO thread NOW: an unregistered
+                    # pool is unreachable from close(), and topology scan
+                    # loops re-dial dead seeds every interval — leaking a
+                    # thread per scan otherwise.
+                    try:
+                        p.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    raise
                 self._pools[addr] = p
             return p
 
@@ -160,8 +227,7 @@ class MasterSlaveRouter:
         live = [a for a in candidates if not self._frozen(a)]
         if not live:
             return self._master
-        self._rr += 1
-        return live[self._rr % len(live)]
+        return self.balancer.choose(live)
 
     def _frozen(self, addr: str) -> bool:
         p = self._pools.get(addr)
@@ -297,7 +363,8 @@ class SentinelManager:
     def __init__(self, pool_factory, sentinel_addresses: Sequence[str],
                  master_name: str, read_mode: str = "SLAVE",
                  pubsub_factory=None, timeout: float = 3.0,
-                 sentinel_password: Optional[str] = None):
+                 sentinel_password: Optional[str] = None,
+                 balancer=None):
         from redisson_tpu.interop.resp_client import SyncRespClient
 
         self.master_name = master_name
@@ -340,7 +407,8 @@ class SentinelManager:
                 f"no sentinel answered for master '{master_name}' "
                 f"({errors[:1]!r})")
         self.router = MasterSlaveRouter(
-            pool_factory, master, slaves, read_mode=read_mode)
+            pool_factory, master, slaves, read_mode=read_mode,
+            balancer=balancer)
 
     def connect(self) -> None:
         self.router.connect()
@@ -563,23 +631,37 @@ class ClusterRouter(MasterSlaveRouter):
         for i, cmd in enumerate(commands):
             addr = self._endpoint_for(cmd, write=True)
             groups.setdefault(addr, []).append(i)
-        if len(groups) == 1:
-            # One owner: whole pipeline to THAT owner (not _master — the
-            # table already knows where these keys live).
-            out = list(self._run_on(next(iter(groups)), "pipeline", commands))
-        else:
-            out = [None] * len(commands)
-            for addr, idxs in groups.items():
-                replies = self._run_on(addr, "pipeline",
-                                       [commands[i] for i in idxs])
-                for i, r in zip(idxs, replies):
-                    out[i] = r
+        out: List[Any] = [None] * len(commands)
+        for addr, idxs in groups.items():
+            cmds = [commands[i] for i in idxs]
+            try:
+                replies = self._run_on(addr, "pipeline", cmds)
+            except (ConnectionError, OSError, TimeoutError):
+                # One blip must not void the other groups' (possibly
+                # already-applied) results: re-resolve the owner once (the
+                # freeze/rescan may have re-pointed it) and retry; a second
+                # failure lands per-command RespErrors in the reply list,
+                # keeping the pipeline contract of in-list errors.
+                try:
+                    retry_addr = self._endpoint_for(cmds[0], write=True)
+                    replies = self._run_on(retry_addr, "pipeline", cmds)
+                except Exception as exc:  # noqa: BLE001
+                    replies = [RespError(f"CONNECTIONFAIL {addr}: {exc}")
+                               for _ in cmds]
+            for i, r in zip(idxs, replies):
+                out[i] = r
         for i, r in enumerate(out):
             if isinstance(r, RespError) and (
                 str(r).startswith("MOVED") or str(r).startswith("ASK")
             ):
-                out[i] = self._maybe_redirect(r, tuple(commands[i]),
-                                              write=True, depth=0)
+                # A genuine error from the redirected resend stays in the
+                # reply list (same contract as untouched replies) — raising
+                # here would discard every other command's result.
+                try:
+                    out[i] = self._maybe_redirect(r, tuple(commands[i]),
+                                                  write=True, depth=0)
+                except RespError as exc:
+                    out[i] = exc
         return out
 
     def execute_blocking(self, *args, response_timeout: float) -> Any:

@@ -97,8 +97,6 @@ def test_moved_updates_between_scans(cluster):
         k = _key_for_slot_range(cluster, a0)
         slot = crc16.key_slot(k)
         router.execute("SET", k, "before")
-        #
-
         # Migrate the slot; the stale table entry now draws a MOVED, which
         # the router follows and caches (CommandAsyncService.java:657-685).
         cluster.state.move_slots(slot, slot, a1)
@@ -261,3 +259,54 @@ def test_create_against_non_cluster_does_not_leak(cluster):
             _t.sleep(0.05)
         leaked = {t.name for t in threading.enumerate()} - before
         assert not any("pool" in n or "cluster" in n for n in leaked), leaked
+
+
+def test_pipeline_redirected_command_error_stays_in_reply_list(cluster):
+    """A MOVED resend that then fails with a genuine error (WRONGTYPE) must
+    land in the reply list, not raise away the other commands' results."""
+    from redisson_tpu.native import RespError
+
+    router, mgr = _router(cluster)
+    try:
+        a0, a1 = cluster.addresses[0], cluster.addresses[1]
+        k = _key_for_slot_range(cluster, a0)
+        slot = crc16.key_slot(k)
+        router.execute("SET", k, "str")       # k holds a string on a0
+        cluster.state.move_slots(slot, slot, a1)
+        router.execute("SET", k, "str")       # follow MOVED; now on a1 too
+        # Stale-table pipeline: LPUSH draws MOVED, resend hits WRONGTYPE.
+        k2 = _key_for_slot_range(cluster, a1)
+        cluster.state.move_slots(crc16.key_slot(k2), crc16.key_slot(k2), a0)
+        out = router.pipeline([("SET", k2, "x"), ("LPUSH", k, "v")])
+        assert not isinstance(out[0], RespError), out
+        assert isinstance(out[1], RespError)
+        assert "WRONGTYPE" in str(out[1]).upper() or "wrong" in str(out[1]).lower()
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_failed_seed_dial_does_not_leak_pool_thread(cluster):
+    import threading
+
+    router = ClusterRouter(_factory, ["127.0.0.1:1"] + list(cluster.addresses))
+    mgr = ClusterTopologyManager(router)
+    try:
+        before = {t for t in threading.enumerate()}
+        mgr.bootstrap()  # dials the dead seed first; must reclaim its pool
+        time.sleep(0.2)
+        leaked = [t.name for t in set(threading.enumerate()) - before
+                  if "pool" in t.name.lower()]
+        # exactly the live pools' threads may exist; the dead seed's not
+        assert len(leaked) <= len(cluster.addresses) + 1, leaked
+    finally:
+        mgr.close()
+        router.close()
+
+
+def test_weighted_balancer_normalizes_url_forms():
+    from redisson_tpu.interop.topology_redis import WeightedRoundRobinBalancer
+
+    b = WeightedRoundRobinBalancer({"redis://h1:6379": 3}, 1)
+    picks = [b.choose(["h1:6379", "h2:6379"]) for _ in range(40)]
+    assert picks.count("h1:6379") == 30

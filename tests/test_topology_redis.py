@@ -376,3 +376,51 @@ def test_role_polling_detects_external_promotion(pair):
     finally:
         mon.close()
         router.close()
+
+
+def test_balancer_strategies_distribute_reads(pair):
+    """Random / weighted balancers (reference connection/balancer/): reads
+    distribute per strategy across two slaves of one master."""
+    from redisson_tpu.interop.topology_redis import (
+        RandomBalancer, WeightedRoundRobinBalancer, make_balancer)
+
+    master, s1 = pair
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+    s2 = EmbeddedRedis(share_with=master)
+    try:
+        master.server.replicas.append(s2.server)
+        s2.server.replicating_from = f"127.0.0.1:{master.port}"
+        slaves = [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"]
+
+        # weighted 3:1 — the heavier slave serves ~3x the reads
+        router = MasterSlaveRouter(
+            _patient_factory, f"127.0.0.1:{master.port}", slaves,
+            read_mode="SLAVE",
+            balancer=WeightedRoundRobinBalancer({slaves[0]: 3}, 1))
+        router.connect()
+        try:
+            router.execute("SET", "bk", "v")
+            picks = [router._endpoint_for(("GET", "bk"), write=False)
+                     for _ in range(40)]
+            assert picks.count(slaves[0]) == 30
+            assert picks.count(slaves[1]) == 10
+        finally:
+            router.close()
+
+        # random — both slaves picked eventually
+        router = MasterSlaveRouter(
+            _patient_factory, f"127.0.0.1:{master.port}", slaves,
+            read_mode="SLAVE", balancer=RandomBalancer(seed=7))
+        router.connect()
+        try:
+            picks = {router._endpoint_for(("GET", "bk"), write=False)
+                     for _ in range(60)}
+            assert picks == set(slaves)
+        finally:
+            router.close()
+
+        with pytest.raises(ValueError):
+            make_balancer("bogus")
+    finally:
+        s2.kill()
