@@ -665,8 +665,11 @@ class TpuBackend:
         RedissonBloomFilter.java:80-114)."""
         for op in ops:
             n, p = op.payload["expected_insertions"], op.payload["false_probability"]
+            blocked = bool(op.payload.get("blocked"))
             m = bloom_ops.optimal_num_of_bits(n, p)
             k = bloom_ops.optimal_num_of_hash_functions(n, m)
+            if blocked:
+                m = bloom_ops.blocked_geometry(m)
             bloom_ops.check_size(m)
             existing = self.store.get(target, ObjectType.BLOOM)
             if existing is not None:
@@ -681,6 +684,7 @@ class TpuBackend:
                     "hash_iterations": k,
                     "expected_insertions": n,
                     "false_probability": p,
+                    "blocked": blocked,
                 },
             )
             op.future.set_result(True)
@@ -697,6 +701,8 @@ class TpuBackend:
         _segments (order-preserving concat) and chunk like the hll path,
         byte runs coalesce through _coalesce_bytes."""
         obj, m, k = self._bloom_meta(target)
+        add_packed, contains_packed, add_bytes, contains_bytes = (
+            self._bloom_kernels(obj))
         outs, spans = [], []
 
         def emit(res, n):
@@ -713,26 +719,30 @@ class TpuBackend:
                 ):
                     for s, e in engine.chunk_spans(packed.shape[0]):
                         rows, count = engine.pad_rows(packed[s:e])
-                        if mutate:
-                            res = engine.bloom_add_packed(
-                                obj.state, rows, np.int32(count), k, m, self.seed)
-                        else:
-                            res = engine.bloom_contains_packed(
-                                obj.state, rows, np.int32(count), k, m, self.seed)
-                        emit(res, e - s)
+                        fn = add_packed if mutate else contains_packed
+                        emit(fn(obj.state, rows, np.int32(count),
+                                k, m, self.seed), e - s)
             else:
                 data, lengths, _ = self._coalesce_bytes(group)
                 for s, e in engine.chunk_spans(data.shape[0]):
                     pdata, plengths, valid = engine.pad_bytes(
                         data[s:e], lengths[s:e])
-                    if mutate:
-                        res = engine.bloom_add_bytes(
-                            obj.state, pdata, plengths, valid, k, m, self.seed)
-                    else:
-                        res = engine.bloom_contains_bytes(
-                            obj.state, pdata, plengths, valid, k, m, self.seed)
-                    emit(res, e - s)
+                    fn = add_bytes if mutate else contains_bytes
+                    emit(fn(obj.state, pdata, plengths, valid,
+                            k, m, self.seed), e - s)
         self.completer.submit(self._slice_results(ops, outs, spans))
+
+    @staticmethod
+    def _bloom_kernels(obj):
+        """Kernel set per filter layout (classic vs blocked, see
+        ops/bloom.py BLOCK_BITS)."""
+        if obj.meta.get("blocked"):
+            return (engine.blocked_bloom_add_packed,
+                    engine.blocked_bloom_contains_packed,
+                    engine.blocked_bloom_add_bytes,
+                    engine.blocked_bloom_contains_bytes)
+        return (engine.bloom_add_packed, engine.bloom_contains_packed,
+                engine.bloom_add_bytes, engine.bloom_contains_bytes)
 
     def _op_bloom_add(self, target: str, ops: List[Op]) -> None:
         self._bloom_run(target, ops, mutate=True)
@@ -744,6 +754,9 @@ class TpuBackend:
         """Hit count per op (host-packed or device-resident keys): chunks
         reduce on device, one int32 scalar rides back per op."""
         obj, m, k = self._bloom_meta(target)
+        count_fn = (engine.blocked_bloom_contains_count_packed
+                    if obj.meta.get("blocked")
+                    else engine.bloom_contains_count_packed)
         for op in ops:
             parts = []
             if "device_packed" in op.payload:
@@ -754,13 +767,13 @@ class TpuBackend:
                     b = engine.bucket_size(n)
                     if n != b:
                         chunk = jnp.zeros((b, 2), jnp.uint32).at[:n].set(chunk)
-                    parts.append(engine.bloom_contains_count_packed(
+                    parts.append(count_fn(
                         obj.state, chunk, np.int32(n), k, m, self.seed))
             else:
                 packed = op.payload["packed"]
                 for s, e in engine.chunk_spans(packed.shape[0]):
                     rows, count = engine.pad_rows(packed[s:e])
-                    parts.append(engine.bloom_contains_count_packed(
+                    parts.append(count_fn(
                         obj.state, rows, np.int32(count), k, m, self.seed))
             total = _start_d2h(functools.reduce(jnp.add, parts)) if parts else 0
             self.completer.submit(

@@ -543,7 +543,43 @@ class RedissonTPU:
 
         return RScript(self._executor)
 
+    # -- bucket batch helpers (RedissonClient.java:174-192) -----------------
+
+    def find_buckets(self, pattern: str):
+        """Buckets whose names match the glob (reference findBuckets)."""
+        return [self.get_bucket(n)
+                for n in self.get_keys().get_keys_by_pattern(pattern)]
+
+    def load_bucket_values(self, *keys):
+        """name -> decoded value for existing keys (loadBucketValues);
+        accepts names varargs or one iterable, like the reference's two
+        overloads."""
+        if len(keys) == 1 and not isinstance(keys[0], str):
+            keys = tuple(keys[0])
+        return self.get_buckets().get(*keys)
+
+    def save_buckets(self, values) -> None:
+        """Atomic multi-bucket MSET (saveBuckets)."""
+        self.get_buckets().set(dict(values))
+
+    # -- lifecycle / config introspection -----------------------------------
+
+    def get_config(self) -> Config:
+        """The live Config (reference getConfig)."""
+        return self.config
+
+    def is_shutdown(self) -> bool:
+        return bool(getattr(self, "_is_shutdown", False))
+
+    def is_shutting_down(self) -> bool:
+        return bool(getattr(self, "_is_shutting_down", False))
+
     # -- observability ------------------------------------------------------
+
+    def get_cluster_nodes_group(self):
+        """Cluster-scoped health surface (reference getClusterNodesGroup);
+        same node set — topology-specific nodes carry their role."""
+        return self.get_nodes_group()
 
     def get_nodes_group(self):
         """Health/ping surface over compute devices + the redis tier
@@ -600,6 +636,16 @@ class RedissonTPU:
     # -- lifecycle ----------------------------------------------------------
 
     def shutdown(self):
+        self._is_shutting_down = True
+        try:
+            self._shutdown_inner()
+        finally:
+            # Flags must flip even when a teardown step raises — a client
+            # permanently reporting "shutting down" would wedge callers.
+            self._is_shutting_down = False
+            self._is_shutdown = True
+
+    def _shutdown_inner(self):
         for rs in self._remote_services.values():
             try:
                 rs.shutdown(wait=False)

@@ -295,6 +295,65 @@ def bloom_contains_packed(bits, packed, count, k: int, m: int, seed: int = 0):
     return _bloom_contains(bits, h1, h2, valid, k, m)
 
 
+# -- blocked bloom (ops/bloom.py BLOCK_BITS docstring) ----------------------
+
+
+def _blocked_add(bits, h1, h2, valid, k: int, m: int):
+    block, pos = bloom.blocked_indexes(h1, h2, k, m)
+    idx = bloom.blocked_absolute(block, pos)
+    idx = jnp.where(valid[:, None], idx, 0)
+    # Same masking as classic _bloom_add: padded lanes write index 0 with
+    # VALUE 0 (an unmasked max(1) would spuriously set absolute bit 0).
+    old = bits[idx.reshape(-1)].reshape(idx.shape)
+    vals = jnp.broadcast_to(valid[:, None], idx.shape)
+    new_bits = bits.at[idx.reshape(-1)].max(vals.astype(jnp.uint8).reshape(-1))
+    added = jnp.any(old == 0, axis=-1) & valid
+    return new_bits, added
+
+
+def _blocked_contains(bits, h1, h2, valid, k: int, m: int):
+    block, pos = bloom.blocked_indexes(h1, h2, k, m)
+    block = jnp.where(valid, block, 0)
+    return bloom.blocked_contains(bits, block, pos) & valid
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+)
+def blocked_bloom_add_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    return _blocked_add(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def blocked_bloom_contains_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    return _blocked_contains(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def blocked_bloom_contains_count_packed(bits, packed, count, k: int, m: int,
+                                        seed: int = 0):
+    h1, h2, valid = _packed_hashes(packed, count, seed)
+    res = _blocked_contains(bits, h1, h2, valid, k, m)
+    return jnp.sum(res.astype(jnp.int32))
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+)
+def blocked_bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    return _blocked_add(bits, h1, h2, valid, k, m)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
+def blocked_bloom_contains_bytes(bits, data, lengths, valid, k: int, m: int,
+                                 seed: int = 0):
+    h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
+    return _blocked_contains(bits, h1, h2, valid, k, m)
+
+
 @functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
 def bloom_contains_count_packed(bits, packed, count, k: int, m: int, seed: int = 0):
     """Membership COUNT of a packed batch — a server-side reduce in the

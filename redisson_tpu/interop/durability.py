@@ -90,6 +90,11 @@ class DurabilityManager:
                                 ("false_probability", "falseProbability")):
                 if field in meta:
                     cfg += [wire, str(meta[field])]
+            if meta.get("blocked"):
+                # Layout flag (no reference analogue): without it a reload
+                # would run classic index derivation over blocked-layout
+                # bits -> false negatives.
+                cfg += ["blocked", "1"]
             cmds = [["SET", key, packed.tobytes()]]
             if len(cfg) > 2:
                 cmds.append(cfg)
@@ -201,6 +206,8 @@ class DurabilityManager:
             if f in wire_to_meta:
                 meta[wire_to_meta[f]] = (
                     float(v) if f == "falseProbability" else int(v))
+            elif f == "blocked":
+                meta["blocked"] = v in ("1", "true", "True")
         bits = np.unpackbits(np.frombuffer(bytes(raw), np.uint8))
         size = int(meta.get("size", bits.size))
         out = np.zeros(size, np.uint8)

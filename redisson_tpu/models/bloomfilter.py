@@ -19,9 +19,16 @@ from redisson_tpu.models.object import RObject, pack_u64
 
 
 class RBloomFilter(RObject):
-    def try_init(self, expected_insertions: int, false_probability: float) -> bool:
+    def try_init(self, expected_insertions: int, false_probability: float,
+                 blocked: bool = False) -> bool:
         """Size + create; False if the filter already exists
-        (reference tryInit contract)."""
+        (reference tryInit contract).
+
+        blocked=True lays all k bits of a key inside one 512-bit block
+        (ops/bloom.py BLOCK_BITS): membership runs ~1.5x faster on TPU
+        (one row gather instead of k scattered gathers) for a slightly
+        higher effective FPR at the same sizing. TPU/local tiers only.
+        """
         if not 0 < false_probability < 1:
             raise ValueError("false_probability must be in (0, 1)")
         return self._executor.execute_sync(
@@ -30,8 +37,15 @@ class RBloomFilter(RObject):
             {
                 "expected_insertions": int(expected_insertions),
                 "false_probability": float(false_probability),
+                "blocked": bool(blocked),
             },
         )
+
+    def is_blocked(self) -> bool:
+        """Whether this filter uses the blocked (cache-line) layout.
+        Filters from checkpoints that predate the layout flag are classic."""
+        obj = self._executor.execute_sync(self.name, "bloom_meta", None)
+        return bool(obj.get("blocked"))
 
     # -- mutation -----------------------------------------------------------
 

@@ -151,3 +151,63 @@ def count_estimate(bit_count, size: int, hash_iterations: int):
     x = jnp.asarray(bit_count, jnp.float32)
     frac = jnp.clip(x / size, 0.0, 1.0 - 1e-7)
     return -(size / hash_iterations) * jnp.log1p(-frac)
+
+
+# ---------------------------------------------------------------------------
+# Blocked (cache-line) variant — TPU gather-friendly membership
+# ---------------------------------------------------------------------------
+
+# All k bits of one key live inside a single 512-bit block, so membership
+# needs ONE row gather per key instead of k scattered element gathers.
+# XLA lowers random 1-D gathers on TPU near-serially; with hashing and
+# index derivation included, blocked membership measures ~17 M keys/s vs
+# ~12 M for the classic layout on v5e (1.5x; the row gather itself is
+# ~2.5x faster, diluted by the shared hash/select work). Cost: slightly
+# higher FPR (bits concentrate per block; the 512-bit block keeps the
+# penalty small — Putze et al., "Cache-, Hash- and Space-Efficient Bloom
+# Filters").
+BLOCK_BITS = 512
+
+
+def blocked_geometry(m: int) -> int:
+    """Round a sizing-formula bit count up to whole blocks."""
+    return ((m + BLOCK_BITS - 1) // BLOCK_BITS) * BLOCK_BITS
+
+
+def blocked_indexes(h1: U64, h2: U64, k: int, m: int):
+    """[N] hash pairs -> (block [N] int32, pos [N, k] int32).
+
+    block = h1 mod nblocks; in-block walk pos_i = (h2.lo + i*step) mod 512
+    with an odd step from h1's high half (odd steps are units mod 2^9, so
+    the k positions are distinct for k <= 512).
+    """
+    nblocks = m // BLOCK_BITS
+    if nblocks < 1 or m % BLOCK_BITS:
+        raise ValueError(f"blocked filter size must be a multiple of {BLOCK_BITS}")
+    block = _mod_u64(h1, nblocks).astype(jnp.int32)
+    step = (h1.hi | jnp.uint32(1)).astype(jnp.uint32)
+    i = jnp.arange(k, dtype=jnp.uint32)
+    pos = (h2.lo[..., None] + i * step[..., None]) & jnp.uint32(BLOCK_BITS - 1)
+    return block, pos.astype(jnp.int32)
+
+
+def blocked_absolute(block: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
+    """(block, in-block positions) -> absolute [N, k] bit indexes."""
+    return block[..., None] * BLOCK_BITS + pos
+
+
+def blocked_contains(bits: jnp.ndarray, block: jnp.ndarray, pos: jnp.ndarray):
+    """[m] u8 cells + per-key (block, pos) -> [N] membership.
+
+    One row gather per key, then a two-level one-hot select (4 groups x
+    128 lanes) — the formulation XLA vectorizes, unlike take_along_axis.
+    """
+    rows = bits.reshape(-1, BLOCK_BITS)[block]          # [n, 512]
+    n = rows.shape[0]
+    r3 = rows.reshape(n, 4, 128).astype(jnp.int32)
+    g, l = pos // 128, pos % 128                         # [n, k]
+    og = (jnp.arange(4, dtype=jnp.int32)[None, None, :] == g[..., None])
+    grp = jnp.einsum("nkg,ngl->nkl", og.astype(jnp.int32), r3)
+    ol = (jnp.arange(128, dtype=jnp.int32)[None, None, :] == l[..., None])
+    got = jnp.sum(grp * ol, -1)                          # [n, k] 0/1
+    return jnp.min(got, axis=-1) > 0

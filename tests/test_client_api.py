@@ -356,3 +356,22 @@ class TestHostfoldIngest:
         n = backend_tpu.HOSTFOLD_MIN_KEYS + 5
         h.add_all([f"k{i}" for i in range(n)])
         assert abs(h.count() - n) / n < 0.03
+
+
+def test_bucket_batch_helpers_and_lifecycle():
+    """findBuckets / loadBucketValues / saveBuckets / getConfig /
+    isShutdown facade parity (RedissonClient.java:174-192, 686, 708-715)."""
+    c = RedissonTPU.create()
+    try:
+        c.save_buckets({"fb:a": 1, "fb:b": 2, "other": 3})
+        assert {b.name for b in c.find_buckets("fb:*")} == {"fb:a", "fb:b"}
+        assert c.load_bucket_values("fb:a", "fb:b") == {"fb:a": 1, "fb:b": 2}
+        assert c.load_bucket_values(["fb:a", "missing"]) == {"fb:a": 1}
+        assert c.get_config() is c.config
+        assert c.get_cluster_nodes_group() is not None
+        assert not c.is_shutdown()
+        assert not c.is_shutting_down()
+    finally:
+        c.shutdown()
+    assert c.is_shutdown()
+    assert not c.is_shutting_down()

@@ -495,3 +495,35 @@ def test_checkpoint_gated_in_redis_mode(tmp_path):
                 client.load_checkpoint(str(tmp_path / "cp"))
         finally:
             client.shutdown()
+
+
+def test_durability_blocked_bloom_roundtrip(local_client):
+    """The blocked-layout flag must survive a flush/reload cycle — without
+    it, classic index derivation over blocked-layout bits would produce
+    false negatives (review r3)."""
+    bf = local_client.get_bloom_filter("d:bblock")
+    bf.try_init(expected_insertions=5000, false_probability=0.01, blocked=True)
+    bf.add_all([b"bk%d" % i for i in range(2000)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            dm.flush(["d:bblock"])
+            local_client._store.delete("d:bblock")
+            assert dm.load_bloom("d:bblock")
+            bf2 = local_client.get_bloom_filter("d:bblock")
+            assert bf2.is_blocked() is True
+            hits = bf2.contains_all([b"bk%d" % i for i in range(2000)])
+            assert all(hits), "false negatives after blocked import"
+
+
+def test_blocked_add_padded_lanes_do_not_set_bit_zero(local_client):
+    """A padded (invalid) lane must not set absolute bit 0 (review r3:
+    unmasked max(1) on masked index 0)."""
+    import numpy as np
+
+    bf = local_client.get_bloom_filter("d:bpad")
+    bf.try_init(expected_insertions=5000, false_probability=0.01, blocked=True)
+    bf.add(b"solo")  # batch of 1 pads up to the bucket size
+    obj = local_client._store.get("d:bpad")
+    state = np.asarray(obj.state)
+    assert state.sum() == bf.get_hash_iterations()  # exactly k bits set
