@@ -139,6 +139,11 @@ def load(store: SketchStore, path: str,
                 continue
             host = z[_KEY_PREFIX + name]
             meta = info.get("meta") or {}
+            if info["otype"] == "bloom":
+                # Layout flag is merge-unsafe (only written when true): an
+                # absent key must clear any stale blocked=True on a live
+                # object, or blocked kernels would run over classic bits.
+                meta.setdefault("blocked", False)
             if put is not None and put(name, info["otype"], host, meta):
                 count += 1
                 continue

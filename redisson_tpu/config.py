@@ -41,7 +41,11 @@ class TpuConfig:
     # probes the link once and picks (backend_tpu.LinkProfile).
     ingest: str = "auto"
     hash_seed: int = 0
-    max_batch_keys: int = 1 << 21
+    # Coalescing cap for one dispatcher run. Device kernels still chunk at
+    # engine.MAX_BUCKET (2^21) per call; a larger run amortizes per-run
+    # costs (host fold setup, changed-readback) — measured on v5e: 2M cap
+    # 149M inserts/s, 8M cap 174M/s, 32M slightly worse (latency).
+    max_batch_keys: int = 1 << 23
     key_width_buckets: tuple = (16, 32, 64, 128, 256)
 
 

@@ -208,6 +208,10 @@ class DurabilityManager:
                     float(v) if f == "falseProbability" else int(v))
             elif f == "blocked":
                 meta["blocked"] = v in ("1", "true", "True")
+        # The flag is only WRITTEN when true, so an absent key must
+        # explicitly clear it: _put merges meta into any live object, and a
+        # stale blocked=True over classic-layout bits means false negatives.
+        meta.setdefault("blocked", False)
         bits = np.unpackbits(np.frombuffer(bytes(raw), np.uint8))
         size = int(meta.get("size", bits.size))
         out = np.zeros(size, np.uint8)

@@ -192,8 +192,14 @@ def blocked_indexes(h1: U64, h2: U64, k: int, m: int):
 
 
 def blocked_absolute(block: jnp.ndarray, pos: jnp.ndarray) -> jnp.ndarray:
-    """(block, in-block positions) -> absolute [N, k] bit indexes."""
-    return block[..., None] * BLOCK_BITS + pos
+    """(block, in-block positions) -> absolute [N, k] bit indexes.
+
+    Computed (and returned) in uint32: at the m = 2^32 cap an int32
+    product would wrap negative for blocks >= 2^22 and the scatter would
+    silently clamp to the wrong cell (classic indexes() keeps uint32 above
+    2^31 for the same reason)."""
+    return (block[..., None].astype(jnp.uint32) * jnp.uint32(BLOCK_BITS)
+            + pos.astype(jnp.uint32))
 
 
 def blocked_contains(bits: jnp.ndarray, block: jnp.ndarray, pos: jnp.ndarray):

@@ -527,3 +527,33 @@ def test_blocked_add_padded_lanes_do_not_set_bit_zero(local_client):
     obj = local_client._store.get("d:bpad")
     state = np.asarray(obj.state)
     assert state.sum() == bf.get_hash_iterations()  # exactly k bits set
+
+
+def test_classic_bloom_import_clears_stale_blocked_flag(local_client):
+    """Importing a classic dump over a live blocked filter must clear the
+    layout flag (review r3: the flag is only written when true, so a
+    meta-merge would keep stale blocked=True -> false negatives)."""
+    bf = local_client.get_bloom_filter("d:swap")
+    bf.try_init(expected_insertions=5000, false_probability=0.01, blocked=True)
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(local_client._store, rc)
+            # Write a CLASSIC dump under the same name from a scratch store.
+            from redisson_tpu.store import SketchStore
+
+            scratch = SketchStore(device=local_client._store.device)
+            from redisson_tpu.client import RedissonTPU as _R  # same proc
+            import numpy as _np
+
+            c2 = _R.create()
+            try:
+                src = c2.get_bloom_filter("d:swap")
+                src.try_init(expected_insertions=5000, false_probability=0.01)
+                src.add_all([b"c%d" % i for i in range(1000)])
+                DurabilityManager(c2._store, rc).flush(["d:swap"])
+            finally:
+                c2.shutdown()
+            assert dm.load_bloom("d:swap")
+            bf2 = local_client.get_bloom_filter("d:swap")
+            assert bf2.is_blocked() is False
+            assert all(bf2.contains_all([b"c%d" % i for i in range(1000)]))
