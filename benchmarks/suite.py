@@ -78,6 +78,12 @@ def config1(full: bool):
     for mode in ("engine", "redis"):
         c = _mkclient(mode)
         try:
+            # Warm kernels at the SAME shape and ingest path as the timed
+            # run (a smaller batch would bucket differently and could take
+            # the other hostfold/jit path), same policy as configs 3/5.
+            wh = c.get_hyper_log_log("b1:warm")
+            wh.add_all(keys)
+            wh.count()
             h = c.get_hyper_log_log("b1:hll")
             t0 = time.perf_counter()
             if mode == "engine":

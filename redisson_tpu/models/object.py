@@ -74,19 +74,24 @@ class RObject:
         repeated batches of similar keys reuse one compiled kernel.
         """
         keys: List[bytes] = [encode_key(v, self._codec) for v in values]
-        max_len = max((len(k) for k in keys), default=1)
+        n = len(keys)
+        lengths = np.fromiter((len(k) for k in keys), np.int32, n) if n else \
+            np.zeros((0,), np.int32)
+        max_len = int(lengths.max()) if n else 1
         w = next((b for b in self._width_buckets if b >= max_len), None)
         if w is None:
             raise ValueError(
                 f"key length {max_len} exceeds max width bucket "
                 f"{self._width_buckets[-1]}"
             )
-        n = len(keys)
         data = np.zeros((n, w), np.uint8)
-        lengths = np.empty((n,), np.int32)
-        for i, k in enumerate(keys):
-            data[i, : len(k)] = np.frombuffer(k, np.uint8)
-            lengths[i] = len(k)
+        if n:
+            # Vectorized fill: a row-major boolean mask selects exactly the
+            # first len(k) cells of each row, in concatenation order — one
+            # C-level scatter instead of a per-key python loop (which
+            # bounded string-key ingest at ~240K keys/s).
+            flat = np.frombuffer(b"".join(keys), np.uint8)
+            data[np.arange(w, dtype=np.int32)[None, :] < lengths[:, None]] = flat
         return data, lengths
 
     # -- RObject surface (RObjectAsync mirrored with _async suffix) ---------
