@@ -217,7 +217,8 @@ class RedissonTPU:
                 self._cluster_manager = None
             self._resp.close()  # reclaim the IO-loop thread
             raise
-        self._backend = self._routing = RedisBackend(self._resp)
+        self._backend = self._routing = RedisBackend(
+            self._resp, hash_seed=getattr(self.config.redis, "hash_seed", 0))
         self._store = None
         self._widths = (16, 32, 64, 128, 256)
         self.metrics = MetricsRegistry()

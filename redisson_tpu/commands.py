@@ -210,12 +210,12 @@ OP_TABLE = {d.kind: d for d in [
     _d("bitset_size", "STRLEN", False, "tpu redis"),
     _d("bitset_set_range", "SETBIT", True, "tpu"),
     _d("bitset_op", "BITOP", True, "tpu redis"),
-    _d("bloom_init", "LUA", True, "tpu"),
-    _d("bloom_add", "SETBIT", True, "tpu"),
-    _d("bloom_contains", "GETBIT", False, "tpu"),
-    _d("bloom_contains_count", "BITCOUNT", False, "tpu"),
-    _d("bloom_count", "BITCOUNT", False, "tpu"),
-    _d("bloom_meta", "HGETALL", False, "tpu"),
+    _d("bloom_init", "LUA", True, "tpu redis"),
+    _d("bloom_add", "SETBIT", True, "tpu redis"),
+    _d("bloom_contains", "GETBIT", False, "tpu redis"),
+    _d("bloom_contains_count", "BITCOUNT", False, "tpu redis"),
+    _d("bloom_count", "BITCOUNT", False, "tpu redis"),
+    _d("bloom_meta", "HGETALL", False, "tpu redis"),
 ]}
 
 

@@ -90,6 +90,10 @@ class RedisConfig:
     # poll INFO replication roles every N ms (0 = off); needs
     # slave_addresses. Catches AWS-side promotions no sentinel announces.
     role_scan_interval_ms: int = 0
+    # Murmur3 seed for wire-mode bloom index derivation; MUST match the
+    # TPU tier's TpuConfig.hash_seed when filters cross tiers via
+    # durability flushes (indexes are bit-compatible only at equal seeds).
+    hash_seed: int = 0
     timeout_ms: int = 3000  # BaseConfig.timeout
     retry_attempts: int = 3  # BaseConfig.retryAttempts
     retry_interval_ms: int = 1000  # BaseConfig.retryInterval

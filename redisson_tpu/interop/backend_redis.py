@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional
 
 from redisson_tpu.executor import Op
+from redisson_tpu.interop.bloom_redis import RedisBloomMixin
 from redisson_tpu.interop.resp_client import SyncRespClient
 from redisson_tpu.native import RespError
 
@@ -57,15 +58,18 @@ def _ck(v):
     return v
 
 
-class RedisBackend:
+class RedisBackend(RedisBloomMixin):
     """Backend for CommandExecutor whose run() executes via RESP."""
 
     # Observability: times a blocking pop's reply window expired with the
     # popped value unknown (potential element loss — see _op_bpop).
     blocking_pop_loss_windows = 0
 
-    def __init__(self, client: SyncRespClient):
+    def __init__(self, client: SyncRespClient, hash_seed: int = 0):
         self.client = client
+        # Seed for the host-side bloom index walk; must match the TPU
+        # tier's TpuConfig.hash_seed for cross-tier filters.
+        self.hash_seed = hash_seed
 
     def run(self, kind: str, target: str, ops: List[Op]) -> None:
         handler = getattr(self, "_op_" + kind, None)

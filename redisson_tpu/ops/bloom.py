@@ -36,19 +36,10 @@ import jax.numpy as jnp
 from redisson_tpu.ops import u64 as u
 from redisson_tpu.ops.u64 import U64
 
-MAX_SIZE = 1 << 32  # reference cap (power-of-two sizes only above 2^31)
-
-
-def optimal_num_of_bits(n: int, p: float) -> int:
-    """m = -n ln p / ln^2 2 (reference optimalNumOfBits)."""
-    if p == 0.0:
-        p = 5e-324  # Double.MIN_VALUE, as in the reference
-    return int(-n * math.log(p) / (math.log(2.0) ** 2))
-
-
-def optimal_num_of_hash_functions(n: int, m: int) -> int:
-    """k = max(1, round(m/n * ln 2)) (reference optimalNumOfHashFunctions)."""
-    return max(1, round(m / n * math.log(2.0)))
+# Sizing/estimation formulas live in ops/bloom_math.py (pure math, no jax)
+# so the wire tier can use them; re-exported here for kernel-side callers.
+from redisson_tpu.ops.bloom_math import (  # noqa: F401
+    MAX_SIZE, optimal_num_of_bits, optimal_num_of_hash_functions)
 
 
 def check_size(m: int) -> None:

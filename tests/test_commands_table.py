@@ -31,7 +31,8 @@ def test_tpu_tier_complete():
 
 
 def test_redis_tier_complete():
-    impl = _ops_of("redisson_tpu/interop/backend_redis.py")
+    impl = _ops_of("redisson_tpu/interop/backend_redis.py") | _ops_of(
+        "redisson_tpu/interop/bloom_redis.py")
     table = kinds_for_tier("redis")
     assert impl - table == set(), f"undocumented redis ops: {impl - table}"
     assert table - impl == set(), f"phantom redis ops: {table - impl}"

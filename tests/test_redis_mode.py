@@ -198,3 +198,107 @@ def test_multimap_cache_colon_field_ttl(rclient):
     assert mm.expire_key("a:mm:b", 0.0005)  # sub-ms rounds up to 1 ms
     time.sleep(0.05)
     assert mm.get_all("a:mm:b") == set()
+
+
+def test_bloom_filter_over_redis(rclient):
+    """The reference's own execution model: k SETBIT/GETBIT per key behind
+    a Lua config guard (RedissonBloomFilter.java:80-168), config in the
+    {name}__config sidecar (:254-256)."""
+    import numpy as np
+
+    bf = rclient.get_bloom_filter("rm:bf")
+    assert bf.try_init(10_000, 0.01) is True
+    assert bf.try_init(10_000, 0.01) is False
+    assert bf.get_hash_iterations() == 7
+    members = [f"u{i}" for i in range(400)]
+    assert bf.add_all(members).all()
+    assert not bf.add_all(members).any()          # re-add reports unchanged
+    assert bf.contains_all(members).all()          # no false negatives
+    assert bf.contains_all([f"g{i}" for i in range(400)]).mean() < 0.05
+    assert abs(bf.count() - 400) / 400 < 0.1       # BITCOUNT estimate
+    ints = np.arange(64, dtype=np.uint64)
+    bf.add_ints(ints)
+    assert bf.contains_count_ints(ints) == 64
+    assert bf.is_blocked() is False
+    with __import__("pytest").raises(Exception):
+        rclient.get_bloom_filter("rm:bfb").try_init(100, 0.01, blocked=True)
+
+
+def test_bloom_cross_tier_bit_compatible(rclient):
+    """A filter built on the TPU tier and flushed via durability serves
+    live wire-mode lookups with zero false negatives (identical murmur3
+    halves + (h1 + i*h2) mod 2^64 mod m walk on both tiers)."""
+    from redisson_tpu.interop.durability import DurabilityManager
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    local = RedissonTPU.create()
+    try:
+        bf = local.get_bloom_filter("rm:xt")
+        bf.try_init(5000, 0.01)
+        bf.add_all([f"m{i}" for i in range(500)])
+        port = rclient.config.redis.address.rsplit(":", 1)[1]
+        with SyncRespClient(port=int(port)) as rc:
+            DurabilityManager(local._store, rc).flush(["rm:xt"])
+    finally:
+        local.shutdown()
+    bf2 = rclient.get_bloom_filter("rm:xt")
+    assert bf2.contains_all([f"m{i}" for i in range(500)]).all()
+    bf2.add("extra")
+    assert bf2.contains("extra")
+
+
+def test_bloom_cross_tier_with_nonzero_seed():
+    """Seeded cross-tier compatibility: TPU tier with hash_seed=9 flushed,
+    redis tier with matching hash_seed serves it — and a MISmatched seed
+    visibly breaks membership (review r3: the wire path must honor the
+    configured seed, not hardcode 0)."""
+    from redisson_tpu.config import TpuConfig
+    from redisson_tpu.interop.durability import DurabilityManager
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    with EmbeddedRedis() as er:
+        local = RedissonTPU.create(Config(tpu=TpuConfig(hash_seed=9)))
+        try:
+            bf = local.get_bloom_filter("rm:seed")
+            bf.try_init(3000, 0.01)
+            bf.add_all([f"s{i}" for i in range(300)])
+            with SyncRespClient(port=er.port) as rc:
+                DurabilityManager(local._store, rc).flush(["rm:seed"])
+        finally:
+            local.shutdown()
+
+        cfg = Config()
+        r = cfg.use_redis()
+        r.address = f"redis://127.0.0.1:{er.port}"
+        r.hash_seed = 9
+        c = RedissonTPU.create(cfg)
+        try:
+            hits = c.get_bloom_filter("rm:seed").contains_all(
+                [f"s{i}" for i in range(300)])
+            assert hits.all()
+        finally:
+            c.shutdown()
+
+        cfg2 = Config()
+        r2 = cfg2.use_redis()
+        r2.address = f"redis://127.0.0.1:{er.port}"  # default seed 0
+        c2 = RedissonTPU.create(cfg2)
+        try:
+            hits = c2.get_bloom_filter("rm:seed").contains_all(
+                [f"s{i}" for i in range(300)])
+            assert not hits.all()  # wrong seed, wrong bits
+        finally:
+            c2.shutdown()
+
+
+def test_bloom_wire_accepts_large_non_pow2_size(rclient):
+    """The wire path takes any size up to the 2^32 cap (host-side index
+    math); the TPU kernel's power-of-two-above-2^31 rule must not apply
+    (review r3)."""
+    bf = rclient.get_bloom_filter("rm:big")
+    # m ~= 2.87e9 > 2^31 and not a power of two. Init + lookups only: a
+    # SETBIT near the top would make the fake allocate a ~360MB backing
+    # string, which is the server's business, not this contract's.
+    assert bf.try_init(300_000_000, 0.01) is True
+    assert bf.get_size() > (1 << 31)
+    assert not bf.contains("other")
