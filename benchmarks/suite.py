@@ -192,9 +192,9 @@ def config2(full: bool):
         pending = []
         t0 = time.perf_counter()
         for s in range(0, n_probe, step):
-            fut, size = probe_batch(s)
+            fut, batch_count = probe_batch(s)
             pending.append(fut)
-            probed += size
+            probed += batch_count
             if len(pending) >= 8:
                 false_hits += drain(pending)
                 pending = []
