@@ -285,9 +285,10 @@ def bench_pfmerge(jax, dev):
 
 
 def main():
-    from redisson_tpu.tpu_boot import acquire_devices
+    from redisson_tpu.tpu_boot import acquire_devices, enable_compilation_cache
 
     devices, platform = acquire_devices(retries=5, fallback_cpu=True)
+    enable_compilation_cache()
     import jax
 
     dev = devices[0]

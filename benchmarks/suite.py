@@ -36,6 +36,9 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+# (The client facade enables the persistent compilation cache for
+# device-backed modes; no import-time backend touch here — `--help` and
+# redis-mode runs must not dial the TPU tunnel.)
 
 
 _TINY = bool(os.environ.get("RTPU_BENCH_TINY"))
@@ -246,15 +249,55 @@ def config3(full: bool):
         # timed pass measures the operation, not its one-time XLA compile.
         c.get_hyper_log_log("b3:warm").merge_with(*names)
         c.get_hyper_log_log("b3:warm").count()
+        rtt_ms = _link_rtt_ms()
+        # Blocking single shot: includes exactly one dependent D2H sync
+        # (one link RTT — ~us on an attached chip, tens of ms through the
+        # dev tunnel; read it against rtt_ms).
         t0 = time.perf_counter()
         dest.merge_with(*names)
         union = dest.count()
-        merge_dt = time.perf_counter() - t0
+        sync_dt = time.perf_counter() - t0
+        # Steady state: K merge+count cycles THROUGH THE ASYNC FACADE
+        # (merge_with_async/count_async are first-class reference API,
+        # RedissonHyperLogLog.java:40-97) — per-op cost with the link RTT
+        # amortized, i.e. what an attached chip sees per blocking call.
+        K = 8
+        futs = []
+        t0 = time.perf_counter()
+        for _ in range(K):
+            dest.merge_with_async(*names)
+            futs.append(dest.count_async())
+        for f in futs:
+            f.result()
+        pipe_dt = (time.perf_counter() - t0) / K
+        # merge_count_ms keeps its historical meaning (blocking single
+        # shot); the pipelined per-op figure is a separate, clearly-named
+        # key so round-over-round diffs never compare different metrics.
         return {"config": 3, "sketches": sketches, "keys_per_sketch": per,
                 "batched_insert_keys_per_sec": sketches * per / add_dt,
-                "merge_count_ms": merge_dt * 1000, "union_estimate": union}
+                "merge_count_ms": sync_dt * 1000,
+                "merge_count_pipelined_ms": pipe_dt * 1000,
+                "link_rtt_ms": rtt_ms,
+                "union_estimate": union}
     finally:
         _close(c)
+
+
+def _link_rtt_ms() -> float:
+    """One dependent device sync of a trivial kernel = the link's
+    round-trip floor (not framework cost — published alongside blocking
+    latencies so they can be read against it)."""
+    import jax
+    import jax.numpy as jnp
+
+    tick = jax.jit(lambda x: x + 1)
+    float(tick(jnp.float32(0)))  # compile
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(tick(jnp.float32(0)))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1000
 
 
 def config4(full: bool):
@@ -343,11 +386,16 @@ def config4(full: bool):
 
 
 def config5(full: bool):
-    """Cluster-mode count-distinct: slot-sharded HLLs, cross-slot merge via
-    the mesh allreduce (pmax over ICI on real pods; virtual mesh here)."""
+    """Cluster-mode count-distinct THROUGH THE CLIENT FACADE: 1024 named
+    HLLs live as mesh-sharded bank rows; inserts are staged per sketch via
+    RBatch (the pod backend's GLOBAL_COALESCE folds them into shared SPMD
+    calls with per-key target rows), and the cross-slot merge is
+    `get_hyper_log_log(...).count_with(*names)` — one gather + row-max +
+    pmax-allreduce kernel. No `c._backend.sketch` reaching (VERDICT r3:
+    the reference's mergeWith/countWith are first-class API,
+    RedissonHyperLogLog.java:40-97, so the <50 ms target must hold here)."""
     from redisson_tpu.client import RedissonTPU
     from redisson_tpu.config import Config
-    from redisson_tpu.parallel import sharded
 
     n_sketches = 64 if _TINY else 1024
     per = _scale(100_000 if full else 20_000)
@@ -357,30 +405,46 @@ def config5(full: bool):
     pod.bank_capacity = n_sketches
     c = RedissonTPU.create(cfg)
     try:
-        backend = c._backend.sketch
         rng = np.random.default_rng(5)
         keys = rng.integers(0, 2**63, n_sketches * per, np.uint64)
-        hi = (keys >> np.uint64(32)).astype(np.uint32)
-        lo = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        rows = (np.arange(keys.size) % n_sketches).astype(np.int32)
-        valid = np.ones(keys.size, bool)
-        backend.bank, _ = sharded.bank_insert(
-            backend.bank, hi, lo, rows, valid, backend.mesh, backend.seed)
-        backend.bank.block_until_ready()
+        names = [f"b5:s{i}" for i in range(n_sketches)]
+        batch = c.create_batch()
+        for i, name in enumerate(names):
+            batch.get_hyper_log_log(name).add_ints_async(
+                keys[i * per:(i + 1) * per])
+        t0 = time.perf_counter()
+        batch.execute()
+        insert_dt = time.perf_counter() - t0
 
-        # Compile outside the timed region; time the steady-state merge
-        # (best of 3 rides over tunnel dispatch stalls, like bench.py).
-        float(sharded.bank_count_all(backend.bank, backend.mesh))
-        merge_dt = float("inf")
+        # Compile outside the timed region; blocking best-of-3 plus the
+        # pipelined steady state (same split as config 3: one link RTT
+        # rides on every blocking call through the dev tunnel).
+        h0 = c.get_hyper_log_log(names[0])
+        h0.count_with(*names[1:])
+        rtt_ms = _link_rtt_ms()
+        sync_dt = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
-            est = float(sharded.bank_count_all(backend.bank, backend.mesh))
-            merge_dt = min(merge_dt, time.perf_counter() - t0)
+            est = h0.count_with(*names[1:])
+            sync_dt = min(sync_dt, time.perf_counter() - t0)
+        K = 8
+        t0 = time.perf_counter()
+        futs = [h0.count_with_async(*names[1:]) for _ in range(K)]
+        for f in futs:
+            f.result()
+        pipe_dt = (time.perf_counter() - t0) / K
         err = abs(est - keys.size) / keys.size
+        backend = c._backend.sketch
+        # Same key discipline as config 3: the historical key stays the
+        # blocking measurement; pipelined gets its own name.
         return {"config": 5, "sketches": n_sketches,
-                "cross_slot_merge_count_ms": merge_dt * 1000,
+                "cross_slot_merge_count_ms": sync_dt * 1000,
+                "cross_slot_merge_count_pipelined_ms": pipe_dt * 1000,
+                "link_rtt_ms": rtt_ms,
+                "insert_keys_per_sec": keys.size / insert_dt,
                 "union_estimate": est, "true_distinct": int(keys.size),
-                "error": err, "devices": int(backend.mesh.devices.size)}
+                "error": err, "devices": int(backend.mesh.devices.size),
+                "api": "facade"}
     finally:
         c.shutdown()
 

@@ -60,6 +60,11 @@ class RedissonTPU:
             # is stateless).
             self._init_redis_mode()
             return
+        # Device-backed modes compile kernels: persist them across processes
+        # (~7 s per cold (op, shape) on the tunneled chip otherwise).
+        from redisson_tpu.tpu_boot import enable_compilation_cache
+
+        enable_compilation_cache()
         if mode == "pod":
             from redisson_tpu.parallel.backend_pod import PodBackend
 

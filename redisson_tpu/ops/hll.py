@@ -124,51 +124,68 @@ def merge_many(stack: jnp.ndarray) -> jnp.ndarray:
 
 _ITERS = 48  # fixed-point iterations; f32 converges in < 30
 
+# Implementation notes (both matter on the tunneled axon TPU backend):
+#   * Unrolled python loops, NOT lax.fori_loop: a sequential scalar loop
+#     body costs ~0.4 ms *per iteration* in dispatch there (the r3 "64 ms
+#     merge" was ~150 fori_loop iterations of estimator overhead).
+#   * The chains run ELEMENTWISE over the whole [q+2] histogram vector and
+#     lanes are selected at the end: the axon XLA build miscompiles long
+#     unrolled chains whose input is a lane extracted (or reduced) from a
+#     computed array — rank-0/width-1 chains return NaN from iteration ~4
+#     while the identical chain over the un-extracted vector is correct.
+#     Keep estimator math vector-shaped until the final reduce.
+
 
 def _sigma(x):
-    """sigma(x) = x + sum_{k>=1} x^(2^k) * 2^(k-1); diverges at x=1."""
-
-    def body(_, carry):
-        x, y, z = carry
+    """sigma(x) = x + sum_{k>=1} x^(2^k) * 2^(k-1); diverges at x=1.
+    Elementwise over any shape."""
+    x = x.astype(jnp.float32)
+    y = jnp.float32(1.0)
+    z = x
+    for _ in range(_ITERS):
         x = x * x
         z = z + x * y
         y = y * 2.0
-        return x, y, z
-
-    x = x.astype(jnp.float32)
-    _, _, z = jax.lax.fori_loop(0, _ITERS, body, (x, jnp.float32(1.0), x))
     return z
 
 
 def _tau(x):
-    def body(_, carry):
-        x, y, z = carry
+    x = x.astype(jnp.float32)
+    y = jnp.float32(1.0)
+    z = 1.0 - x
+    for _ in range(_ITERS):
         x = jnp.sqrt(x)
         y = y * 0.5
         z = z - jnp.square(1.0 - x) * y
-        return x, y, z
-
-    x = x.astype(jnp.float32)
-    _, _, z = jax.lax.fori_loop(0, _ITERS, body, (x, jnp.float32(1.0), 1.0 - x))
     return z / 3.0
 
 
 def count(registers: jnp.ndarray) -> jnp.ndarray:
-    """Cardinality estimate (float32 scalar; 0 for an empty sketch)."""
+    """Cardinality estimate (float32 scalar; 0 for an empty sketch).
+
+    Ertl's z accumulator is computed as one weighted reduce instead of the
+    sequential halving loop: unrolling `z = 0.5*(z + hist[k])` q times
+    assigns hist[k] the weight 2^-k and the tau term 2^-q, so
+    z = 2^-q*m*tau + sum_k 2^-k*hist[k] + m*sigma — mathematically
+    identical, vector-shaped end to end (see the chain-shape note above
+    _sigma), and one VPU pass instead of 50 dependent scalar steps."""
     m = registers.shape[0]
     p = _p_of(m)
     q = 64 - p
     # Histogram of register values 0..q+1.
     hist = jnp.zeros((q + 2,), jnp.float32).at[registers].add(1.0)
     mf = jnp.float32(m)
-    z = mf * _tau(1.0 - hist[q + 1] / mf)
-
-    def body(i, z):
-        k = q - i  # q down to 1
-        return 0.5 * (z + hist[k])
-
-    z = jax.lax.fori_loop(0, q, body, z)
-    z = z + mf * _sigma(hist[0] / mf)
+    x = hist / mf  # [q+2]
+    sig = _sigma(x)  # elementwise; only lane 0 is used
+    tau = _tau(1.0 - x)  # elementwise; only lane q+1 is used
+    lane = jnp.arange(q + 2)
+    w = jnp.where((lane >= 1) & (lane <= q),
+                  jnp.exp2(-lane.astype(jnp.float32)), 0.0)
+    combo = (hist * w
+             + jnp.where(lane == q + 1,
+                         mf * jnp.exp2(jnp.float32(-q)) * tau, 0.0)
+             + jnp.where(lane == 0, mf * sig, 0.0))
+    z = jnp.sum(combo)
     alpha_inf = jnp.float32(0.5 / jnp.log(2.0))
     est = alpha_inf * mf * mf / z
     # Load-bearing: with the fixed iteration count sigma(1) is a finite

@@ -54,8 +54,10 @@ def _insert_local(bank_local, hi, lo, row, valid, seed: int,
     (byte keys hash host-side via the native batch murmur so local and pod
     modes agree bit-for-bit on identical inputs); False hashes the raw u64
     key on device (the int fast path).
-    Returns (new_local, changed[1]) — changed is this device's "any register
-    raised" flag pmax-reduced over the mesh (the PFADD bool contract).
+    Returns (new_local, changed_local[S/D]) — a PER-ROW "any register
+    raised" flag (concatenates to the global [S] vector across the shard
+    axis), so a cross-sketch coalesced insert can give every target its own
+    PFADD bool instead of one run-wide flag.
     """
     s_local, m = bank_local.shape
     dev = lax.axis_index(SHARD_AXIS)
@@ -71,10 +73,11 @@ def _insert_local(bank_local, hi, lo, row, valid, seed: int,
     bucket, rank = hll.bucket_rank(h1, p)
     rank = jnp.where(mine, rank, 0)
     flat = bank_local.reshape(-1)
-    flat_idx = jnp.where(mine, local_row, 0) * m + bucket
-    changed = jnp.any(rank > flat[flat_idx])
-    changed = lax.pmax(changed.astype(jnp.int32), SHARD_AXIS)
-    return flat.at[flat_idx].max(rank).reshape(s_local, m), changed[None]
+    safe_row = jnp.where(mine, local_row, 0)
+    flat_idx = safe_row * m + bucket
+    raised = (rank > flat[flat_idx]) & mine
+    changed_local = jnp.zeros((s_local,), bool).at[safe_row].max(raised)
+    return flat.at[flat_idx].max(rank).reshape(s_local, m), changed_local
 
 
 @functools.partial(
@@ -84,7 +87,8 @@ def bank_insert(bank, hi, lo, row, valid, mesh: Mesh, seed: int = 0,
                 pre_hashed: bool = False):
     """Insert a replicated key batch into the sharded bank (one SPMD step).
 
-    Returns (new_bank, changed) where changed is vs pre-batch state.
+    Returns (new_bank, changed_rows[S]) — per-row change flags vs
+    pre-batch state (`changed_rows.any()` is the whole-batch bool).
     """
     fn = shard_map(
         functools.partial(_insert_local, seed=seed, pre_hashed=pre_hashed),
@@ -92,8 +96,7 @@ def bank_insert(bank, hi, lo, row, valid, mesh: Mesh, seed: int = 0,
         in_specs=(P(SHARD_AXIS, None), P(), P(), P(), P()),
         out_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS)),
     )
-    bank, changed = fn(bank, hi, lo, row, valid)
-    return bank, changed[0] > 0
+    return fn(bank, hi, lo, row, valid)
 
 
 def _merge_local(bank_local):

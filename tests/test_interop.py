@@ -190,6 +190,14 @@ def local_client():
     c.shutdown()
 
 
+def _dm(client, rc):
+    """DurabilityManager wired the way the client wires it: HLLs live in
+    the backend's bank (not the store), so flushing them needs the
+    executor + bank-owning backend."""
+    return DurabilityManager(client._store, rc, executor=client._executor,
+                             pod_backend=client._pod_backend())
+
+
 def test_durability_hll_roundtrip(local_client):
     h = local_client.get_hyper_log_log("d:hll")
     h.add_all([b"k%d" % i for i in range(30000)])
@@ -197,14 +205,14 @@ def test_durability_hll_roundtrip(local_client):
 
     with EmbeddedRedis() as er:
         with SyncRespClient(port=er.port) as rc:
-            dm = DurabilityManager(local_client._store, rc)
+            dm = _dm(local_client, rc)
             assert dm.flush(["d:hll"]) == 1
             # A "real" server can PFCOUNT the flushed blob directly.
             server_est = rc.execute("PFCOUNT", "d:hll")
             assert abs(server_est - est_before) / max(est_before, 1) < 0.01
 
             # Wipe local state, import back, estimate preserved exactly.
-            local_client._store.delete("d:hll")
+            local_client._executor.execute_sync("d:hll", "delete", None)
             assert dm.load_hll("d:hll")
             h2 = local_client.get_hyper_log_log("d:hll")
             assert abs(h2.count() - est_before) / max(est_before, 1) < 0.005
@@ -259,7 +267,7 @@ def test_durability_periodic_flush(local_client):
     h.add_all([b"x%d" % i for i in range(100)])
     with EmbeddedRedis() as er:
         with SyncRespClient(port=er.port) as rc:
-            dm = DurabilityManager(local_client._store, rc)
+            dm = _dm(local_client, rc)
             dm.start_periodic(interval=0.05)
             import time
             deadline = time.time() + 5
@@ -283,7 +291,7 @@ def test_checkpoint_roundtrip(tmp_path, local_client):
     est = h.count()
 
     path = str(tmp_path / "ckpt")
-    n = checkpoint.save(local_client._store, path)
+    n = local_client.save_checkpoint(path)
     assert n == 2
     meta = checkpoint.info(path)
     assert set(meta["objects"]) == {"c:hll", "c:bits"}
@@ -291,7 +299,7 @@ def test_checkpoint_roundtrip(tmp_path, local_client):
     local_client.flushall()
     assert local_client.get_hyper_log_log("c:hll").count() == 0
 
-    assert checkpoint.load(local_client._store, path) == 2
+    assert local_client.load_checkpoint(path) == 2
     assert local_client.get_hyper_log_log("c:hll").count() == est
     assert local_client.get_bit_set("c:bits").get(42)
 
@@ -402,7 +410,7 @@ def test_periodic_flush_skips_clean_objects(local_client):
     h.add_all([b"a%d" % i for i in range(100)])
     with EmbeddedRedis() as er:
         with SyncRespClient(port=er.port) as rc:
-            dm = DurabilityManager(local_client._store, rc)
+            dm = _dm(local_client, rc)
             assert dm.flush(only_dirty=True) == 1   # first flush writes
             assert dm.flush(only_dirty=True) == 0   # nothing changed
             h.add(b"new-key")
@@ -416,7 +424,7 @@ def test_failed_flush_keeps_objects_dirty(local_client):
     with EmbeddedRedis() as er:
         rc = SyncRespClient(port=er.port)
         rc.connect()
-        dm = DurabilityManager(local_client._store, rc)
+        dm = _dm(local_client, rc)
         rc.close()  # write will fail
         with pytest.raises(Exception):
             dm.flush(only_dirty=True)
