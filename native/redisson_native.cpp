@@ -522,4 +522,184 @@ RTPU_EXPORT void rtpu_hll_fold_rows(const uint8_t* data, int64_t w,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Host-side Bloom fold/probe: the transfer-adaptive ingest path for the
+// Bloom tier (same move-the-reduction trick as rtpu_hll_fold_u64 — on a
+// slow host->device link, fold membership bits locally and ship/OR the
+// bitmap once instead of 8 B/key + per-key bools).
+//
+// Index semantics are identical to ops/bloom.py indexes(): hash the key
+// with MurmurHash3 x64 128 (u64 keys as their 8-byte LE encoding), then
+// walk idx_i = ((h1 + i*h2) mod 2^64) mod m — the uint64 accumulator wraps
+// naturally. Bit layout is numpy packbits big-endian (absolute bit i ->
+// byte i>>3, bit 7-(i&7)) so host mirrors interoperate with np.packbits /
+// np.unpackbits and the durability blobs.
+// ---------------------------------------------------------------------------
+
+static inline void mm3_u64_pair(uint64_t key, uint64_t seed, uint64_t* o1,
+                                uint64_t* o2) {
+  // x86-64 is little-endian: the in-memory bytes of `key` ARE its 8-byte
+  // LE encoding (the encoding murmur3_x64_128_u64 hashes on device).
+  murmur3_x64_128_one(reinterpret_cast<const uint8_t*>(&key), 8, seed, o1, o2);
+}
+
+static inline int bloom_get_bit(const uint8_t* bits, uint64_t idx) {
+  return (bits[idx >> 3] >> (7 - (idx & 7))) & 1;
+}
+
+// Threads share the bitmap; byte-granular |= is a read-modify-write, so a
+// plain store could drop a concurrent thread's bit in the same byte —
+// atomic OR keeps every set (relaxed order: bloom bits are monotone).
+static inline void bloom_set_bit_atomic(uint8_t* bits, uint64_t idx) {
+  __atomic_fetch_or(&bits[idx >> 3], (uint8_t)(0x80u >> (idx & 7u)),
+                    __ATOMIC_RELAXED);
+}
+
+template <bool Atomic>
+static inline uint8_t bloom_fold_one(uint64_t h1, uint64_t h2, int32_t k,
+                                     uint64_t m, uint8_t* bits) {
+  uint64_t acc = h1;
+  uint8_t fresh = 0;
+  for (int32_t i = 0; i < k; i++) {
+    uint64_t idx = acc % m;
+    if (!bloom_get_bit(bits, idx)) {
+      fresh = 1;
+      if (Atomic)  // lock-prefixed RMW only when threads share the bitmap
+        bloom_set_bit_atomic(bits, idx);
+      else
+        bits[idx >> 3] |= (uint8_t)(0x80u >> (idx & 7u));
+    }
+    acc += h2;
+  }
+  return fresh;
+}
+
+static inline uint8_t bloom_probe_one(uint64_t h1, uint64_t h2, int32_t k,
+                                      uint64_t m, const uint8_t* bits) {
+  uint64_t acc = h1;
+  for (int32_t i = 0; i < k; i++) {
+    if (!bloom_get_bit(bits, acc % m)) return 0;  // early out: most
+    acc += h2;                                    // negatives fail bit 0
+  }
+  return 1;
+}
+
+template <bool Atomic>
+static void bloom_fold_u64_range(const uint64_t* keys, int64_t n,
+                                 uint64_t seed, int32_t k, uint64_t m,
+                                 uint8_t* bits, uint8_t* newly) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    mm3_u64_pair(keys[i], seed, &h1, &h2);
+    uint8_t fresh = bloom_fold_one<Atomic>(h1, h2, k, m, bits);
+    if (newly) newly[i] = fresh;
+  }
+}
+
+// Fold a u64 key batch into a shared packed bitmap. `newly` (optional,
+// size n) gets 1 where the key set at least one previously-unset bit.
+// The "previously" read races across threads only for two keys sharing a
+// bit in the same batch — the same looseness the device path's per-chunk
+// evaluation already documents (executor batch-visibility contract).
+RTPU_EXPORT void rtpu_bloom_fold_u64(const uint64_t* keys, int64_t n,
+                                     uint64_t seed, int32_t k, uint64_t m,
+                                     uint8_t* bits, uint8_t* newly,
+                                     int32_t nthreads) {
+  const int64_t kMinPerThread = 1 << 15;
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads > (int32_t)(n / kMinPerThread))
+    nthreads = (int32_t)(n / kMinPerThread);
+  if (nthreads <= 1) {
+    bloom_fold_u64_range<false>(keys, n, seed, k, m, bits, newly);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = n / nthreads;
+  for (int32_t t = 1; t < nthreads; t++) {
+    int64_t s = per * t;
+    int64_t e = (t == nthreads - 1) ? n : per * (t + 1);
+    threads.emplace_back([=] {
+      bloom_fold_u64_range<true>(keys + s, e - s, seed, k, m, bits,
+                                 newly ? newly + s : nullptr);
+    });
+  }
+  bloom_fold_u64_range<true>(keys, per, seed, k, m, bits, newly);
+  for (auto& th : threads) th.join();
+}
+
+static void bloom_probe_u64_range(const uint64_t* keys, int64_t n,
+                                  uint64_t seed, int32_t k, uint64_t m,
+                                  const uint8_t* bits, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    mm3_u64_pair(keys[i], seed, &h1, &h2);
+    out[i] = bloom_probe_one(h1, h2, k, m, bits);
+  }
+}
+
+// Membership probe of a u64 key batch against a packed bitmap (read-only,
+// embarrassingly parallel).
+RTPU_EXPORT void rtpu_bloom_contains_u64(const uint64_t* keys, int64_t n,
+                                         uint64_t seed, int32_t k, uint64_t m,
+                                         const uint8_t* bits, uint8_t* out,
+                                         int32_t nthreads) {
+  const int64_t kMinPerThread = 1 << 15;
+  if (nthreads > 16) nthreads = 16;
+  if (nthreads > (int32_t)(n / kMinPerThread))
+    nthreads = (int32_t)(n / kMinPerThread);
+  if (nthreads <= 1) {
+    bloom_probe_u64_range(keys, n, seed, k, m, bits, out);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t per = n / nthreads;
+  for (int32_t t = 1; t < nthreads; t++) {
+    int64_t s = per * t;
+    int64_t e = (t == nthreads - 1) ? n : per * (t + 1);
+    threads.emplace_back([=] {
+      bloom_probe_u64_range(keys + s, e - s, seed, k, m, bits, out + s);
+    });
+  }
+  bloom_probe_u64_range(keys, per, seed, k, m, bits, out);
+  for (auto& th : threads) th.join();
+}
+
+// Row-layout byte-key variants (the executor's padded [n, w] matrix +
+// per-key lengths, like rtpu_hll_fold_rows).
+RTPU_EXPORT void rtpu_bloom_fold_rows(const uint8_t* data, int64_t w,
+                                      const int32_t* lengths, int64_t n,
+                                      uint64_t seed, int32_t k, uint64_t m,
+                                      uint8_t* bits, uint8_t* newly) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    murmur3_x64_128_one(data + i * w, lengths[i], seed, &h1, &h2);
+    uint8_t fresh = bloom_fold_one<false>(h1, h2, k, m, bits);
+    if (newly) newly[i] = fresh;
+  }
+}
+
+RTPU_EXPORT void rtpu_bloom_contains_rows(const uint8_t* data, int64_t w,
+                                          const int32_t* lengths, int64_t n,
+                                          uint64_t seed, int32_t k, uint64_t m,
+                                          const uint8_t* bits, uint8_t* out) {
+  for (int64_t i = 0; i < n; i++) {
+    uint64_t h1, h2;
+    murmur3_x64_128_one(data + i * w, lengths[i], seed, &h1, &h2);
+    out[i] = bloom_probe_one(h1, h2, k, m, bits);
+  }
+}
+
+// Population count of a packed bitmap (host-side BITCOUNT for the mirror).
+RTPU_EXPORT uint64_t rtpu_popcount(const uint8_t* bits, int64_t nbytes) {
+  uint64_t total = 0;
+  int64_t i = 0;
+  for (; i + 8 <= nbytes; i += 8) {
+    uint64_t w;
+    std::memcpy(&w, bits + i, 8);
+    total += (uint64_t)__builtin_popcountll(w);
+  }
+  for (; i < nbytes; i++) total += (uint64_t)__builtin_popcount(bits[i]);
+  return total;
+}
+
 RTPU_EXPORT const char* rtpu_version() { return "redisson-tpu-native 1.0"; }

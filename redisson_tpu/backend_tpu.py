@@ -141,6 +141,44 @@ def _complete_all(ops: List[Op], materialize: Callable[[], object]) -> Callable:
     return run
 
 
+def complete_changed_rows(completer: "Completer", ops: List[Op],
+                          rows: List[int], parts) -> None:
+    """Complete a coalesced insert run with PER-TARGET PFADD semantics: the
+    kernels return changed-rows vectors; each op's bool is its own target's
+    lane (one tiny D2H per run resolved on the completer — never a run-wide
+    flag leaking across sketches, never a dispatcher-side device wait).
+    Shared by the single-chip and pod backends."""
+    flag = None
+    if parts:
+        flag = _start_d2h(functools.reduce(jnp.logical_or, parts))
+
+    def run():
+        try:
+            host = None if flag is None else np.asarray(flag)
+        except Exception as exc:  # noqa: BLE001
+            for op in ops:
+                if not op.future.done():
+                    op.future.set_exception(exc)
+            return
+        for op, r in zip(ops, rows):
+            if not op.future.done():
+                op.future.set_result(False if host is None else bool(host[r]))
+
+    completer.submit(run)
+
+
+def backend_names(store: SketchStore, extra_names, pattern: str = "*"):
+    """Store keys plus backend-held names (bank HLLs) matching `pattern` —
+    the RKeys listing for backends whose objects span both registries."""
+    import fnmatch
+
+    out = dict.fromkeys(store.keys(pattern))
+    for n in extra_names:
+        if pattern in (None, "*") or fnmatch.fnmatchcase(n, pattern):
+            out[n] = None
+    return list(out)
+
+
 class LinkProfile:
     """One-time measurement of the host->device link and the native fold.
 
@@ -155,18 +193,35 @@ class LinkProfile:
         import time
 
         import jax
+        import jax.numpy as jnp
 
         from redisson_tpu import native as native_mod
 
-        # Incompressible probe payload: a zeros buffer measures the tunnel's
-        # compressor (~2 GB/s apparent), not the link; real key batches are
-        # random-ish and move at wire speed.
-        buf = np.random.default_rng(0).integers(
-            0, 256, 1 << 20, np.uint8)  # 1 MB probe
-        jax.device_put(buf, device).block_until_ready()  # warm path/alloc
-        t0 = time.perf_counter()
-        jax.device_put(buf, device).block_until_ready()
-        self.transfer_ns_per_byte = (time.perf_counter() - t0) * 1e9 / buf.nbytes
+        # Two rules keep this probe honest on the tunneled platform:
+        #   * incompressible payload — a zeros buffer measures the tunnel's
+        #     compressor (~2 GB/s apparent), not the link;
+        #   * force a full round trip (upload -> device reduce -> scalar
+        #     sync) — block_until_ready on a bare device_put returns before
+        #     the bytes actually move there, reporting fictional bandwidth
+        #     that made the auto policy flip per process.
+        # The fixed sync RTT cancels in the big-minus-small difference.
+        # The big buffer must dwarf the sync RTT floor (~65 ms through the
+        # tunnel, noisy) or the difference drowns: 8 MB at the tunnel's
+        # ~50 MB/s is ~160 ms of genuine transfer vs ~65 ms of floor.
+        rng = np.random.default_rng(0)
+        small = rng.integers(0, 256, 1 << 12, np.uint8)  # 4 KB
+        big = rng.integers(0, 256, 1 << 23, np.uint8)  # 8 MB
+
+        def roundtrip(buf):
+            t0 = time.perf_counter()
+            float(jnp.sum(jax.device_put(buf, device).astype(jnp.int32)))
+            return time.perf_counter() - t0
+
+        roundtrip(small), roundtrip(big)  # warm path/alloc/compile
+        t_small = min(roundtrip(small) for _ in range(2))
+        t_big = min(roundtrip(big) for _ in range(2))
+        self.transfer_ns_per_byte = max(
+            (t_big - t_small) * 1e9 / (big.nbytes - small.nbytes), 0.001)
 
         self.fold_ns_per_key = float("inf")
         if native_mod.available():
@@ -265,6 +320,9 @@ class TpuBackend:
         # name -> mutation counter (durability/checkpoint dirty tracking —
         # same surface as PodBackend.row_version).
         self._row_versions: dict = {}
+        # name -> packed host replica of a bloom filter (see the Bloom host
+        # mirror section).
+        self._bloom_mirrors: dict = {}
 
     def _use_hostfold(self, nkeys: int) -> bool:
         return hostfold_policy(self.ingest, nkeys, self.store.device)
@@ -354,13 +412,7 @@ class TpuBackend:
         return self._row_versions.get(name, 0)
 
     def names(self, pattern: str = "*") -> List[str]:
-        import fnmatch
-
-        out = dict.fromkeys(self.store.keys(pattern))
-        for n in self._rows:
-            if pattern in (None, "*") or fnmatch.fnmatchcase(n, pattern):
-                out[n] = None
-        return list(out)
+        return backend_names(self.store, self._rows, pattern)
 
     def _op_hll_add(self, target: str, ops: List[Op]) -> None:
         # A coalesced run may span formats AND targets (GLOBAL_COALESCE);
@@ -407,28 +459,8 @@ class TpuBackend:
             )
 
     def _complete_changed(self, ops: List[Op], parts) -> None:
-        """Completion with PER-TARGET PFADD semantics: the kernels return a
-        changed-rows [S] vector; each op's bool is its own target's lane
-        (one tiny D2H per run, no run-wide flag leaking across sketches)."""
-        rows = [self._rows[op.target] for op in ops]
-        flag = None
-        if parts:
-            flag = _start_d2h(functools.reduce(jnp.logical_or, parts))
-
-        def run():
-            try:
-                host = None if flag is None else np.asarray(flag)
-            except Exception as exc:  # noqa: BLE001
-                for op in ops:
-                    if not op.future.done():
-                        op.future.set_exception(exc)
-                return
-            for op, r in zip(ops, rows):
-                if not op.future.done():
-                    op.future.set_result(
-                        False if host is None else bool(host[r]))
-
-        self.completer.submit(run)
+        complete_changed_rows(
+            self.completer, ops, [self._rows[op.target] for op in ops], parts)
 
     @staticmethod
     def _payload_nkeys(op: Op) -> int:
@@ -848,6 +880,10 @@ class TpuBackend:
             sources = op.payload["names"]
             arrays = []
             for n in sources:
+                # HLLs live in the bank, not the store: without this guard
+                # an HLL source would read as absent and be silently
+                # skipped instead of WRONGTYPE (review r4).
+                self._check_not_hll(n, ObjectType.BITSET)
                 o = self.store.get(n, ObjectType.BITSET)
                 if o is not None:
                     arrays.append(o.state)
@@ -915,6 +951,126 @@ class TpuBackend:
             raise RuntimeError(f"bloom filter '{target}' is not initialized")
         return obj, obj.meta["size"], obj.meta["hash_iterations"]
 
+    # -- Bloom host mirror (transfer-adaptive ingest) ------------------------
+    #
+    # The bloom analogue of the HLL hostfold, shaped by a different constant:
+    # an HLL folds into 16 KB, but a filter's bitmap is m/8 bytes (32 MB at
+    # m=2^28), so shipping it per run would lose. Instead the filter is
+    # DUAL-RESIDENT: a packed host replica ("mirror") absorbs native k-hash
+    # folds and serves native membership with ZERO link traffic; the device
+    # copy is brought current lazily — one packed OR — only when a
+    # device-side op (device-resident probes, BITCOUNT, export/durability)
+    # actually needs it. Invariants:
+    #   * mirror valid   <=> mir["synced_dev"] == obj.version  (no device
+    #     write since the mirror was built/synced);
+    #   * device current <=> mir["host_v"] == mir["absorbed_v"] (no host
+    #     fold pending absorb).
+    # Every device-path bloom op calls _bloom_device_sync first, so at the
+    # moment a device write bumps obj.version there are never pending host
+    # bits — the two sides never hold disjoint private writes.
+    # Classic layout only (the blocked layout's value is device-side gather
+    # locality; its wire/host story is the classic filter).
+
+    def _bloom_use_host(self, target: str, obj, nkeys: int) -> bool:
+        from redisson_tpu import native as native_mod
+
+        if self.ingest == "device" or obj.meta.get("blocked"):
+            return False
+        if not native_mod.available():
+            return False
+        mir = self._bloom_mirrors.get(target)
+        if mir is not None and mir["synced_dev"] == obj.version:
+            return True  # sticky: a valid mirror keeps serving host ops
+        if self.ingest == "hostfold":
+            return True
+        # auto: adopt a mirror when the link-vs-fold profile says hostfold
+        # (same probe as the HLL path) and the batch is worth it.
+        return hostfold_policy(self.ingest, nkeys, self.store.device)
+
+    def _bloom_mirror(self, target: str, obj, m: int) -> dict:
+        """The current host replica (build/refresh if a device-side write
+        invalidated it). A fresh filter (version 0) mirrors as zeros; an
+        existing one is packed ON DEVICE and pulled once (1 bit per bit
+        over the link, m/8 bytes)."""
+        mir = self._bloom_mirrors.get(target)
+        if mir is not None and mir["synced_dev"] == obj.version:
+            return mir
+        if mir is not None and mir["host_v"] != mir["absorbed_v"]:
+            # Defensive: pending host bits with an invalidated mirror means
+            # some device write path skipped its sync barrier. Push the
+            # host bits down first (ORing true bits is always safe), then
+            # rebuild from the device, which now holds both sides' writes.
+            self._bloom_device_sync(target)
+        nbytes = (m + 7) // 8
+        if obj.version == 0:
+            bits = np.zeros(nbytes, np.uint8)
+        else:
+            bits = np.asarray(engine.bitset_pack(obj.state))[:nbytes].copy()
+        mir = {"bits": bits, "synced_dev": obj.version,
+               "host_v": 0, "absorbed_v": 0}
+        self._bloom_mirrors[target] = mir
+        return mir
+
+    def _bloom_device_sync(self, target: str) -> None:
+        """Absorb host-pending mirror bits into the device filter (one
+        packed upload + OR kernel). Device-side bloom ops and the
+        durability/checkpoint barrier (`bloom_sync` op) call this."""
+        mir = self._bloom_mirrors.get(target)
+        if mir is None or mir["host_v"] == mir["absorbed_v"]:
+            return
+        import jax
+
+        obj = self.store.get(target, ObjectType.BLOOM)
+        was_valid = mir["synced_dev"] == obj.version
+        new = engine.bitset_absorb_packed(
+            obj.state, jax.device_put(mir["bits"], self.store.device))
+        self.store.swap(target, new)
+        mir["absorbed_v"] = mir["host_v"]
+        if was_valid:
+            mir["synced_dev"] = obj.version  # device == mirror right now
+        # else: the mirror was already missing device writes; it stays
+        # invalid and the next host-path op rebuilds it from the device.
+
+    def _op_bloom_sync(self, target: str, ops: List[Op]) -> None:
+        """Barrier: make the device filter include every host-mirror write
+        (no-op when nothing is pending or the name is not a bloom)."""
+        if self.store.get(target) is not None:
+            self._bloom_device_sync(target)
+        for op in ops:
+            op.future.set_result(None)
+
+    def _bloom_host_add(self, target: str, obj, m: int, k: int,
+                        ops: List[Op]) -> None:
+        from redisson_tpu import native as native_mod
+
+        mir = self._bloom_mirror(target, obj, m)
+        for op in ops:
+            p = op.payload
+            if "packed" in p:
+                newly = native_mod.bloom_fold_u64(
+                    p["packed"], mir["bits"], k, m, self.seed)
+            else:
+                newly = native_mod.bloom_fold_rows(
+                    p["data"], p["lengths"], mir["bits"], k, m, self.seed)
+            op.future.set_result(newly.view(np.bool_))  # zero-copy
+        mir["host_v"] += 1
+
+    def _bloom_host_contains(self, target: str, obj, m: int, k: int,
+                             ops: List[Op], count_only: bool = False) -> None:
+        from redisson_tpu import native as native_mod
+
+        mir = self._bloom_mirror(target, obj, m)
+        for op in ops:
+            p = op.payload
+            if "packed" in p:
+                hits = native_mod.bloom_contains_u64(
+                    p["packed"], mir["bits"], k, m, self.seed)
+            else:
+                hits = native_mod.bloom_contains_rows(
+                    p["data"], p["lengths"], mir["bits"], k, m, self.seed)
+            op.future.set_result(
+                int(hits.sum()) if count_only else hits.view(np.bool_))
+
     def _bloom_run(self, target: str, ops: List[Op], mutate: bool) -> None:
         """Shared bloom dispatch: a coalesced run is processed in op order
         (positional result slicing), packed runs coalesce small arrays via
@@ -965,15 +1121,37 @@ class TpuBackend:
                 engine.bloom_add_bytes, engine.bloom_contains_bytes)
 
     def _op_bloom_add(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        nkeys = sum(op.nkeys or self._payload_nkeys(op) for op in ops)
+        if self._bloom_use_host(target, obj, nkeys):
+            self._bloom_host_add(target, obj, m, k, ops)
+            return
+        self._bloom_device_sync(target)
         self._bloom_run(target, ops, mutate=True)
 
     def _op_bloom_contains(self, target: str, ops: List[Op]) -> None:
+        obj, m, k = self._bloom_meta(target)
+        nkeys = sum(op.nkeys or self._payload_nkeys(op) for op in ops)
+        if self._bloom_use_host(target, obj, nkeys):
+            self._bloom_host_contains(target, obj, m, k, ops)
+            return
+        self._bloom_device_sync(target)
         self._bloom_run(target, ops, mutate=False)
 
     def _op_bloom_contains_count(self, target: str, ops: List[Op]) -> None:
         """Hit count per op (host-packed or device-resident keys): chunks
         reduce on device, one int32 scalar rides back per op."""
         obj, m, k = self._bloom_meta(target)
+        host_ops = [op for op in ops if "device_packed" not in op.payload]
+        if host_ops and self._bloom_use_host(
+                target, obj,
+                sum(op.nkeys or self._payload_nkeys(op) for op in host_ops)):
+            self._bloom_host_contains(target, obj, m, k, host_ops,
+                                      count_only=True)
+            ops = [op for op in ops if "device_packed" in op.payload]
+            if not ops:
+                return
+        self._bloom_device_sync(target)
         count_fn = (engine.blocked_bloom_contains_count_packed
                     if obj.meta.get("blocked")
                     else engine.bloom_contains_count_packed)
@@ -1006,8 +1184,16 @@ class TpuBackend:
             op.future.set_result(meta)
 
     def _op_bloom_count(self, target: str, ops: List[Op]) -> None:
+        from redisson_tpu import native as native_mod
+
         obj, m, k = self._bloom_meta(target)
-        bc = int(engine.bitset_cardinality(obj.state))
+        mir = self._bloom_mirrors.get(target)
+        if mir is not None and mir["synced_dev"] == obj.version:
+            # Valid mirror holds every bit: host popcount, zero link traffic.
+            bc = native_mod.popcount(mir["bits"])
+        else:
+            self._bloom_device_sync(target)
+            bc = int(engine.bitset_cardinality(obj.state))
         est = float(bloom_ops.count_estimate(bc, m, k))
         for op in ops:
             op.future.set_result(int(round(est)))
@@ -1022,6 +1208,7 @@ class TpuBackend:
             self._row_versions.pop(target, None)
             res = True
         else:
+            self._bloom_mirrors.pop(target, None)
             res = self.store.delete(target)
         for op in ops:
             op.future.set_result(res)
@@ -1040,6 +1227,7 @@ class TpuBackend:
         self._row_versions.clear()
         self._next_row = 0
         self.bank = None
+        self._bloom_mirrors.clear()
         self.store.flushall()
         for op in ops:
             op.future.set_result(None)

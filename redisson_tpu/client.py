@@ -334,6 +334,14 @@ class RedissonTPU:
                 if exported is not None:
                     regs, version = exported
                     extra[n] = ("hll", regs, {}, version)
+        # Bloom barrier: host-mirror bits must reach device state before the
+        # store snapshot reads it (same reason as the durability flush).
+        from redisson_tpu.store import ObjectType
+
+        for n in (names if names is not None else self._store.keys()):
+            obj = self._store.get(n)
+            if obj is not None and obj.otype == ObjectType.BLOOM:
+                self._executor.execute_sync(n, "bloom_sync", None)
         return checkpoint.save(self._store, path, names, extra_objects=extra)
 
     def load_checkpoint(self, path: str, names=None) -> int:

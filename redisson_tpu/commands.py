@@ -216,6 +216,9 @@ OP_TABLE = {d.kind: d for d in [
     _d("bloom_contains_count", "BITCOUNT", False, "tpu redis"),
     _d("bloom_count", "BITCOUNT", False, "tpu redis"),
     _d("bloom_meta", "HGETALL", False, "tpu redis"),
+    # Barrier flushing host-mirror bloom bits into device state before a
+    # device-side read (durability/checkpoint); internal, no wire analogue.
+    _d("bloom_sync", "-", True, "tpu"),
 ]}
 
 

@@ -341,6 +341,30 @@ def pad_rows_repeat(rows):
     return out
 
 
+@jax.jit
+def bitset_pack(bits):
+    """[m] uint8 cells -> packed bytes (numpy packbits big-endian order:
+    absolute bit i -> byte i>>3, bit 7-(i&7)). The device half of pulling a
+    bloom/bitset to the host mirror: 1 bit/bit over the link, not 1 byte."""
+    m = bits.shape[0]
+    pad = (-m) % 8
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), bits.dtype)])
+    w = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.int32)
+    return jnp.sum(bits.reshape(-1, 8).astype(jnp.int32) * w, axis=1).astype(
+        jnp.uint8)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bitset_absorb_packed(bits, packed):
+    """OR a packed (big-endian) bitmap into [m] uint8 cells — the device
+    half of the bloom hostfold absorb."""
+    m = bits.shape[0]
+    sh = jnp.asarray([7, 6, 5, 4, 3, 2, 1, 0], jnp.uint8)
+    unpacked = ((packed[:, None] >> sh[None, :]) & 1).reshape(-1)[:m]
+    return jnp.maximum(bits, unpacked.astype(bits.dtype))
+
+
 # ---------------------------------------------------------------------------
 # BitSet
 # ---------------------------------------------------------------------------

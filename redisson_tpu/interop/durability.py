@@ -130,6 +130,13 @@ class DurabilityManager:
             obj = self.store.get(n)
             if obj is None:
                 continue
+            if obj.otype == ObjectType.BLOOM and self.executor is not None:
+                # Barrier: pull host-mirror bloom bits down to the device
+                # BEFORE reading state/version — otherwise hostfold-ingested
+                # bits would be invisible to the flush (the sync bumps the
+                # version when anything was pending, keeping dirty tracking
+                # honest).
+                self.executor.execute_sync(n, "bloom_sync", None)
             if only_dirty and self._flushed_versions.get(n) == obj.version:
                 continue
             version = obj.version  # read before export: racing mutations re-flush

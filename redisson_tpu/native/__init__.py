@@ -95,6 +95,20 @@ def _load():
             u64p, ctypes.c_int64, ctypes.c_uint64, u8p, ctypes.c_int32]
         lib.rtpu_hll_fold_rows.argtypes = [
             u8p, ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_uint64, u8p]
+        lib.rtpu_bloom_fold_u64.argtypes = [
+            u64p, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_uint64, u8p, u8p, ctypes.c_int32]
+        lib.rtpu_bloom_contains_u64.argtypes = [
+            u64p, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32,
+            ctypes.c_uint64, u8p, u8p, ctypes.c_int32]
+        lib.rtpu_bloom_fold_rows.argtypes = [
+            u8p, ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.c_uint64, u8p, u8p]
+        lib.rtpu_bloom_contains_rows.argtypes = [
+            u8p, ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.c_uint64, u8p, u8p]
+        lib.rtpu_popcount.argtypes = [u8p, ctypes.c_int64]
+        lib.rtpu_popcount.restype = ctypes.c_uint64
         lib.rtpu_version.restype = ctypes.c_char_p
         _lib = lib
         AVAILABLE = True
@@ -469,6 +483,110 @@ def hll_fold_rows(
         lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
         data.shape[0], ctypes.c_uint64(seed), _u8p(regs))
     return regs
+
+
+def _norm_u64_keys(keys: np.ndarray, who: str) -> np.ndarray:
+    """uint64 [n] or pack_u64 uint32 [n, 2] -> contiguous uint64 [n]."""
+    if keys.dtype == np.uint64:
+        return np.ascontiguousarray(keys)
+    if keys.dtype == np.uint32 and keys.ndim == 2 and keys.shape[1] == 2:
+        return np.ascontiguousarray(keys).view(np.uint64).reshape(-1)
+    raise TypeError(
+        f"{who} wants uint64 [n] or packed uint32 [n, 2] keys, "
+        f"got {keys.dtype} {keys.shape}"
+    )
+
+
+def bloom_fold_u64(keys: np.ndarray, bits: np.ndarray, k: int, m: int,
+                   seed: int = 0, want_newly: bool = True,
+                   nthreads: int = 0) -> Optional[np.ndarray]:
+    """Fold u64 keys into a packed bloom bitmap in-place (numpy packbits
+    big-endian layout; index walk identical to ops/bloom.py indexes()).
+    Returns the per-key newly-set mask (uint8 [n]) when want_newly, else
+    None. The transfer-adaptive bloom ingest's host half: ship/OR the
+    bitmap once instead of 8 B/key + per-key bools over a slow link.
+    Requires the native library (callers gate on available())."""
+    assert bits.dtype == np.uint8 and bits.shape == ((m + 7) // 8,)
+    keys = _norm_u64_keys(keys, "bloom_fold_u64")
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if nthreads <= 0:
+        nthreads = os.cpu_count() or 1
+    newly = np.empty(keys.shape[0], np.uint8) if want_newly else None
+    lib.rtpu_bloom_fold_u64(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        keys.shape[0], ctypes.c_uint64(seed), ctypes.c_int32(k),
+        ctypes.c_uint64(m), _u8p(bits),
+        _u8p(newly) if newly is not None else None,
+        ctypes.c_int32(nthreads))
+    return newly
+
+
+def bloom_contains_u64(keys: np.ndarray, bits: np.ndarray, k: int, m: int,
+                       seed: int = 0, nthreads: int = 0) -> np.ndarray:
+    """Membership probe of u64 keys against a packed bitmap -> uint8 [n]."""
+    assert bits.dtype == np.uint8 and bits.shape == ((m + 7) // 8,)
+    keys = _norm_u64_keys(keys, "bloom_contains_u64")
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if nthreads <= 0:
+        nthreads = os.cpu_count() or 1
+    out = np.empty(keys.shape[0], np.uint8)
+    lib.rtpu_bloom_contains_u64(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        keys.shape[0], ctypes.c_uint64(seed), ctypes.c_int32(k),
+        ctypes.c_uint64(m), _u8p(bits), _u8p(out), ctypes.c_int32(nthreads))
+    return out
+
+
+def bloom_fold_rows(data: np.ndarray, lengths: np.ndarray, bits: np.ndarray,
+                    k: int, m: int, seed: int = 0,
+                    want_newly: bool = True) -> Optional[np.ndarray]:
+    """Byte-key ([n, w] + lengths) bloom fold into a packed bitmap."""
+    assert bits.dtype == np.uint8 and bits.shape == ((m + 7) // 8,)
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    data = np.ascontiguousarray(data, np.uint8)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    newly = np.empty(data.shape[0], np.uint8) if want_newly else None
+    lib.rtpu_bloom_fold_rows(
+        _u8p(data), data.shape[1],
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.shape[0], ctypes.c_uint64(seed), ctypes.c_int32(k),
+        ctypes.c_uint64(m), _u8p(bits),
+        _u8p(newly) if newly is not None else None)
+    return newly
+
+
+def bloom_contains_rows(data: np.ndarray, lengths: np.ndarray,
+                        bits: np.ndarray, k: int, m: int,
+                        seed: int = 0) -> np.ndarray:
+    """Byte-key membership probe against a packed bitmap -> uint8 [n]."""
+    assert bits.dtype == np.uint8 and bits.shape == ((m + 7) // 8,)
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    data = np.ascontiguousarray(data, np.uint8)
+    lengths = np.ascontiguousarray(lengths, np.int32)
+    out = np.empty(data.shape[0], np.uint8)
+    lib.rtpu_bloom_contains_rows(
+        _u8p(data), data.shape[1],
+        lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        data.shape[0], ctypes.c_uint64(seed), ctypes.c_int32(k),
+        ctypes.c_uint64(m), _u8p(bits), _u8p(out))
+    return out
+
+
+def popcount(bits: np.ndarray) -> int:
+    """Population count of a packed uint8 buffer (host BITCOUNT)."""
+    lib = _load()
+    bits = np.ascontiguousarray(bits, np.uint8)
+    if lib is None:
+        return int(np.unpackbits(bits).sum())
+    return int(lib.rtpu_popcount(_u8p(bits), bits.shape[0]))
 
 
 def version() -> str:

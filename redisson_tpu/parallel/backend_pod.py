@@ -20,7 +20,10 @@ from typing import List
 import numpy as np
 
 from redisson_tpu import engine
-from redisson_tpu.backend_tpu import TpuBackend, _complete_all, _start_d2h
+from redisson_tpu.backend_tpu import (
+    TpuBackend, _complete_all, _start_d2h, backend_names,
+    complete_changed_rows,
+)
 from redisson_tpu.executor import Op
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.parallel import sharded
@@ -115,13 +118,7 @@ class PodBackend:
 
     def names(self, pattern: str = "*") -> List[str]:
         """Bank-resident names + delegate-store names (RKeys support)."""
-        import fnmatch
-
-        out = dict.fromkeys(self.store.keys(pattern))
-        for n in self._rows:
-            if pattern in (None, "*") or fnmatch.fnmatchcase(n, pattern):
-                out[n] = None
-        return list(out)
+        return backend_names(self.store, self._rows, pattern)
 
     # -- lifecycle ops must see bank-resident HLLs too ----------------------
 
@@ -198,10 +195,6 @@ class PodBackend:
         # RTT per chunk — the same serialization the single-chip backend
         # shed in r3, VERDICT r2 weak #1). bank_insert returns PER-ROW
         # change flags, so each op gets its own target's PFADD bool.
-        import functools as _ft
-
-        import jax.numpy as jnp
-
         parts = []
         for pre_hashed, (his, los, rows) in groups.items():
             if not his:
@@ -222,22 +215,7 @@ class PodBackend:
         for op in ops:
             self._row_versions[op.target] = self._row_versions.get(op.target, 0) + 1
             op_rows.append(self._rows[op.target])
-        flag = _start_d2h(_ft.reduce(jnp.logical_or, parts)) if parts else None
-
-        def run():
-            try:
-                host = None if flag is None else np.asarray(flag)
-            except Exception as exc:  # noqa: BLE001
-                for op in ops:
-                    if not op.future.done():
-                        op.future.set_exception(exc)
-                return
-            for op, r in zip(ops, op_rows):
-                if not op.future.done():
-                    op.future.set_result(
-                        False if host is None else bool(host[r]))
-
-        self.completer.submit(run)
+        complete_changed_rows(self.completer, ops, op_rows, parts)
 
     def _op_hll_count(self, target: str, ops: List[Op]) -> None:
         row = self._rows.get(target)

@@ -1,0 +1,158 @@
+"""Bloom host mirror (transfer-adaptive ingest) — VERDICT r4 item #2.
+
+The filter is dual-resident: a packed host replica absorbs native k-hash
+folds and serves native membership with zero link traffic; the device copy
+is brought current by the `bloom_sync` barrier only when a device-side op
+needs it. These tests force ingest='hostfold' so the mirror path runs on
+the CPU suite (the auto policy picks the device path on a fast local link).
+"""
+
+import numpy as np
+import pytest
+
+from redisson_tpu import native
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config, TpuConfig
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built")
+
+
+@pytest.fixture()
+def hclient():
+    c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="hostfold")))
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture()
+def dclient():
+    c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="device")))
+    yield c
+    c.shutdown()
+
+
+def _backend(c):
+    return c._routing.sketch
+
+
+def test_mirror_matches_device_path(hclient, dclient):
+    """Same keys through mirror and device paths -> identical membership
+    and identical device bit arrays after a sync barrier."""
+    keys = np.random.default_rng(0).integers(0, 2**63, 5000, np.uint64)
+    strs = [b"s%d" % i for i in range(1000)]
+    for c in (hclient, dclient):
+        bf = c.get_bloom_filter("bm:eq")
+        assert bf.try_init(20_000, 0.01)
+        bf.add_ints(keys)
+        bf.add_all(strs)
+    hclient._executor.execute_sync("bm:eq", "bloom_sync", None)
+    hb = np.asarray(hclient._store.get("bm:eq").state)
+    db = np.asarray(dclient._store.get("bm:eq").state)
+    assert np.array_equal(hb, db)
+    # membership agrees on hits and (statistically) on misses
+    assert hclient.get_bloom_filter("bm:eq").contains_ints(keys).all()
+    assert dclient.get_bloom_filter("bm:eq").contains_ints(keys).all()
+    fresh = np.random.default_rng(9).integers(2**63, 2**64, 5000, np.uint64)
+    hm = hclient.get_bloom_filter("bm:eq").contains_ints(fresh)
+    dm = dclient.get_bloom_filter("bm:eq").contains_ints(fresh)
+    assert np.array_equal(hm, dm)
+
+
+def test_add_returns_per_key_newly(hclient):
+    bf = hclient.get_bloom_filter("bm:newly")
+    bf.try_init(10_000, 0.01)
+    first = bf.add_all([b"a", b"b", b"c"])
+    assert list(first) == [True, True, True]
+    again = bf.add_all([b"a", b"b", b"d"])
+    assert list(again) == [False, False, True]
+
+
+def test_count_and_contains_count_use_mirror(hclient):
+    bf = hclient.get_bloom_filter("bm:count")
+    bf.try_init(50_000, 0.01)
+    keys = np.arange(10_000, dtype=np.uint64)
+    bf.add_ints(keys)
+    est = bf.count()
+    assert abs(est - 10_000) / 10_000 < 0.05
+    assert bf.contains_count_ints(keys) == 10_000
+    # No device work should have happened yet for this filter's bits.
+    obj = hclient._store.get("bm:count")
+    assert obj.version == 0
+
+
+def test_device_probe_syncs_pending_mirror(hclient):
+    """contains_count_device_async must see host-folded bits (the sync
+    barrier ships the packed mirror to the device)."""
+    import jax.numpy as jnp
+
+    bf = hclient.get_bloom_filter("bm:dev")
+    bf.try_init(10_000, 0.01)
+    keys = np.arange(3000, dtype=np.uint64)
+    bf.add_ints(keys)
+    packed = jnp.asarray(
+        np.stack([(keys & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                  (keys >> np.uint64(32)).astype(np.uint32)], axis=1))
+    hits = bf.contains_count_device_async(packed).result()
+    assert hits == 3000
+
+
+def test_device_write_invalidates_mirror(hclient):
+    """A device-path write after host folds: sync absorbs host bits first,
+    then the mirror rebuilds on the next host op — no lost writes in
+    either direction."""
+    back = _backend(hclient)
+    bf = hclient.get_bloom_filter("bm:mix")
+    bf.try_init(10_000, 0.01)
+    bf.add_all([b"host-side"])
+    # Force a device-path write under the mirror's feet.
+    hclient._executor.execute_sync("bm:mix", "bloom_sync", None)
+    back.ingest = "device"
+    bf.add_all([b"device-side"])
+    back.ingest = "hostfold"
+    assert bf.contains(b"host-side")
+    assert bf.contains(b"device-side")
+    assert not bf.contains(b"neither")
+
+
+def test_durability_flush_includes_mirror_bits(hclient):
+    from redisson_tpu.interop.durability import DurabilityManager
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    bf = hclient.get_bloom_filter("bm:flush")
+    bf.try_init(5000, 0.01)
+    bf.add_all([b"f%d" % i for i in range(500)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            dm = DurabilityManager(
+                hclient._store, rc, executor=hclient._executor,
+                pod_backend=hclient._pod_backend())
+            assert dm.flush(["bm:flush"]) == 1
+            raw = bytes(rc.execute("GET", "bm:flush"))
+    # the flushed blob must carry exactly the host-folded bits
+    flushed_pop = int(np.unpackbits(np.frombuffer(raw, np.uint8)).sum())
+    mirror_pop = native.popcount(_backend(hclient)._bloom_mirrors["bm:flush"]["bits"])
+    assert flushed_pop == mirror_pop > 0
+
+
+def test_checkpoint_includes_mirror_bits(tmp_path, hclient):
+    bf = hclient.get_bloom_filter("bm:ckpt")
+    bf.try_init(5000, 0.01)
+    bf.add_all([b"c%d" % i for i in range(300)])
+    path = str(tmp_path / "ck")
+    hclient.save_checkpoint(path, names=["bm:ckpt"])
+    hclient.flushall()
+    hclient.load_checkpoint(path)
+    bf2 = hclient.get_bloom_filter("bm:ckpt")
+    assert bf2.contains_all([b"c%d" % i for i in range(300)]).all()
+
+
+def test_blocked_filter_stays_on_device_path(hclient):
+    """Blocked layout has no host mirror: ops run the device kernels even
+    under ingest='hostfold'."""
+    bf = hclient.get_bloom_filter("bm:blk")
+    bf.try_init(5000, 0.01, blocked=True)
+    bf.add_all([b"x%d" % i for i in range(500)])
+    assert bf.contains_all([b"x%d" % i for i in range(500)]).all()
+    assert "bm:blk" not in _backend(hclient)._bloom_mirrors

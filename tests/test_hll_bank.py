@@ -134,6 +134,16 @@ def test_wrongtype_both_directions(client):
         client.get_bloom_filter("wt:hll").try_init(100, 0.01)
 
 
+def test_bitop_with_hll_source_raises_wrongtype(client):
+    """BITOP sources that are bank HLLs must raise WRONGTYPE, not be
+    silently skipped (review r4: HLLs left the store, so store.get no
+    longer guards this path)."""
+    client.get_hyper_log_log("bo:h").add(b"x")
+    client.get_bit_set("bo:dest").set(1)
+    with pytest.raises(WrongTypeError):
+        client.get_bit_set("bo:dest").or_("bo:h")
+
+
 def test_flushall_drops_bank(client):
     back = _tpu_backend(client)
     client.get_hyper_log_log("fa:h").add(b"k")
