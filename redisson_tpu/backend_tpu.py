@@ -179,6 +179,56 @@ def backend_names(store: SketchStore, extra_names, pattern: str = "*"):
     return list(out)
 
 
+class RowAllocator:
+    """name -> bank-row bookkeeping shared by the single-chip and pod
+    backends: free-list reuse, elastic grow-on-full, per-name mutation
+    counters (durability/checkpoint dirty tracking). `grow` is the
+    backend's capacity hook: called with the requested new capacity, it
+    reallocates the bank and returns the (possibly rounded-up) actual
+    capacity."""
+
+    __slots__ = ("rows", "free", "next", "versions", "capacity", "_grow")
+
+    def __init__(self, capacity: int, grow: Callable[[int], int]):
+        self.rows: dict = {}
+        self.free: list = []
+        self.next = 0
+        self.versions: dict = {}
+        self.capacity = capacity
+        self._grow = grow
+
+    def row_of(self, name: str) -> int:
+        row = self.rows.get(name)
+        if row is not None:
+            return row
+        if self.free:
+            row = self.free.pop()
+        else:
+            if self.next >= self.capacity:
+                self.capacity = self._grow(self.capacity * 2)
+            row = self.next
+            self.next += 1
+        self.rows[name] = row
+        return row
+
+    def release(self, name: str) -> Optional[int]:
+        """Free the name's row for reuse; returns it (None if absent)."""
+        row = self.rows.pop(name, None)
+        if row is not None:
+            self.free.append(row)
+            self.versions.pop(name, None)
+        return row
+
+    def bump(self, name: str) -> None:
+        self.versions[name] = self.versions.get(name, 0) + 1
+
+    def clear(self) -> None:
+        self.rows.clear()
+        self.free.clear()
+        self.versions.clear()
+        self.next = 0
+
+
 class LinkProfile:
     """One-time measurement of the host->device link and the native fold.
 
@@ -311,18 +361,34 @@ class TpuBackend:
         self.seed = seed
         self.ingest = ingest
         self.completer = Completer()
-        # HLL bank: lazy [S, m] int32 device array + name -> row map.
+        # HLL bank: lazy [S, m] int32 device array + shared row bookkeeping.
         self.bank = None
-        self.bank_capacity = max(1, bank_capacity)
-        self._rows: dict = {}
-        self._free_rows: list = []
-        self._next_row = 0
-        # name -> mutation counter (durability/checkpoint dirty tracking —
-        # same surface as PodBackend.row_version).
-        self._row_versions: dict = {}
+        self._alloc = RowAllocator(max(1, bank_capacity), self._grow_bank)
         # name -> packed host replica of a bloom filter (see the Bloom host
         # mirror section).
         self._bloom_mirrors: dict = {}
+
+    # row-map views (tests and the durability duck type read these)
+    @property
+    def _rows(self) -> dict:
+        return self._alloc.rows
+
+    @property
+    def _row_versions(self) -> dict:
+        return self._alloc.versions
+
+    @property
+    def bank_capacity(self) -> int:
+        return self._alloc.capacity
+
+    @bank_capacity.setter
+    def bank_capacity(self, v: int) -> None:
+        self._alloc.capacity = v
+
+    def _grow_bank(self, new_cap: int) -> int:
+        """RowAllocator grow hook: double the device bank in place."""
+        self.bank = engine.hll_bank_grow(self._ensure_bank(), new_cap)
+        return new_cap
 
     def _use_hostfold(self, nkeys: int) -> bool:
         return hostfold_policy(self.ingest, nkeys, self.store.device)
@@ -369,7 +435,7 @@ class TpuBackend:
     def _hll_row(self, name: str, create: bool = True):
         """name -> bank row (WRONGTYPE if the store holds the name as a
         bitset/bloom — the bank is the HLL half of the keyspace)."""
-        row = self._rows.get(name)
+        row = self._alloc.rows.get(name)
         if row is not None:
             return row
         other = self.store.get(name)
@@ -380,18 +446,7 @@ class TpuBackend:
         if not create:
             return None
         self._ensure_bank()
-        if self._free_rows:
-            row = self._free_rows.pop()
-        else:
-            if self._next_row >= self.bank_capacity:
-                # Elastic capacity: double in place, row indices stable.
-                new_cap = self.bank_capacity * 2
-                self.bank = engine.hll_bank_grow(self.bank, new_cap)
-                self.bank_capacity = new_cap
-            row = self._next_row
-            self._next_row += 1
-        self._rows[name] = row
-        return row
+        return self._alloc.row_of(name)
 
     def _check_not_hll(self, name: str, otype: str) -> None:
         if name in self._rows:
@@ -400,7 +455,7 @@ class TpuBackend:
             )
 
     def _bump(self, name: str) -> None:
-        self._row_versions[name] = self._row_versions.get(name, 0) + 1
+        self._alloc.bump(name)
 
     # durability/checkpoint surface (same duck type as PodBackend — the
     # client's _pod_backend() probe picks this up, so bank rows flush and
@@ -409,7 +464,7 @@ class TpuBackend:
         return list(self._rows)
 
     def row_version(self, name: str) -> int:
-        return self._row_versions.get(name, 0)
+        return self._alloc.versions.get(name, 0)
 
     def names(self, pattern: str = "*") -> List[str]:
         return backend_names(self.store, self._rows, pattern)
@@ -1201,11 +1256,9 @@ class TpuBackend:
     # -- generic ------------------------------------------------------------
 
     def _op_delete(self, target: str, ops: List[Op]) -> None:
-        row = self._rows.pop(target, None)
+        row = self._alloc.release(target)
         if row is not None:
             self.bank = engine.hll_bank_zero_row(self.bank, np.int32(row))
-            self._free_rows.append(row)
-            self._row_versions.pop(target, None)
             res = True
         else:
             self._bloom_mirrors.pop(target, None)
@@ -1222,10 +1275,7 @@ class TpuBackend:
         # Runs on the dispatcher thread, so it is serialized against every
         # other op (no mid-kernel store mutation). The bank is dropped, not
         # zeroed — lazily reallocated on the next HLL touch.
-        self._rows.clear()
-        self._free_rows.clear()
-        self._row_versions.clear()
-        self._next_row = 0
+        self._alloc.clear()
         self.bank = None
         self._bloom_mirrors.clear()
         self.store.flushall()

@@ -200,15 +200,15 @@ OP_TABLE = {d.kind: d for d in [
     _d("hll_count", "PFCOUNT", False, "tpu redis"),
     _d("hll_count_with", "PFCOUNT", False, "tpu redis"),
     _d("hll_merge_with", "PFMERGE", True, "tpu redis"),
-    _d("hll_export", "-", False, "tpu"),
+    _d("hll_export", "GET", False, "tpu redis"),
     _d("hll_import", "RESTORE", True, "tpu"),
     _d("bitset_set", "SETBIT", True, "tpu redis"),
     _d("bitset_clear", "SETBIT", True, "tpu redis"),
     _d("bitset_get", "GETBIT", False, "tpu redis"),
     _d("bitset_cardinality", "BITCOUNT", False, "tpu redis"),
-    _d("bitset_length", "BITPOS", False, "tpu"),
+    _d("bitset_length", "GETRANGE", False, "tpu redis"),
     _d("bitset_size", "STRLEN", False, "tpu redis"),
-    _d("bitset_set_range", "SETBIT", True, "tpu"),
+    _d("bitset_set_range", "SETBIT", True, "tpu redis"),
     _d("bitset_op", "BITOP", True, "tpu redis"),
     _d("bloom_init", "LUA", True, "tpu redis"),
     _d("bloom_add", "SETBIT", True, "tpu redis"),

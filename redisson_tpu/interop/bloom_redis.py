@@ -113,16 +113,35 @@ class RedisBloomMixin:
             [str(m), str(k), str(n), repr(float(prob))])
         op.future.set_result(res == 1)
 
-    def _bloom_cfg(self, key: str):
+    def _bloom_cfg(self, key: str, allow_blocked: bool = False):
+        from redisson_tpu.interop.backend_redis import UnsupportedInRedisMode
+
         pairs = self._x("HGETALL", _bloom_cfg_key(key))
         if not pairs:
             raise RuntimeError(f"bloom filter '{key}' is not initialized")
         cfg = {bytes(pairs[i]).decode(): bytes(pairs[i + 1]).decode()
                for i in range(0, len(pairs), 2)}
+        if not allow_blocked and cfg.get("blocked") in ("1", "true", "True"):
+            # A blocked-layout filter flushed from the TPU tier: the classic
+            # (h1 + i*h2) mod m walk below would silently return false
+            # negatives against blocked-layout bits — refuse loudly instead
+            # (same guard as _op_bloom_init; advisor r3 medium).
+            raise UnsupportedInRedisMode(
+                f"bloom filter '{key}' uses the blocked (TPU-tier) layout; "
+                "redis mode cannot answer it — re-add into a classic filter")
         return int(cfg["size"]), int(cfg["hashIterations"]), cfg
 
     def _bloom_keys_of(self, op: Op) -> List[bytes]:
+        from redisson_tpu.interop.backend_redis import UnsupportedInRedisMode
+
         p = op.payload
+        if "device_packed" in p:
+            # No opaque KeyError: device-resident key batches are a TPU-tier
+            # surface (advisor r3 low).
+            raise UnsupportedInRedisMode(
+                "device-resident key batches are not available in redis "
+                "mode; use contains_count_ints / contains_ints with host "
+                "keys")
         if "packed" in p:
             import numpy as np
 
@@ -179,7 +198,9 @@ class RedisBloomMixin:
             int(round(bloom_math.count_estimate(int(bc), m, k))))
 
     def _op_bloom_meta(self, key: str, op: Op) -> None:
-        m, k, cfg = self._bloom_cfg(key)
+        # meta is layout-independent introspection (is_blocked() reads it),
+        # so the blocked guard does not apply here.
+        m, k, cfg = self._bloom_cfg(key, allow_blocked=True)
         op.future.set_result({
             "size": m,
             "hash_iterations": k,

@@ -417,6 +417,27 @@ class FakeRedisServer:
         self.data[k] = bytes(a[1])
         return _int(1)
 
+    def _cmd_getrange(self, a):
+        v = self.data.get(bytes(a[0]), b"")
+        if not isinstance(v, bytes):
+            raise ValueError("WRONGTYPE")
+        s, e = int(a[1]), int(a[2])
+        n = len(v)
+        if s < 0:
+            s = max(0, n + s)
+        if e < 0:
+            e = n + e
+        return _bulk(v[s:e + 1] if e >= s else b"")
+
+    def _cmd_setrange(self, a):
+        k, off, val = bytes(a[0]), int(a[1]), bytes(a[2])
+        buf = bytearray(self.data.get(k, b""))
+        if len(buf) < off + len(val):
+            buf.extend(b"\x00" * (off + len(val) - len(buf)))
+        buf[off:off + len(val)] = val
+        self.data[k] = bytes(buf)
+        return _int(len(self.data[k]))
+
     def _cmd_getset(self, a):
         k = bytes(a[0])
         old = self.data.get(k)

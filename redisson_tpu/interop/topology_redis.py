@@ -94,13 +94,20 @@ class WeightedRoundRobinBalancer:
                         for k, v in weights.items()}
         self.default_weight = max(1, int(default_weight))
         self._i = 0
+        # wheel cached per live-set: rebuilding an O(sum-of-weights) list on
+        # every read (under the router lock) was hot-path waste (advisor r3)
+        self._wheel_key: tuple = ()
+        self._wheel: List[str] = []
 
     def choose(self, live: List[str]) -> str:
-        wheel: List[str] = []
-        for a in live:
-            wheel.extend([a] * self.weights.get(a, self.default_weight))
+        key = tuple(live)
+        if key != self._wheel_key:
+            wheel: List[str] = []
+            for a in live:
+                wheel.extend([a] * self.weights.get(a, self.default_weight))
+            self._wheel, self._wheel_key = wheel, key
         self._i += 1
-        return wheel[self._i % len(wheel)]
+        return self._wheel[self._i % len(self._wheel)]
 
 
 def make_balancer(spec: str, weights: Optional[Dict[str, int]] = None,
@@ -638,16 +645,33 @@ class ClusterRouter(MasterSlaveRouter):
                 replies = self._run_on(addr, "pipeline", cmds)
             except (ConnectionError, OSError, TimeoutError):
                 # One blip must not void the other groups' (possibly
-                # already-applied) results: re-resolve the owner once (the
-                # freeze/rescan may have re-pointed it) and retry; a second
-                # failure lands per-command RespErrors in the reply list,
-                # keeping the pipeline contract of in-list errors.
-                try:
-                    retry_addr = self._endpoint_for(cmds[0], write=True)
-                    replies = self._run_on(retry_addr, "pipeline", cmds)
-                except Exception as exc:  # noqa: BLE001
-                    replies = [RespError(f"CONNECTIONFAIL {addr}: {exc}")
-                               for _ in cmds]
+                # already-applied) results. A concurrent rescan may have
+                # SPLIT this group's slots across owners, so the retry
+                # re-resolves EVERY command (not just cmds[0]) and resends
+                # per new owner; a second failure lands per-command
+                # RespErrors in the reply list, keeping the pipeline
+                # contract of in-list errors (advisor r3).
+                # NOTE at-least-once semantics: a command that already
+                # applied on the half-failed first attempt is applied again
+                # by the resend — the reference's batch resend carries the
+                # same caveat (CommandBatchService.java:332-343).
+                retry_groups: Dict[str, List[int]] = {}
+                for i in idxs:
+                    try:
+                        raddr = self._endpoint_for(commands[i], write=True)
+                    except Exception:  # noqa: BLE001 — no owner resolvable
+                        raddr = addr
+                    retry_groups.setdefault(raddr, []).append(i)
+                for raddr, ridxs in retry_groups.items():
+                    rcmds = [commands[i] for i in ridxs]
+                    try:
+                        rs = self._run_on(raddr, "pipeline", rcmds)
+                    except Exception as exc:  # noqa: BLE001
+                        rs = [RespError(f"CONNECTIONFAIL {raddr}: {exc}")
+                              for _ in rcmds]
+                    for i, r in zip(ridxs, rs):
+                        out[i] = r
+                continue
             for i, r in zip(idxs, replies):
                 out[i] = r
         for i, r in enumerate(out):

@@ -21,9 +21,10 @@ import numpy as np
 
 from redisson_tpu import engine
 from redisson_tpu.backend_tpu import (
-    TpuBackend, _complete_all, _start_d2h, backend_names,
+    RowAllocator, TpuBackend, _complete_all, _start_d2h, backend_names,
     complete_changed_rows,
 )
+from redisson_tpu.store import WrongTypeError
 from redisson_tpu.executor import Op
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.parallel import sharded
@@ -37,20 +38,36 @@ class PodBackend:
     def __init__(self, cfg):
         self.mesh = build_mesh(cfg.num_shards)
         self.seed = cfg.hash_seed
-        self.bank_capacity = cfg.bank_capacity
+        cap = cfg.bank_capacity
         ndev = self.mesh.devices.size
-        if self.bank_capacity % ndev:
-            self.bank_capacity += ndev - self.bank_capacity % ndev
-        self.bank = sharded.make_bank(self.mesh, self.bank_capacity)
-        self._rows: dict = {}  # name -> row
-        self._free_rows: list = []  # rows returned by delete, for reuse
-        self._next_row = 0
-        # name -> mutation counter: the durability tier's dirty tracking
-        # (the store-version analogue for bank-resident sketches).
-        self._row_versions: dict = {}
-        # Non-HLL ops delegate to a single-device backend.
+        if cap % ndev:
+            cap += ndev - cap % ndev
+        # Shared row bookkeeping (free-list reuse, grow-on-full, dirty
+        # counters) lives in backend_tpu.RowAllocator for both tiers.
+        self._alloc = RowAllocator(cap, self._grow_hook)
+        self.bank = sharded.make_bank(self.mesh, cap)
+        # Non-HLL ops delegate to a single-device backend. The delegate
+        # SHARES this allocator so its _check_not_hll guards (bitset/bloom
+        # ops colliding with a bank HLL name) see pod-tier rows too.
         self.store = SketchStore(device=self.mesh.devices.flat[0])
         self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed)
+        self._delegate._alloc = self._alloc
+
+    @property
+    def _rows(self) -> dict:
+        return self._alloc.rows
+
+    @property
+    def _row_versions(self) -> dict:
+        return self._alloc.versions
+
+    @property
+    def bank_capacity(self) -> int:
+        return self._alloc.capacity
+
+    @bank_capacity.setter
+    def bank_capacity(self, v: int) -> None:
+        self._alloc.capacity = v
 
     @property
     def completer(self):
@@ -61,29 +78,27 @@ class PodBackend:
     # -- routing ------------------------------------------------------------
 
     def row_of(self, name: str) -> int:
-        row = self._rows.get(name)
-        if row is None:
-            if self._free_rows:
-                row = self._free_rows.pop()
-            else:
-                if self._next_row >= self.bank_capacity:
-                    # Elastic repartitioning (the live-slot-migration
-                    # analogue, ClusterConnectionManager.java:457-541):
-                    # double the bank in place instead of failing.
-                    self._grow_bank(self.bank_capacity * 2)
-                row = self._next_row
-                self._next_row += 1
-            self._rows[name] = row
-        return row
+        row = self._alloc.rows.get(name)
+        if row is not None:
+            return row
+        if self.store.get(name) is not None:
+            # Same keyspace rule as the single-chip tier: a name held by
+            # the delegate store (bitset/bloom/...) cannot double as a bank
+            # HLL (review r4: pod mode skipped these cross-type guards).
+            raise WrongTypeError(
+                f"key '{name}' holds {self.store.get(name).otype}, "
+                "operation needs hll")
+        return self._alloc.row_of(name)
 
-    def _grow_bank(self, new_capacity: int) -> None:
-        """Re-lay the bank onto a larger [S', m] allocation, keeping shard
-        layout; old rows keep their indices (no routing churn)."""
+    def _grow_hook(self, new_capacity: int) -> int:
+        """RowAllocator grow hook — elastic repartitioning (the
+        live-slot-migration analogue, ClusterConnectionManager.java:457-541):
+        double the bank in place, rounded to a device multiple."""
         ndev = self.mesh.devices.size
         if new_capacity % ndev:
             new_capacity += ndev - new_capacity % ndev
         self.bank = sharded.grow_bank(self.bank, new_capacity, self.mesh)
-        self.bank_capacity = new_capacity
+        return new_capacity
 
     def reshard(self, num_shards: int) -> None:
         """Migrate the bank onto a mesh of `num_shards` devices — the
@@ -123,11 +138,9 @@ class PodBackend:
     # -- lifecycle ops must see bank-resident HLLs too ----------------------
 
     def _op_delete(self, target: str, ops: List[Op]) -> None:
-        row = self._rows.pop(target, None)
+        row = self._alloc.release(target)
         if row is not None:
             self.bank = sharded.zero_row(self.bank, row)
-            self._free_rows.append(row)
-            self._row_versions.pop(target, None)
             for op in ops:
                 op.future.set_result(True)
             return
@@ -141,10 +154,7 @@ class PodBackend:
         self._delegate.run("exists", target, ops)
 
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
-        self._rows.clear()
-        self._free_rows.clear()
-        self._row_versions.clear()
-        self._next_row = 0
+        self._alloc.clear()
         self.bank = sharded.make_bank(self.mesh, self.bank_capacity)
         self.store.flushall()
         for op in ops:
@@ -272,7 +282,7 @@ class PodBackend:
         return list(self._rows)
 
     def row_version(self, name: str) -> int:
-        return self._row_versions.get(name, 0)
+        return self._alloc.versions.get(name, 0)
 
     def _op_hll_export(self, target: str, ops: List[Op]) -> None:
         """(registers uint8[m], version) of a bank row; falls back to the

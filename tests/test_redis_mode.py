@@ -302,3 +302,63 @@ def test_bloom_wire_accepts_large_non_pow2_size(rclient):
     assert bf.try_init(300_000_000, 0.01) is True
     assert bf.get_size() > (1 << 31)
     assert not bf.contains("other")
+
+
+def test_bitset_length_over_redis(rclient):
+    """Wire-tier lengthAsync parity (RedissonBitSet.java:181-192): logical
+    length = highest set bit + 1, matching the TPU tier's semantics."""
+    bs = rclient.get_bit_set("rm:blen")
+    assert bs.length() == 0
+    bs.set(0)
+    assert bs.length() == 1
+    bs.set(7)
+    assert bs.length() == 8
+    bs.set(100)
+    assert bs.length() == 101
+    bs.set(65_000)
+    assert bs.length() == 65_001
+    bs.clear_bits([65_000])
+    assert bs.length() == 101
+
+
+def test_bitset_set_range_over_redis(rclient):
+    """Range set/clear over the wire (RedissonBitSet.java:203-228) — edge
+    bits + aligned SETRANGE middle must agree bit-for-bit with per-bit."""
+    bs = rclient.get_bit_set("rm:brange")
+    bs.set_range(3, 75)  # spans edges + 8 full bytes
+    assert bs.cardinality() == 72
+    assert not bs.get(2) and bs.get(3) and bs.get(74) and not bs.get(75)
+    bs.set_range(10, 20, value=False)
+    assert bs.cardinality() == 72 - 10
+    assert bs.get(9) and not bs.get(10) and not bs.get(19) and bs.get(20)
+    # clear past the end must not grow the backing string — including the
+    # UNALIGNED edge-bit path (review r4: SETBIT 0 zero-pads)
+    bs2 = rclient.get_bit_set("rm:brange2")
+    bs2.set(5)
+    bs2.set_range(1000, 5000, value=False)
+    assert bs2.size() <= 8  # still one byte
+    bs2.set_range(1001, 5003, value=False)  # unaligned edges
+    assert bs2.size() <= 8
+    bs2.set_range(3, 5003, value=False)  # straddles the current end
+    assert bs2.size() <= 8
+    assert bs2.get(5) is False and bs2.cardinality() == 0
+
+
+def test_hll_export_over_redis(rclient):
+    """hll_export decodes the server's HYLL blob into raw registers —
+    re-importable (PFCOUNT-stable through an export/import cycle)."""
+    h = rclient.get_hyper_log_log("rm:hexp")
+    h.add_all([b"e%d" % i for i in range(20_000)])
+    est = h.count()
+    regs, version = rclient._executor.execute_sync("rm:hexp", "hll_export", None)
+    assert regs.shape == (16384,) and regs.dtype.name == "uint8"
+    assert int(regs.max()) > 0
+    # registers reconstruct the same estimate through the decoder's math
+    from redisson_tpu.interop import hyll
+    import numpy as np
+
+    blob = hyll.encode_dense(regs)
+    back = hyll.decode(blob)
+    assert np.array_equal(back.astype(np.uint8), regs)
+    assert rclient._executor.execute_sync("rm:none", "hll_export", None) is None
+    assert abs(est - 20_000) / 20_000 < 0.05

@@ -286,3 +286,82 @@ def test_multimap_cache_put_after_full_expiry(client):
     assert mm.put("k", "new") is True
     assert mm.get_all("k") == {"new"}
     assert mm.contains_key("k") is True
+
+
+def test_wire_tier_refuses_blocked_bloom(client):
+    """A blocked-layout filter flushed from the TPU tier must be REFUSED by
+    the wire tier, not silently mis-answered: the classic index walk over
+    blocked-layout bits returns false negatives (advisor r3 medium)."""
+    from redisson_tpu.interop.backend_redis import UnsupportedInRedisMode
+    from redisson_tpu.interop.durability import DurabilityManager
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    bf = client.get_bloom_filter("regr:blk")
+    bf.try_init(2000, 0.01, blocked=True)
+    bf.add_all([b"b%d" % i for i in range(200)])
+    with EmbeddedRedis() as er:
+        with SyncRespClient(port=er.port) as rc:
+            DurabilityManager(
+                client._store, rc, executor=client._executor,
+                pod_backend=client._pod_backend()).flush(["regr:blk"])
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        rcli = RedissonTPU.create(cfg)
+        try:
+            wire_bf = rcli.get_bloom_filter("regr:blk")
+            assert wire_bf.is_blocked() is True  # meta stays readable
+            with pytest.raises(UnsupportedInRedisMode):
+                wire_bf.contains(b"b0")
+            with pytest.raises(UnsupportedInRedisMode):
+                wire_bf.add(b"new")
+            with pytest.raises(UnsupportedInRedisMode):
+                wire_bf.count()
+        finally:
+            rcli.shutdown()
+
+
+def test_wire_tier_device_packed_probe_clear_error():
+    """contains_count_device_async in redis mode: a clear
+    UnsupportedInRedisMode, not an opaque KeyError (advisor r3 low)."""
+    from redisson_tpu.interop.backend_redis import UnsupportedInRedisMode
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+    with EmbeddedRedis() as er:
+        cfg = Config()
+        cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+        rcli = RedissonTPU.create(cfg)
+        try:
+            bf = rcli.get_bloom_filter("regr:dp")
+            bf.try_init(1000, 0.01)
+            fake_device_batch = np.zeros((4, 2), np.uint32)
+            with pytest.raises(UnsupportedInRedisMode):
+                bf.contains_count_device_async(fake_device_batch).result()
+        finally:
+            rcli.shutdown()
+
+
+def test_multimap_legacy_raw_members_tolerated():
+    """Multimap index members written before the hex-segment layout decode
+    as raw bytes instead of raising ValueError (advisor r3 low)."""
+    from redisson_tpu.interop.backend_redis import RedisBackend
+
+    assert RedisBackend._mm_dec(b"6162") == b"ab"  # hex path
+    assert RedisBackend._mm_dec(b"plain-legacy!") == b"plain-legacy!"
+    assert RedisBackend._mm_dec(b"\xff\x00legacy") == b"\xff\x00legacy"
+
+
+def test_pod_mode_wrongtype_cross_checks(pod):
+    """Pod mode enforces the same HLL-vs-store keyspace rule as the
+    single-chip tier (review r4: row_of never consulted the delegate store
+    and the delegate's guard saw an empty row map)."""
+    from redisson_tpu.store import WrongTypeError
+
+    pod.get_bit_set("pw:bits").set(3)
+    with pytest.raises(WrongTypeError):
+        pod.get_hyper_log_log("pw:bits").add(b"x")
+    pod.get_hyper_log_log("pw:hll").add(b"x")
+    with pytest.raises(WrongTypeError):
+        pod.get_bit_set("pw:hll").set(1)
+    with pytest.raises(WrongTypeError):
+        pod.get_bit_set("pw:dest").or_("pw:hll")
