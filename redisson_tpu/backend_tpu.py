@@ -1271,6 +1271,35 @@ class TpuBackend:
         for op in ops:
             op.future.set_result(res)
 
+    def _op_rename(self, target: str, ops: List[Op]) -> None:
+        """RENAME/RENAMENX for sketch-tier objects (bank HLL rows move by
+        remapping; store objects re-key; bloom mirrors follow). Atomic: the
+        whole check+move runs on the dispatcher."""
+        for op in ops:
+            new = op.payload["newkey"]
+            if op.payload.get("nx") and (
+                    new in self._rows or self.store.exists(new)):
+                op.future.set_result(False)
+                continue
+            # RENAME overwrites the destination in this tier.
+            row = self._alloc.release(new)
+            if row is not None:
+                self.bank = engine.hll_bank_zero_row(self.bank, np.int32(row))
+            self.store.delete(new)
+            self._bloom_mirrors.pop(new, None)
+            if target in self._rows:
+                self._alloc.rows[new] = self._alloc.rows.pop(target)
+                self._alloc.versions[new] = (
+                    self._alloc.versions.pop(target, 0) + 1)
+            elif self.store.exists(target):
+                self.store.rename(target, new)
+                mir = self._bloom_mirrors.pop(target, None)
+                if mir is not None:
+                    self._bloom_mirrors[new] = mir
+            else:
+                raise KeyError(f"no such key '{target}'")
+            op.future.set_result(True)
+
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
         # Runs on the dispatcher thread, so it is serialized against every
         # other op (no mid-kernel store mutation). The bank is dropped, not

@@ -63,7 +63,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("flushall", "FLUSHALL", True, _ALL + " tpu"),
     _d("keys", "KEYS", False, _ALL + " tpu"),
     _d("type", "TYPE", False, _ALL),
-    _d("rename", "RENAME", True, _ALL),
+    _d("rename", "RENAME", True, _ALL + " tpu"),
     _d("persist", "PERSIST", True, _ALL),
     _d("pexpire", "PEXPIRE", True, _ALL),
     _d("pexpireat", "PEXPIREAT", True, _ALL),
@@ -184,6 +184,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("sem_release", "LUA", True, "engine coord"),
     _d("sem_available", "GET", False, "engine coord"),
     _d("sem_drain", "GETSET", True, "engine coord"),
+    _d("sem_set_permits", "SET", True, "engine coord"),
     _d("sem_add_permits", "INCRBY", True, "engine coord"),
     _d("latch_try_set", "SETNX", True, "engine coord"),
     _d("latch_count_down", "LUA", True, "engine coord"),

@@ -134,6 +134,10 @@ class RedisBackend(RedisBloomMixin):
         op.future.set_result(self._x("PTTL", key))
 
     def _op_rename(self, key: str, op: Op) -> None:
+        if op.payload.get("nx"):
+            op.future.set_result(
+                self._x("RENAMENX", key, op.payload["newkey"]) == 1)
+            return
         self._x("RENAME", key, op.payload["newkey"])
         op.future.set_result(True)
 

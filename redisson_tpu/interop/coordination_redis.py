@@ -586,6 +586,14 @@ class RedisSemaphore:
             self.RELEASE, [self.name, self.channel],
             [permits, RELEASE_MESSAGE])
 
+    def set_permits(self, permits: int) -> None:
+        """Force the permit count atomically + wake waiters (reference
+        setPermits)."""
+        self._scripts.run(
+            "redis.call('set', KEYS[1], ARGV[1]) "
+            "redis.call('publish', KEYS[2], ARGV[2]) return 1",
+            [self.name, self.channel], [int(permits), RELEASE_MESSAGE])
+
     def available_permits(self) -> int:
         v = self._scripts.resp.execute("GET", self.name)
         return int(v) if v is not None else 0

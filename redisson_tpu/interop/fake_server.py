@@ -501,6 +501,12 @@ class FakeRedisServer:
             self.expires[nk] = self.expires.pop(k)
         return _ok()
 
+    def _cmd_renamenx(self, a):
+        if bytes(a[1]) in self.data:
+            return _int(0)
+        self._cmd_rename(a)
+        return _int(1)
+
     def _cmd_pexpire(self, a):
         import time
         k = bytes(a[0])

@@ -97,6 +97,52 @@ class RBatch:
     def get_list_multimap(self, name: str) -> RListMultimap:
         return RListMultimap(name, self._staging, self._codec, self._widths)
 
+    def get_map_cache(self, name: str) -> "RMapCache":
+        from redisson_tpu.models.mapcache import RMapCache
+
+        return RMapCache(name, self._staging, self._codec)
+
+    def get_set_cache(self, name: str) -> "RSetCache":
+        from redisson_tpu.models.mapcache import RSetCache
+
+        return RSetCache(name, self._staging, self._codec)
+
+    def get_set_multimap_cache(self, name: str) -> "RSetMultimapCache":
+        from redisson_tpu.models.multimap import RSetMultimapCache
+
+        return RSetMultimapCache(name, self._staging, self._codec)
+
+    def get_list_multimap_cache(self, name: str) -> "RListMultimapCache":
+        from redisson_tpu.models.multimap import RListMultimapCache
+
+        return RListMultimapCache(name, self._staging, self._codec)
+
+    def get_blocking_queue(self, name: str) -> "RBlockingQueue":
+        from redisson_tpu.models.queue import RBlockingQueue
+
+        return RBlockingQueue(name, self._staging, self._codec)
+
+    def get_blocking_deque(self, name: str) -> "RBlockingDeque":
+        from redisson_tpu.models.queue import RBlockingDeque
+
+        return RBlockingDeque(name, self._staging, self._codec)
+
+    def get_topic(self, name: str) -> "RTopic":
+        """Batch-staged publish (listeners attach via the live client)."""
+        from redisson_tpu.models.topic import RTopic
+
+        return RTopic(name, self._staging, self._codec, pubsub=None)
+
+    def get_script(self) -> "RScript":
+        from redisson_tpu.models.script import RScript
+
+        return RScript(self._staging)
+
+    def get_keys(self) -> "RKeys":
+        from redisson_tpu.models.keys import RKeys
+
+        return RKeys(self._staging, routing=None)
+
     def get_geo(self, name: str) -> RGeo:
         return RGeo(name, self._staging, self._codec, self._widths)
 

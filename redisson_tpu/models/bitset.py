@@ -115,3 +115,17 @@ class RBitSet(RObject):
         if n == 0:
             return np.zeros((0,), bool)
         return self.get_bits(np.arange(n))
+
+    def as_bit_set(self) -> set:
+        """Reference asBitSet() -> java.util.BitSet; pythonic form: the set
+        of set-bit indexes."""
+        arr = self.to_numpy()
+        return set(np.nonzero(arr)[0].tolist())
+
+    def to_byte_array(self) -> bytes:
+        """Reference toByteArray(): the packed big-endian bitmap (the exact
+        bytes a Redis GET of the key returns)."""
+        arr = self.to_numpy()
+        if arr.size == 0:
+            return b""
+        return np.packbits(arr.astype(np.uint8)).tobytes()

@@ -72,6 +72,11 @@ class RBuckets:
         pairs = {k: self._codec.encode(v) for k, v in values.items()}
         self._executor.execute_sync("", "mset", {"pairs": pairs})
 
+    def find(self, pattern: str) -> List["RBucket"]:
+        """Reference find(pattern): buckets whose names match the glob."""
+        names = self._executor.execute_sync("", "keys", {"pattern": pattern})
+        return [RBucket(n, self._executor, self._codec) for n in names]
+
     def try_set(self, values: Dict[str, Any]) -> bool:
         pairs = {k: self._codec.encode(v) for k, v in values.items()}
         return self._executor.execute_sync("", "msetnx", {"pairs": pairs})
@@ -186,6 +191,18 @@ class RAtomicDouble(RExpirable):
 
     def decrement_and_get_async(self):
         return self.add_and_get_async(-1.0)
+
+    def get_and_increment(self) -> float:
+        return self.add_and_get(1.0) - 1.0
+
+    def get_and_increment_async(self):
+        return _map_future(self.add_and_get_async(1.0), lambda v: v - 1.0)
+
+    def get_and_decrement(self) -> float:
+        return self.add_and_get(-1.0) + 1.0
+
+    def get_and_decrement_async(self):
+        return _map_future(self.add_and_get_async(-1.0), lambda v: v + 1.0)
 
     def get_and_add(self, delta: float) -> float:
         return self.add_and_get(delta) - float(delta)

@@ -201,6 +201,34 @@ class RList(RExpirable):
     def fast_set(self, index: int, value: Any) -> None:
         self._executor.execute_sync(self.name, "lset", {"index": index, "value": self._e(value)})
 
+    def add_after(self, element: Any, value: Any) -> int:
+        """LINSERT AFTER pivot (reference addAfter); new length, -1 if the
+        pivot is absent."""
+        return self._executor.execute_sync(
+            self.name, "linsert",
+            {"pivot": self._e(element), "value": self._e(value),
+             "before": False})
+
+    def add_before(self, element: Any, value: Any) -> int:
+        """LINSERT BEFORE pivot (reference addBefore)."""
+        return self._executor.execute_sync(
+            self.name, "linsert",
+            {"pivot": self._e(element), "value": self._e(value),
+             "before": True})
+
+    def sub_list(self, from_index: int, to_index: int) -> List[Any]:
+        """Reference subList(from, to) — a read of the half-open index
+        window (the java live-view semantics collapse to a read here)."""
+        if to_index <= from_index:
+            return []
+        return self.range(from_index, to_index - 1)
+
+    def fast_remove(self, *indexes: int) -> None:
+        """Remove elements by index without returning them (reference
+        fastRemove). Descending order keeps lower indexes stable."""
+        for i in sorted(indexes, reverse=True):
+            self._executor.execute_sync(self.name, "lrem_index", {"index": i})
+
     def __getitem__(self, index: int) -> Any:
         v = self.get(index)
         if v is None:

@@ -29,6 +29,39 @@ class RGeo(RExpirable):
         )
         return {self._d(m): coords for m, coords in raw.items()}
 
+    _GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+    def hash(self, *members: Any) -> Dict[Any, str]:
+        """Reference hash() -> GEOHASH strings (11-char base32 geohash of
+        each member's position, computed from the stored coordinates).
+        Matches Redis GEOHASH exactly: ten characters from the first 50 of
+        its 52 interleaved bits, and a literal '0' eleventh character
+        (Redis discards the last two bits and hard-codes that char —
+        geohashCommand in geo.c)."""
+        out: Dict[Any, str] = {}
+        for member, (lon, lat) in self.pos(*members).items():
+            lat_rng, lon_rng = [-90.0, 90.0], [-180.0, 180.0]
+            bits = []
+            even = True
+            while len(bits) < 50:
+                rng, v = (lon_rng, lon) if even else (lat_rng, lat)
+                mid = (rng[0] + rng[1]) / 2
+                if v >= mid:
+                    bits.append(1)
+                    rng[0] = mid
+                else:
+                    bits.append(0)
+                    rng[1] = mid
+                even = not even
+            s = ""
+            for i in range(0, 50, 5):
+                idx = 0
+                for b in bits[i:i + 5]:
+                    idx = (idx << 1) | b
+                s += self._GEOHASH32[idx]
+            out[member] = s + "0"
+        return out
+
     def dist(self, member1: Any, member2: Any, unit: str = "m") -> Optional[float]:
         return self._executor.execute_sync(
             self.name,

@@ -20,6 +20,17 @@ class RKeys:
     def get_keys_by_pattern(self, pattern: str) -> List[str]:
         return self.get_keys(pattern)
 
+    def find_keys_by_pattern(self, pattern: str) -> List[str]:
+        """Reference findKeysByPattern (KEYS pattern)."""
+        return self.get_keys(pattern)
+
+    def get_slot(self, key: str) -> int:
+        """CRC16 key slot (reference getSlot; same function cluster routing
+        uses, connection/CRC16.java + hashtag rules)."""
+        from redisson_tpu.ops import crc16
+
+        return crc16.key_slot(key)
+
     def random_key(self) -> Optional[str]:
         import random
 
@@ -38,6 +49,28 @@ class RKeys:
 
     def delete_by_pattern(self, pattern: str) -> int:
         return self.delete(*self.get_keys(pattern))
+
+    # -- async twins (RKeysAsync; also what RBatch.get_keys() stages) -------
+
+    def get_keys_async(self, pattern: str = "*"):
+        return self._executor.execute_async(
+            "", "keys", {"pattern": pattern})
+
+    def delete_async(self, *names: str):
+        """Stage/async delete; resolves to the number of keys removed."""
+        from redisson_tpu.models.object import map_future
+
+        if len(names) == 1:
+            return map_future(
+                self._executor.execute_async(names[0], "delete", None),
+                lambda ok: int(bool(ok)))
+        futs = [self._executor.execute_async(n, "delete", None)
+                for n in names]
+
+        def _sum(_last):
+            return sum(int(bool(f.result())) for f in futs)
+
+        return map_future(futs[-1], _sum) if futs else None
 
     def flushall(self) -> None:
         self._executor.execute_sync("", "flushall", None)

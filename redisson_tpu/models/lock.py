@@ -377,6 +377,13 @@ class RSemaphore:
     def drain_permits(self) -> int:
         return self._executor.execute_sync(self.name, "sem_drain", None)
 
+    def set_permits(self, permits: int) -> None:
+        """Force the permit count (reference setPermits — unlike
+        try_set_permits this overwrites unconditionally). One atomic op on
+        the dispatcher: concurrent acquire/release cannot interleave."""
+        self._executor.execute_sync(
+            self.name, "sem_set_permits", {"permits": int(permits)})
+
     def add_permits(self, permits: int) -> None:
         self._executor.execute_sync(self.name, "sem_add_permits", {"permits": permits})
 

@@ -136,6 +136,41 @@ class RMap(RExpirable):
         )
         return val
 
+    def fast_put_if_absent(self, key: Any, value: Any) -> bool:
+        """HSETNX reply only (reference fastPutIfAbsent: True when the
+        field was absent and got set). Checks the RAW reply — a stored
+        null/None value must read as "present" (same rule fast_put
+        follows)."""
+        raw = self._executor.execute_sync(
+            self.name, "hput_if_absent",
+            {"field": self._ek(key), "value": self._ev(value)})
+        return raw is None
+
+    # -- bulk reads (reference readAllKeySet/readAllValues/readAllEntrySet) --
+
+    def read_all_key_set(self) -> set:
+        return set(self.key_set())
+
+    def read_all_values(self) -> List[Any]:
+        return self.values()
+
+    def read_all_entry_set(self) -> List[Tuple[Any, Any]]:
+        return self.entry_set()
+
+    # -- predicate filters (reference filterKeys/filterValues/filterEntries,
+    # core/Predicate.java): the reference serializes the predicate and runs
+    # it server-side; pythonic form takes a callable and streams the HSCAN
+    # cursor through it client-side (same result set, no code shipping) ----
+
+    def filter_keys(self, predicate) -> Dict[Any, Any]:
+        return {k: v for k, v in self.iter_entries() if predicate(k)}
+
+    def filter_values(self, predicate) -> Dict[Any, Any]:
+        return {k: v for k, v in self.iter_entries() if predicate(v)}
+
+    def filter_entries(self, predicate) -> Dict[Any, Any]:
+        return {k: v for k, v in self.iter_entries() if predicate(k, v)}
+
     # -- iteration (HSCAN cursor protocol) ----------------------------------
 
     def iter_entries(self, count: int = 10) -> Iterator[Tuple[Any, Any]]:
@@ -148,6 +183,16 @@ class RMap(RExpirable):
                 yield self._dk(f), self._dv(v)
             if cursor == 0:
                 return
+
+    # reference entryIterator/keyIterator/valueIterator
+    def entry_iterator(self, count: int = 10) -> Iterator[Tuple[Any, Any]]:
+        return self.iter_entries(count)
+
+    def key_iterator(self, count: int = 10) -> Iterator[Any]:
+        return (k for k, _ in self.iter_entries(count))
+
+    def value_iterator(self, count: int = 10) -> Iterator[Any]:
+        return (v for _, v in self.iter_entries(count))
 
     # -- dict sugar ---------------------------------------------------------
 

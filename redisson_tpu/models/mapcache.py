@@ -138,7 +138,10 @@ class RSetCache(RExpirable):
         return self._codec.encode(v)
 
     def add(self, value: Any, ttl_s: Optional[float] = None) -> bool:
-        return self._executor.execute_sync(
+        return self.add_async(value, ttl_s).result()
+
+    def add_async(self, value: Any, ttl_s: Optional[float] = None):
+        return self._executor.execute_async(
             self.name,
             "sc_add",
             {"member": self._e(value), "ttl_ms": None if ttl_s is None else int(ttl_s * 1000)},

@@ -83,6 +83,42 @@ class _RMultimap(RExpirable):
         would orphan subkeys and the timeout zset in redis mode)."""
         return self._executor.execute_sync(self.name, "mm_delete", self._p())
 
+    # -- reference RMultimap surface completers -----------------------------
+
+    def get(self, key: Any) -> List[Any]:
+        """Reference get(): the values of one key (the java live-view
+        semantics collapse to a read here; mutate via put/remove)."""
+        return self.get_all(key)
+
+    def is_empty(self) -> bool:
+        return self.key_size() == 0
+
+    def clear(self) -> bool:
+        """Remove every entry (reference clear(): the Map contract's wipe)."""
+        return self.delete()
+
+    def values(self) -> List[Any]:
+        """Every value across all keys (reference values() view, read
+        form)."""
+        return [v for _, v in self.entries()]
+
+    def fast_remove(self, *keys: Any) -> int:
+        """Remove whole keys; returns how many existed (reference
+        fastRemove)."""
+        n = 0
+        for k in keys:
+            if self.contains_key(k):
+                self.remove_all(k)
+                n += 1
+        return n
+
+    def replace_values(self, key: Any, values: Iterable[Any]) -> List[Any]:
+        """Swap a key's collection; returns the previous values (reference
+        replaceValues)."""
+        old = self.remove_all(key)
+        self.put_all(key, values)
+        return old
+
 
 class RSetMultimap(_RMultimap):
     """Values per key form a set (duplicate entries collapse)."""

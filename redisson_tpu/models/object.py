@@ -104,3 +104,23 @@ class RObject:
 
     def is_exists(self) -> bool:
         return self._executor.execute_sync(self.name, "exists", None)
+
+    def get_name(self) -> str:
+        """Reference getName() (also available as the `.name` attribute)."""
+        return self.name
+
+    def rename(self, new_name: str) -> None:
+        """RENAME: move this object's state under a new key; this handle
+        follows it (reference rename mutates the object's name too)."""
+        self._executor.execute_sync(self.name, "rename", {"newkey": new_name})
+        self.name = new_name
+
+    def renamenx(self, new_name: str) -> bool:
+        """RENAMENX: rename only when the destination is absent — a single
+        atomic op (the check+move runs serialized on the dispatcher, like
+        the server-side RENAMENX)."""
+        ok = self._executor.execute_sync(
+            self.name, "rename", {"newkey": new_name, "nx": True})
+        if ok:
+            self.name = new_name
+        return bool(ok)

@@ -66,6 +66,38 @@ class RDeque(RQueue):
     def push(self, value: Any) -> None:
         self.add_first(value)
 
+    # -- java Deque surface (RDequeAsync.java declares the async twins) -----
+
+    def remove_first(self) -> Any:
+        """Pop head; raises IndexError when empty (java removeFirst)."""
+        v = self.poll_first()
+        if v is None:
+            raise IndexError("remove_first from an empty deque")
+        return v
+
+    def remove_last(self) -> Any:
+        v = self.poll_last()
+        if v is None:
+            raise IndexError("remove_last from an empty deque")
+        return v
+
+    def get_last(self) -> Any:
+        """Peek tail; raises IndexError when empty (java getLast)."""
+        v = self.peek_last()
+        if v is None:
+            raise IndexError("get_last from an empty deque")
+        return v
+
+    def remove_first_occurrence(self, value: Any) -> bool:
+        """LREM count=1 head-side (java removeFirstOccurrence)."""
+        return self._executor.execute_sync(
+            self.name, "lrem", {"value": self._e(value), "count": 1}) > 0
+
+    def remove_last_occurrence(self, value: Any) -> bool:
+        """LREM count=-1 tail-side (java removeLastOccurrence)."""
+        return self._executor.execute_sync(
+            self.name, "lrem", {"value": self._e(value), "count": -1}) > 0
+
 
 class RBlockingQueue(RQueue):
     """take()/poll(timeout) parity with `RedissonBlockingQueue.java`."""
@@ -117,6 +149,54 @@ class RBlockingQueue(RQueue):
             n += 1
         return n
 
+    def _poll_from_any(self, timeout_s: Optional[float], side: str,
+                       names: tuple):
+        """Reference pollFromAny (multi-key BLPOP): round-robin the queues
+        — an immediate pop wins; otherwise short blocking slices rotate
+        across the keys until the deadline. (The reference's server-side
+        BLPOP watches all keys in one command; the rotation reaches the
+        same outcome with a bounded wake-up latency per slice.)"""
+        import time as _time
+
+        queues = [self.name, *names]
+        # BLPOP rule: timeout 0 (or None) blocks indefinitely.
+        deadline = None if not timeout_s else _time.monotonic() + timeout_s
+        slice_s = 0.05
+        first_sweep = True
+        while True:
+            for i, q in enumerate(queues):
+                remaining = None if deadline is None else max(
+                    0.0, deadline - _time.monotonic())
+                # Always finish one full non-blocking sweep before giving
+                # up, so an already-available element is returned even at a
+                # zero/elapsed deadline.
+                if (remaining is not None and remaining <= 0
+                        and not first_sweep):
+                    return None, None
+                # Block briefly only on the last queue of the rotation so a
+                # quiet system still parks instead of spinning.
+                wait = slice_s if i == len(queues) - 1 else 0
+                if wait and remaining is not None:
+                    wait = min(wait, remaining) or 0
+                other = RBlockingQueue(q, self._executor, self._codec)
+                v = (other._blocking_pop(wait, side) if wait
+                     else other._executor.execute_sync(
+                         q, "lpop" if side == "left" else "rpop", None))
+                if v is not None:
+                    return (other._d(v) if not wait else v), q
+                if (first_sweep and i == len(queues) - 1
+                        and deadline is not None
+                        and deadline - _time.monotonic() <= 0):
+                    return None, None
+            first_sweep = False
+
+    def poll_from_any(self, timeout_s: Optional[float] = None,
+                      *queue_names: str) -> Any:
+        """First element from this queue or any of `queue_names`
+        (reference pollFromAny, BLPOP key1..keyN)."""
+        v, _ = self._poll_from_any(timeout_s, "left", queue_names)
+        return v
+
 
 class RBlockingDeque(RBlockingQueue, RDeque):
     def take_first(self) -> Any:
@@ -134,3 +214,21 @@ class RBlockingDeque(RBlockingQueue, RDeque):
         if timeout_s is None:
             return RDeque.poll_last(self)
         return self._blocking_pop(timeout_s, "right")
+
+    def put_first(self, value: Any) -> None:
+        """Head insert (java BlockingDeque putFirst; capacity is unbounded
+        here, so it never blocks — same as the reference on Redis lists)."""
+        self.add_first(value)
+
+    def put_last(self, value: Any) -> None:
+        self.add_last(value)
+
+    def poll_first_from_any(self, timeout_s: Optional[float] = None,
+                            *queue_names: str) -> Any:
+        v, _ = self._poll_from_any(timeout_s, "left", queue_names)
+        return v
+
+    def poll_last_from_any(self, timeout_s: Optional[float] = None,
+                           *queue_names: str) -> Any:
+        v, _ = self._poll_from_any(timeout_s, "right", queue_names)
+        return v

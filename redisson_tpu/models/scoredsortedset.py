@@ -157,6 +157,43 @@ class RScoredSortedSet(RExpirable):
         vals = self.value_range(-1, -1)
         return vals[0] if vals else None
 
+    # -- reference surface completers (RScoredSortedSet.java) ---------------
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
+
+    def to_array(self) -> List[Any]:
+        return self.read_all()
+
+    def contains_all(self, members: Iterable[Any]) -> bool:
+        ms = [self._e(m) for m in members]
+        if not ms:
+            return True
+        scores = self._executor.execute_sync(
+            self.name, "zmscore", {"members": ms})
+        return all(s is not None for s in scores)
+
+    def retain_all(self, members: Iterable[Any]) -> bool:
+        """Keep only `members`; True if anything was removed (reference
+        retainAll)."""
+        keep = {self._e(m) for m in members}
+        drop = [m for m in self.read_all() if self._e(m) not in keep]
+        if not drop:
+            return False
+        self.remove_all(drop)
+        return True
+
+    def clear(self) -> bool:
+        """Remove every member (java Collection clear)."""
+        return self.remove_range_by_rank(0, -1) > 0
+
+    def value_range_reversed(self, start: int, stop: int) -> List[Any]:
+        """Reference valueRangeReversed (ZREVRANGE by index)."""
+        return self.value_range(start, stop, reversed=True)
+
+    def entry_range_reversed(self, start: int, stop: int) -> List[Tuple[Any, float]]:
+        return self.entry_range(start, stop, reversed=True)
+
     # -- multi-set ops (ZUNIONSTORE/ZINTERSTORE) ----------------------------
 
     def union(self, *names: str) -> int:
@@ -284,6 +321,90 @@ class RLexSortedSet(RExpirable):
 
     def read_all(self) -> List[str]:
         return self.lex_range()
+
+    # -- reference RLexSortedSet.java surface completers --------------------
+    # (extends SortedSet<String> + the ZLEX families; `range`/`valueRange`
+    # are BY-INDEX reads there, head/tail are the open-ended lex windows.)
+
+    def rank(self, value) -> Optional[int]:
+        return self._executor.execute_sync(
+            self.name, "zrank", {"member": self._e(value)})
+
+    def rev_rank(self, value) -> Optional[int]:
+        return self._executor.execute_sync(
+            self.name, "zrank", {"member": self._e(value), "rev": True})
+
+    def first(self) -> Optional[str]:
+        vals = self.value_range(0, 0)
+        return vals[0] if vals else None
+
+    def last(self) -> Optional[str]:
+        vals = self.value_range(-1, -1)
+        return vals[0] if vals else None
+
+    def poll_first(self) -> Optional[str]:
+        raw = self._executor.execute_sync(self.name, "zpop", {})
+        return None if raw is None else self._d(raw[0])
+
+    def poll_last(self) -> Optional[str]:
+        raw = self._executor.execute_sync(self.name, "zpop", {"last": True})
+        return None if raw is None else self._d(raw[0])
+
+    def value_range(self, start: int, stop: int) -> List[str]:
+        """BY-INDEX window (reference valueRange/range: ZRANGE on the
+        all-zero-score set = lex order)."""
+        raw = self._executor.execute_sync(
+            self.name, "zrange", {"start": start, "stop": stop})
+        return [self._d(m) for m in raw]
+
+    def range(self, start: int, stop: int) -> List[str]:
+        return self.value_range(start, stop)
+
+    def range_head(self, to_element, inclusive: bool = True) -> List[str]:
+        return self.lex_range_head(to_element, inclusive)
+
+    def range_tail(self, from_element, inclusive: bool = True) -> List[str]:
+        return self.lex_range_tail(from_element, inclusive)
+
+    def count(self, from_element=None, from_inclusive: bool = True,
+              to_element=None, to_inclusive: bool = True) -> int:
+        return self.lex_count(from_element, from_inclusive,
+                              to_element, to_inclusive)
+
+    def count_head(self, to_element, inclusive: bool = True) -> int:
+        return self.lex_count(to_element=to_element, to_inclusive=inclusive)
+
+    def count_tail(self, from_element, inclusive: bool = True) -> int:
+        return self.lex_count(from_element=from_element,
+                              from_inclusive=inclusive)
+
+    def lex_count_head(self, to_element, inclusive: bool = True) -> int:
+        return self.count_head(to_element, inclusive)
+
+    def lex_count_tail(self, from_element, inclusive: bool = True) -> int:
+        return self.count_tail(from_element, inclusive)
+
+    def remove_range_by_lex(self, from_element=None,
+                            from_inclusive: bool = True, to_element=None,
+                            to_inclusive: bool = True) -> int:
+        return self.remove_range(from_element, from_inclusive,
+                                 to_element, to_inclusive)
+
+    def remove_range_head(self, to_element, inclusive: bool = True) -> int:
+        return self.remove_range(to_element=to_element,
+                                 to_inclusive=inclusive)
+
+    def remove_range_head_by_lex(self, to_element,
+                                 inclusive: bool = True) -> int:
+        return self.remove_range_head(to_element, inclusive)
+
+    def remove_range_tail(self, from_element, inclusive: bool = True) -> int:
+        return self.remove_range(from_element=from_element,
+                                 from_inclusive=inclusive)
+
+    def remove_range_tail_by_lex(self, from_element,
+                                 inclusive: bool = True) -> int:
+        return self.remove_range_tail(from_element, inclusive)
 
     def __len__(self) -> int:
         return self.size()

@@ -139,6 +139,10 @@ class RScript:
         return self._executor.execute_async(
             "", "script_eval", {"fn": fn, "keys": list(keys), "args": list(args)})
 
+    def eval_sha(self, sha: str, keys: Sequence[str] = (), args: Sequence = ()):
+        """Reference evalSha spelling."""
+        return self.evalsha(sha, keys, args)
+
     def evalsha(self, sha: str, keys: Sequence[str] = (),
                 args: Sequence[Any] = ()) -> Any:
         """Run a previously loaded script by handle (EVALSHA)."""

@@ -109,6 +109,16 @@ class RSortedSet(RExpirable):
     def size(self) -> int:
         return self._executor.execute_sync(self.name, "llen", None)
 
+    def try_set_comparator(self, key) -> bool:
+        """Reference trySetComparator: install a new ordering (a python
+        sort key, the comparator's pythonic form); succeeds only while the
+        set is empty — re-sorting existing members is what the reference
+        also refuses."""
+        if self.size() > 0:
+            return False
+        self._key = key if key is not None else (lambda v: v)
+        return True
+
     def first(self) -> Any:
         return self._d(self._executor.execute_sync(self.name, "lindex", {"index": 0}))
 

@@ -4,7 +4,7 @@ registry; publish returns receiver count)."""
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, List
 
 
 class RTopic:
@@ -40,6 +40,10 @@ class RTopic:
         self._listeners.discard(listener_id)
         self._pubsub.unsubscribe(self.name, listener_id)
 
+    def get_channel_names(self) -> List[str]:
+        """Reference getChannelNames() (one channel per topic here)."""
+        return [self.name]
+
     def remove_all_listeners(self) -> None:
         for lid in list(self._listeners):
             self.remove_listener(lid)
@@ -64,6 +68,10 @@ class RPatternTopic:
         hub_id = self._pubsub.psubscribe(self.pattern, wrapped)
         self._listeners.add(hub_id)
         return hub_id
+
+    def get_pattern_names(self) -> List[str]:
+        """Reference getPatternNames()."""
+        return [self.pattern]
 
     def remove_listener(self, listener_id: int) -> None:
         self._listeners.discard(listener_id)

@@ -187,6 +187,19 @@ class Node:
         except Exception:
             return False
 
+    def get_type(self) -> str:
+        """Reference Node.getType() (NodeType analogue: the tier kind)."""
+        return self.kind
+
+    def get_addr(self) -> str:
+        """Reference Node.getAddr()."""
+        return self.ident
+
+    def info(self) -> dict:
+        """Reference ClusterNode.info(): the node's descriptive fields."""
+        return {"type": self.kind, "addr": self.ident,
+                "alive": self.ping()}
+
 
 class NodesGroup:
     """client.get_nodes_group(): enumerate + ping nodes, listen to
@@ -217,6 +230,12 @@ class NodesGroup:
     def add_connection_listener(self, fn: Callable[[str, str], None]) -> None:
         """fn(event, ident) with event in {'connect', 'disconnect'}."""
         self._listeners.append(fn)
+
+    def remove_connection_listener(self, fn: Callable[[str, str], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def fire(self, event: str, ident: str) -> None:
         for fn in list(self._listeners):

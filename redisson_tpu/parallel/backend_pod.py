@@ -153,6 +153,34 @@ class PodBackend:
             return
         self._delegate.run("exists", target, ops)
 
+    def _op_rename(self, target: str, ops: List[Op]) -> None:
+        """RENAME/RENAMENX over bank rows + delegate store (the delegate's
+        own handler would zero ITS bank, which pod mode never allocates)."""
+        for op in ops:
+            new = op.payload["newkey"]
+            if op.payload.get("nx") and (
+                    new in self._rows or self.store.exists(new)):
+                op.future.set_result(False)
+                continue
+            row = self._alloc.release(new)
+            if row is not None:
+                self.bank = sharded.zero_row(self.bank, row)
+            self.store.delete(new)
+            self._delegate._bloom_mirrors.pop(new, None)
+            if target in self._rows:
+                self._alloc.rows[new] = self._alloc.rows.pop(target)
+                self._alloc.versions[new] = (
+                    self._alloc.versions.pop(target, 0) + 1)
+            elif self.store.exists(target):
+                self.store.rename(target, new)
+                mir = self._delegate._bloom_mirrors.pop(target, None)
+                if mir is not None:
+                    self._delegate._bloom_mirrors[new] = mir
+            else:
+                op.future.set_exception(KeyError(f"no such key '{target}'"))
+                continue
+            op.future.set_result(True)
+
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
         self._alloc.clear()
         self.bank = sharded.make_bank(self.mesh, self.bank_capacity)

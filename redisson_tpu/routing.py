@@ -29,7 +29,7 @@ class RoutingBackend:
 
     # sketch kinds = everything the sketch backend implements, minus the
     # keyspace-wide ops we intercept.
-    _BOTH = {"delete", "exists", "flushall", "keys"}
+    _BOTH = {"delete", "exists", "flushall", "keys", "rename"}
 
     def _sketch_handles(self, kind: str) -> bool:
         # Backends that wrap a delegate (PodBackend) answer through
@@ -72,6 +72,34 @@ class RoutingBackend:
         self.structures.flushall()
         for op in ops:
             op.future.set_result(None)
+
+    def _both_rename(self, target: str, ops: List[Op]) -> None:
+        """RENAME/RENAMENX routed to the tier holding the source; the
+        destination is cleared in BOTH tiers first (Redis RENAME overwrites
+        whatever held that name). Serialized on the dispatcher -> atomic."""
+        for op in ops:
+            new = op.payload["newkey"]
+            in_sketch = bool(self._sketch_side("exists", target))
+            in_struct = self.structures.exists(target)
+            if not in_sketch and not in_struct:
+                op.future.set_exception(KeyError(f"no such key '{target}'"))
+                continue
+            if op.payload.get("nx") and (
+                    bool(self._sketch_side("exists", new))
+                    or self.structures.exists(new)):
+                op.future.set_result(False)
+                continue
+            if in_sketch:
+                self.structures.delete(new)
+                probe = Op(target=target, kind="rename", payload=op.payload)
+                self.sketch.run("rename", target, [probe])
+                try:
+                    op.future.set_result(probe.future.result())
+                except Exception as exc:  # noqa: BLE001
+                    op.future.set_exception(exc)
+            else:
+                self._sketch_side("delete", new)
+                self.structures.run("rename", target, [op])
 
     def _both_keys(self, target: str, ops: List[Op]) -> None:
         """KEYS across both tiers, serialized on the dispatcher thread."""

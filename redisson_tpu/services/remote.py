@@ -46,6 +46,28 @@ class RemoteInvocationOptions:
                       exec_s: Optional[float]) -> "RemoteInvocationOptions":
         return RemoteInvocationOptions(ack_s, exec_s)
 
+    # -- reference accessor/builder surface ---------------------------------
+
+    def expect_ack_within(self, ack_s: float) -> "RemoteInvocationOptions":
+        return RemoteInvocationOptions(ack_s, self.execution_timeout_s)
+
+    def expect_result_within(self, exec_s: float) -> "RemoteInvocationOptions":
+        return RemoteInvocationOptions(self.ack_timeout_s, exec_s)
+
+    def is_ack_expected(self) -> bool:
+        return self.ack_timeout_s is not None
+
+    def is_result_expected(self) -> bool:
+        return self.execution_timeout_s is not None
+
+    def get_ack_timeout_in_millis(self) -> Optional[int]:
+        return (None if self.ack_timeout_s is None
+                else int(self.ack_timeout_s * 1000))
+
+    def get_execution_timeout_in_millis(self) -> Optional[int]:
+        return (None if self.execution_timeout_s is None
+                else int(self.execution_timeout_s * 1000))
+
 
 class RemoteServiceTimeoutError(TimeoutError):
     """No response inside execution_timeout_s."""

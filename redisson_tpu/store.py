@@ -118,6 +118,17 @@ class SketchStore:
         with self._lock:
             return self._objects.pop(name, None) is not None
 
+    def rename(self, name: str, new_name: str) -> bool:
+        """Move an object under a new key (RENAME: destination overwritten)."""
+        with self._lock:
+            obj = self._objects.pop(name, None)
+            if obj is None:
+                return False
+            obj.name = new_name
+            obj.slot = self.slot_of(new_name)
+            self._objects[new_name] = obj
+            return True
+
     def exists(self, name: str) -> bool:
         with self._lock:
             return name in self._objects

@@ -339,13 +339,17 @@ class StructureBackend(ExtendedOps):
         op.future.set_result(fn(ScriptContext(self), p["keys"], p["args"]))
 
     def _op_rename(self, key: str, op: Op) -> None:
+        """RENAME / RENAMENX (payload nx=True): atomic on the dispatcher."""
         kv = self._entry(key)
         if kv is None:
             raise KeyError(f"no such key '{key}'")
         with self._lock:
+            if op.payload.get("nx") and op.payload["newkey"] in self._data:
+                op.future.set_result(False)
+                return
             del self._data[key]
             self._data[op.payload["newkey"]] = kv
-        op.future.set_result(None)
+        op.future.set_result(True)
 
     def _op_type(self, key: str, op: Op) -> None:
         kv = self._entry(key)

@@ -681,6 +681,15 @@ class ExtendedOps:
             kv.value = 0
         op.future.set_result(drained)
 
+    def _op_sem_set_permits(self, key: str, op: Op) -> None:
+        from redisson_tpu.structures.engine import T
+
+        kv = self._create(key, T.SEMAPHORE, lambda: 0)
+        kv.value = int(op.payload["permits"])
+        if kv.value > 0:
+            self.pubsub.publish(SEMAPHORE_CHANNEL_PREFIX + key, kv.value)
+        op.future.set_result(None)
+
     def _op_sem_add_permits(self, key: str, op: Op) -> None:
         from redisson_tpu.structures.engine import T
 
