@@ -230,6 +230,12 @@ class RedissonTPU:
         self._executor = CommandExecutor(
             self._backend, metrics=ExecutorMetrics(self.metrics))
         self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        # Observability for the blocking-pop silent-loss window (reply
+        # window expires exactly as the server pops, or a mid-reply drop
+        # forces a re-drive — r2 advisor finding): per-backend-instance so
+        # two clients in one process never pool their counts.
+        self.metrics.gauge("redis.blocking_pop_loss_windows",
+                           lambda: self._backend.blocking_pop_loss_windows)
         # Engine-backed tiers are absent; coordination runs as server-side
         # Lua + pub/sub wake-ups instead (interop/coordination_redis.py) —
         # the reference's own execution model.

@@ -61,12 +61,13 @@ def _ck(v):
 class RedisBackend(RedisBloomMixin):
     """Backend for CommandExecutor whose run() executes via RESP."""
 
-    # Observability: times a blocking pop's reply window expired with the
-    # popped value unknown (potential element loss — see _op_bpop).
-    blocking_pop_loss_windows = 0
-
     def __init__(self, client: SyncRespClient, hash_seed: int = 0):
         self.client = client
+        # Observability: times a blocking pop's value became unknown (reply
+        # window expired, or a connection drop mid-reply forced a re-drive)
+        # — potential element loss, see _op_bpop. Per INSTANCE: two clients
+        # in one process must not pool their counts.
+        self.blocking_pop_loss_windows = 0
         # Seed for the host-side bloom index walk; must match the TPU
         # tier's TpuConfig.hash_seed for cross-tier filters.
         self.hash_seed = hash_seed
@@ -382,26 +383,71 @@ class RedisBackend(RedisBloomMixin):
         side = op.payload.get("side", "left")
         dest = op.payload.get("dest")
         timeout_s = op.payload.get("timeout_s")
-        # Server-side wait; 0 = block forever. The client-side reply window
-        # adds the normal response timeout as slack.
-        server_timeout = 0.0 if timeout_s is None else max(float(timeout_s), 0.05)
         slack = getattr(self.client, "timeout", 30.0)
-        response_timeout = 10 ** 9 if timeout_s is None else server_timeout + slack
 
         def work():
+            import time as _time
+
+            deadline = (None if timeout_s is None
+                        else _time.monotonic() + max(float(timeout_s), 0.05))
+            attempt = 0
             try:
-                if dest is not None:
-                    v = self.client.execute_blocking(
-                        "BRPOPLPUSH", key, dest, _fmt_num(server_timeout),
-                        response_timeout=response_timeout)
-                    value = None if v is None else bytes(v)
-                else:
-                    cmd = "BLPOP" if side == "left" else "BRPOP"
-                    v = self.client.execute_blocking(
-                        cmd, key, _fmt_num(server_timeout),
-                        response_timeout=response_timeout)
-                    value = None if v is None else bytes(v[1])
-            except Exception as e:  # noqa: BLE001
+                while True:
+                    # Server-side wait; 0 = block forever. Each (re)attempt
+                    # recomputes the remaining window; the client-side reply
+                    # window adds the normal response timeout as slack.
+                    if deadline is None:
+                        server_timeout = 0.0
+                        response_timeout = 10 ** 9
+                    else:
+                        server_timeout = max(
+                            deadline - _time.monotonic(), 0.05)
+                        response_timeout = server_timeout + slack
+                    try:
+                        if dest is not None:
+                            v = self.client.execute_blocking(
+                                "BRPOPLPUSH", key, dest,
+                                _fmt_num(server_timeout),
+                                response_timeout=response_timeout)
+                            value = None if v is None else bytes(v)
+                        else:
+                            cmd = "BLPOP" if side == "left" else "BRPOP"
+                            v = self.client.execute_blocking(
+                                cmd, key, _fmt_num(server_timeout),
+                                response_timeout=response_timeout)
+                            value = None if v is None else bytes(v[1])
+                        break
+                    except (ConnectionError, OSError) as e:
+                        # The node parked under us died (or the connection
+                        # dropped): RE-DRIVE the blocking pop against the
+                        # router's CURRENT master — the reference reattaches
+                        # in-flight blocking commands on failover
+                        # (connection/MasterSlaveEntry.java:158-250).
+                        # NOTE: if the server popped and the reply died on
+                        # the wire, the re-drive double-pops — the same
+                        # unknown-value window as the reply-timeout path, so
+                        # count it (exactly-once callers use BRPOPLPUSH,
+                        # where the value lands in dest regardless).
+                        if dest is None:
+                            self.blocking_pop_loss_windows += 1
+                        attempt += 1
+                        if op.future.done():  # model gave up (bpop_cancel)
+                            return
+                        if getattr(self.client, "closed", False):
+                            # Client shutdown, not failover: fail fast
+                            # instead of ~100 backoff retries against a
+                            # permanently closed client.
+                            raise e
+                        if (deadline is not None
+                                and _time.monotonic() >= deadline):
+                            value = None
+                            break
+                        if attempt > 100:  # defensive: not a tight spin
+                            raise e
+                        _time.sleep(min(0.1 * attempt, 1.0))
+            except BaseException as e:  # noqa: BLE001 — CancelledError
+                # (BaseException on 3.8+) arrives from teardown's
+                # _cancel_leftover_tasks; the future must still resolve.
                 if isinstance(e, TimeoutError) and dest is None:
                     # Response window expired exactly as the server may have
                     # popped: the element's value is unknown, so it cannot be
@@ -411,11 +457,11 @@ class RedisBackend(RedisBloomMixin):
                     # BRPOPLPUSH, which lands the value in dest regardless).
                     import logging
 
-                    type(self).blocking_pop_loss_windows += 1
+                    self.blocking_pop_loss_windows += 1
                     logging.getLogger(__name__).warning(
                         "blocking pop on %r timed out in the reply window; "
                         "a popped element may be lost (total windows: %d)",
-                        key, type(self).blocking_pop_loss_windows)
+                        key, self.blocking_pop_loss_windows)
                 if not op.future.done():
                     try:
                         op.future.set_exception(e)

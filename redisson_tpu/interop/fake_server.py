@@ -1593,6 +1593,17 @@ class FakeRedisServer:
 
     def _cmd_publish(self, a):
         channel, payload = bytes(a[0]), bytes(a[1])
+        receivers = self._deliver_publish(channel, payload)
+        # Redis Cluster broadcasts PUBLISH over the cluster bus: a
+        # subscriber on ANY node receives messages published on any other.
+        # The reply, like real Redis, counts only THIS node's receivers.
+        state = getattr(self, "cluster_state", None)
+        for peer in getattr(state, "servers", []) if state else ():
+            if peer is not self:
+                peer._deliver_publish(channel, payload)
+        return _int(receivers)
+
+    def _deliver_publish(self, channel: bytes, payload: bytes) -> int:
         receivers = 0
         for writer, (chans, pats) in list(self._subs.items()):
             frames = []
@@ -1610,7 +1621,7 @@ class FakeRedisServer:
                     writer.write(b"".join(frames))
                 except Exception:  # noqa: BLE001 - dying subscriber
                     self._subs.pop(writer, None)
-        return _int(receivers)
+        return receivers
 
     # -- blocking pops ------------------------------------------------------
 
@@ -1730,6 +1741,8 @@ class ClusterState:
     def __init__(self):
         # addr -> {"id": str, "role": "master"|"slave", "master": addr|None}
         self.nodes: Dict[str, Dict] = {}
+        # live FakeRedisServer peers for the cluster-bus PUBLISH broadcast
+        self.servers: List[FakeRedisServer] = []
         # (start, end) inclusive -> master addr
         self.ranges: List[Tuple[int, int, str]] = []
 
@@ -1815,6 +1828,7 @@ class ClusterFixture:
             self.state.add_master(addr, [(start, end)])
             er.server.cluster_state = self.state
             er.server.cluster_self = addr
+            self.state.servers.append(er.server)
         self.addresses = [f"127.0.0.1:{er.port}" for er in self.embedded]
 
     def server_for(self, addr: str) -> FakeRedisServer:
@@ -1833,6 +1847,7 @@ class ClusterFixture:
         er.server.replicating_from = master_addr
         er.server.cluster_state = self.state
         er.server.cluster_self = addr
+        self.state.servers.append(er.server)
         self.state.add_slave(addr, master_addr)
         self.addresses.append(addr)
         return addr

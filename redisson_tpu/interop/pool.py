@@ -361,10 +361,21 @@ class RespConnectionPool:
     def reaped(self) -> int:
         return self._pool.reaped
 
+    @property
+    def closed(self) -> bool:
+        return self._loop.is_closed()
+
     def close(self) -> None:
         try:
             self._run(self._pool.close())
         finally:
+            try:
+                from redisson_tpu.interop.resp_client import (
+                    _cancel_leftover_tasks)
+
+                self._run(_cancel_leftover_tasks())
+            except Exception:  # noqa: BLE001 — teardown best effort
+                pass
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=5)
             self._loop.close()

@@ -162,6 +162,7 @@ class MasterSlaveRouter:
         self._slot_table: Dict[int, str] = {}  # slot -> "host:port" (MOVED)
         self.promotions = 0  # observability: master changes
         self.redirects = 0   # observability: MOVED/ASK followed
+        self.closed = False  # parked blocking ops bail once set
 
     # -- pool bookkeeping ----------------------------------------------------
 
@@ -195,6 +196,7 @@ class MasterSlaveRouter:
                 pass
 
     def close(self) -> None:
+        self.closed = True  # parked blocking ops bail instead of re-driving
         with self._lock:
             pools, self._pools = list(self._pools.values()), {}
         for p in pools:
@@ -209,6 +211,11 @@ class MasterSlaveRouter:
 
     @property
     def master_address(self) -> str:
+        """Current primary endpoint. The client's coordination pub/sub
+        dials through this, so subscribe connections FOLLOW topology
+        changes (master promotion, sentinel switch, cluster failover) —
+        the reference migrates pub/sub listeners the same way
+        (MasterSlaveEntry.java:158-250)."""
         return self._master
 
     # -- routing -------------------------------------------------------------
@@ -351,8 +358,22 @@ class MasterSlaveRouter:
             raise
 
     def execute_blocking(self, *args, response_timeout: float) -> Any:
-        return self._run_on(self._master, "execute_blocking", *args,
-                            response_timeout=response_timeout)
+        addr = self._master
+        try:
+            return self._run_on(addr, "execute_blocking", *args,
+                                response_timeout=response_timeout)
+        except (ConnectionError, OSError):
+            # A dead master would park blocking pops forever: promote (the
+            # failed-write policy) and re-raise so the caller's re-drive
+            # loop lands on the NEW master — the reference reattaches
+            # in-flight blocking commands the same way on failover
+            # (connection/MasterSlaveEntry.java:158-250). Promote only if
+            # the failed endpoint is STILL the master: a second parked pop
+            # racing the same death must not promote again (and possibly
+            # reinstate the dead node).
+            if addr == self._master:
+                self._promote()
+            raise
 
 
 class SentinelManager:
