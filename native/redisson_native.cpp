@@ -458,17 +458,22 @@ static inline uint64_t mm3_h1_u64(uint64_t key, uint64_t seed) {
   return h1 + h2;
 }
 
+// One u64 key folded into a register array — THE p=14 fold step (rank =
+// ctz((h1 >> 14) | 2^50) + 1, range [1, 51]; ops/hll.py bucket_rank /
+// Redis hllPatLen). Shared by the flat and bank folds so the formula can
+// never diverge between them.
+static inline void hll_fold_step_u64(uint64_t key, uint64_t seed,
+                                     uint8_t* regs) {
+  uint64_t h1 = mm3_h1_u64(key, seed);
+  uint32_t bucket = (uint32_t)(h1 & 16383u);
+  uint64_t rest = (h1 >> 14) | (1ULL << 50);
+  uint8_t rank = (uint8_t)(__builtin_ctzll(rest) + 1);
+  if (rank > regs[bucket]) regs[bucket] = rank;
+}
+
 static void hll_fold_u64_range(const uint64_t* keys, int64_t n, uint64_t seed,
                                uint8_t* regs) {
-  for (int64_t i = 0; i < n; i++) {
-    uint64_t h1 = mm3_h1_u64(keys[i], seed);
-    uint32_t bucket = (uint32_t)(h1 & 16383u);
-    // rank = ctz((h1 >> 14) | 2^50) + 1, range [1, 51] (ops/hll.py
-    // bucket_rank; Redis hllPatLen).
-    uint64_t rest = (h1 >> 14) | (1ULL << 50);
-    uint8_t rank = (uint8_t)(__builtin_ctzll(rest) + 1);
-    if (rank > regs[bucket]) regs[bucket] = rank;
-  }
+  for (int64_t i = 0; i < n; i++) hll_fold_step_u64(keys[i], seed, regs);
 }
 
 // Host-side HLL fold over u64 keys: the transfer-adaptive ingest path.
@@ -504,6 +509,21 @@ RTPU_EXPORT void rtpu_hll_fold_u64(const uint64_t* keys, int64_t n,
   for (auto& sc : scratch)
     for (int i = 0; i < 16384; i++)
       if (sc[(size_t)i] > regs[i]) regs[i] = sc[(size_t)i];
+}
+
+// Row-aware u64 fold into a BANK of sketches (bank = nrows x 16384 uint8,
+// row-major): the host half of the sharded-bank streaming ingest — fold a
+// keyed stream into a host bank mirror, ship/absorb the bank periodically
+// instead of 8 B/key (BASELINE config 4's host path).
+RTPU_EXPORT void rtpu_hll_fold_u64_rows(const uint64_t* keys,
+                                        const int32_t* rows, int64_t n,
+                                        uint64_t seed, uint8_t* bank,
+                                        int64_t nrows) {
+  for (int64_t i = 0; i < n; i++) {
+    int64_t row = rows[i];
+    if (row < 0 || row >= nrows) continue;  // defensive: never scribble
+    hll_fold_step_u64(keys[i], seed, bank + row * 16384);
+  }
 }
 
 // Row-layout byte-key fold: keys arrive as the executor's padded [n, w]
@@ -588,7 +608,42 @@ template <bool Atomic>
 static void bloom_fold_u64_range(const uint64_t* keys, int64_t n,
                                  uint64_t seed, int32_t k, uint64_t m,
                                  uint8_t* bits, uint8_t* newly) {
-  for (int64_t i = 0; i < n; i++) {
+  // The walk is memory-latency-bound (k random bytes in an L3-sized
+  // bitmap): stage a block of keys' indexes, software-prefetch them all,
+  // then apply — overlapping the misses instead of serializing them.
+  constexpr int64_t kBlock = 32;
+  constexpr int32_t kMaxK = 32;
+  uint64_t idx[kBlock * kMaxK];
+  int32_t kk = k > kMaxK ? kMaxK : k;
+  int64_t i = 0;
+  for (; i + kBlock <= n && k <= kMaxK; i += kBlock) {
+    for (int64_t b = 0; b < kBlock; b++) {
+      uint64_t h1, h2;
+      mm3_u64_pair(keys[i + b], seed, &h1, &h2);
+      uint64_t acc = h1;
+      for (int32_t j = 0; j < kk; j++) {
+        uint64_t ix = acc % m;
+        idx[b * kk + j] = ix;
+        __builtin_prefetch(&bits[ix >> 3], 1, 1);
+        acc += h2;
+      }
+    }
+    for (int64_t b = 0; b < kBlock; b++) {
+      uint8_t fresh = 0;
+      for (int32_t j = 0; j < kk; j++) {
+        uint64_t ix = idx[b * kk + j];
+        if (!bloom_get_bit(bits, ix)) {
+          fresh = 1;
+          if (Atomic)
+            bloom_set_bit_atomic(bits, ix);
+          else
+            bits[ix >> 3] |= (uint8_t)(0x80u >> (ix & 7u));
+        }
+      }
+      if (newly) newly[i + b] = fresh;
+    }
+  }
+  for (; i < n; i++) {
     uint64_t h1, h2;
     mm3_u64_pair(keys[i], seed, &h1, &h2);
     uint8_t fresh = bloom_fold_one<Atomic>(h1, h2, k, m, bits);
@@ -630,7 +685,21 @@ RTPU_EXPORT void rtpu_bloom_fold_u64(const uint64_t* keys, int64_t n,
 static void bloom_probe_u64_range(const uint64_t* keys, int64_t n,
                                   uint64_t seed, int32_t k, uint64_t m,
                                   const uint8_t* bits, uint8_t* out) {
-  for (int64_t i = 0; i < n; i++) {
+  // Same staged-prefetch structure as the fold: prefetch only each key's
+  // FIRST index (negative probes usually fail there; positive probes pay
+  // the remaining misses, still overlapped across the block).
+  constexpr int64_t kBlock = 32;
+  uint64_t h1s[kBlock], h2s[kBlock];
+  int64_t i = 0;
+  for (; i + kBlock <= n; i += kBlock) {
+    for (int64_t b = 0; b < kBlock; b++) {
+      mm3_u64_pair(keys[i + b], seed, &h1s[b], &h2s[b]);
+      __builtin_prefetch(&bits[(h1s[b] % m) >> 3], 0, 1);
+    }
+    for (int64_t b = 0; b < kBlock; b++)
+      out[i + b] = bloom_probe_one(h1s[b], h2s[b], k, m, bits);
+  }
+  for (; i < n; i++) {
     uint64_t h1, h2;
     mm3_u64_pair(keys[i], seed, &h1, &h2);
     out[i] = bloom_probe_one(h1, h2, k, m, bits);

@@ -95,6 +95,9 @@ def _load():
             u64p, ctypes.c_int64, ctypes.c_uint64, u8p, ctypes.c_int32]
         lib.rtpu_hll_fold_rows.argtypes = [
             u8p, ctypes.c_int64, i32p, ctypes.c_int64, ctypes.c_uint64, u8p]
+        lib.rtpu_hll_fold_u64_rows.argtypes = [
+            u64p, i32p, ctypes.c_int64, ctypes.c_uint64, u8p,
+            ctypes.c_int64]
         lib.rtpu_bloom_fold_u64.argtypes = [
             u64p, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int32,
             ctypes.c_uint64, u8p, u8p, ctypes.c_int32]
@@ -463,6 +466,29 @@ def hll_fold_u64(
         keys.shape[0], ctypes.c_uint64(seed), _u8p(regs),
         ctypes.c_int32(nthreads))
     return regs
+
+
+def hll_fold_u64_rows(keys: np.ndarray, rows: np.ndarray,
+                      bank: np.ndarray, seed: int = 0) -> np.ndarray:
+    """Fold u64 keys into per-row sketches of a host bank mirror
+    ([nrows, 16384] uint8, in place) — the host half of the sharded-bank
+    streaming ingest (ship the folded bank periodically, not 8 B/key).
+    Requires the native library (callers gate on available())."""
+    assert bank.dtype == np.uint8 and bank.ndim == 2 and bank.shape[1] == 16384
+    # in-place raw-pointer writes: a strided view would be corrupted at
+    # wrong offsets (and a copy would lose the caller's updates) — refuse
+    assert bank.flags.c_contiguous, "bank mirror must be C-contiguous"
+    keys = _norm_u64_keys(keys, "hll_fold_u64_rows")
+    rows = np.ascontiguousarray(rows, np.int32)
+    assert rows.shape[0] == keys.shape[0]
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    lib.rtpu_hll_fold_u64_rows(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        keys.shape[0], ctypes.c_uint64(seed), _u8p(bank), bank.shape[0])
+    return bank
 
 
 def hll_fold_rows(

@@ -139,6 +139,19 @@ def bank_count_rows_merged(bank, rows, mesh: Mesh):
     return hll.count(jnp.max(sub, axis=0))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _absorb_host(bank, host_bank):
+    return jnp.maximum(bank, host_bank.astype(jnp.int32))
+
+
+def bank_absorb_host(bank, host_u8, mesh: Mesh) -> jax.Array:
+    """Max-merge a host-folded [S, m] uint8 bank mirror into the sharded
+    device bank — the absorb half of the streaming host-ingest path
+    (native.hll_fold_u64_rows folds the key stream on host; one bank
+    upload per absorb interval replaces 8 B/key of link traffic)."""
+    return _absorb_host(bank, jax.device_put(host_u8, bank_sharding(mesh)))
+
+
 def zero_row(bank, row: int) -> jax.Array:
     """Reset one sketch row (pod-mode DEL of an HLL)."""
     return bank.at[row].set(0)
