@@ -769,23 +769,41 @@ class TpuBackend:
                 _complete_all([op], lambda est=est: int(round(float(est))))
             )
 
+    def _merge_rows(self, target: str, names) -> tuple:
+        """(target_row, padded source-row vector incl. target) for the
+        PFMERGE family — target participates in the max, missing sources
+        are skipped, pad-with-repeats keeps shapes static per pow2 class."""
+        trow = self._hll_row(target)
+        rows = [trow] + [
+            r for n in names
+            if (r := self._hll_row(n, create=False)) is not None
+        ]
+        return np.int32(trow), engine.pad_rows_repeat(np.array(rows, np.int32))
+
     def _op_hll_merge_with(self, target: str, ops: List[Op]) -> None:
         # PFMERGE semantics: fold sources into target — one gather +
         # row-max + row-set kernel (target row is in the gathered set, so
         # existing target registers participate in the max).
         for op in ops:
-            trow = self._hll_row(target)
-            rows = [trow] + [
-                r for n in op.payload["names"]
-                if (r := self._hll_row(n, create=False)) is not None
-            ]
-            self.bank = engine.hll_bank_merge_rows(
-                self.bank,
-                engine.pad_rows_repeat(np.array(rows, np.int32)),
-                np.int32(trow),
-            )
+            trow, rows = self._merge_rows(target, op.payload["names"])
+            self.bank = engine.hll_bank_merge_rows(self.bank, rows, trow)
             self._bump(target)
             op.future.set_result(None)
+
+    def _op_hll_merge_count(self, target: str, ops: List[Op]) -> None:
+        # Fused PFMERGE+PFCOUNT (one device program, one D2H sync) — the
+        # blocking merge_with+count path costs one link RTT instead of
+        # three (reference: single pipelined batch,
+        # RedissonHyperLogLog.java:78-97).
+        for op in ops:
+            trow, rows = self._merge_rows(target, op.payload["names"])
+            self.bank, est = engine.hll_bank_merge_count_rows(
+                self.bank, rows, trow)
+            self._bump(target)
+            est = _start_d2h(est)
+            self.completer.submit(
+                _complete_all([op], lambda est=est: int(round(float(est))))
+            )
 
     # -- BitSet -------------------------------------------------------------
 
@@ -1277,6 +1295,13 @@ class TpuBackend:
         whole check+move runs on the dispatcher."""
         for op in ops:
             new = op.payload["newkey"]
+            # Redis RENAME/RENAMENX errors on a missing source regardless of
+            # the destination, and must leave the destination intact — so the
+            # source check comes first, before any destructive step, and a
+            # failure is per-op (doesn't abort coalesced siblings).
+            if target not in self._rows and not self.store.exists(target):
+                op.future.set_exception(KeyError(f"no such key '{target}'"))
+                continue
             if op.payload.get("nx") and (
                     new in self._rows or self.store.exists(new)):
                 op.future.set_result(False)
@@ -1291,13 +1316,11 @@ class TpuBackend:
                 self._alloc.rows[new] = self._alloc.rows.pop(target)
                 self._alloc.versions[new] = (
                     self._alloc.versions.pop(target, 0) + 1)
-            elif self.store.exists(target):
+            else:
                 self.store.rename(target, new)
                 mir = self._bloom_mirrors.pop(target, None)
                 if mir is not None:
                     self._bloom_mirrors[new] = mir
-            else:
-                raise KeyError(f"no such key '{target}'")
             op.future.set_result(True)
 
     def _op_flushall(self, target: str, ops: List[Op]) -> None:

@@ -201,6 +201,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("hll_count", "PFCOUNT", False, "tpu redis"),
     _d("hll_count_with", "PFCOUNT", False, "tpu redis"),
     _d("hll_merge_with", "PFMERGE", True, "tpu redis"),
+    _d("hll_merge_count", "PFMERGE", True, "tpu redis"),
     _d("hll_export", "GET", False, "tpu redis"),
     _d("hll_import", "RESTORE", True, "tpu"),
     _d("bitset_set", "SETBIT", True, "tpu redis"),

@@ -597,26 +597,31 @@ class RedisBackend(RedisBloomMixin):
 
     def _op_bitset_length(self, key: str, op: Op) -> None:
         """Logical length = highest set bit + 1 (reference lengthAsync's Lua
-        bitpos scan, RedissonBitSet.java:181-192). Implemented as a
-        backwards GETRANGE scan: pull trailing chunks until a nonzero byte
-        appears — wire traffic is bounded by the zero tail, not the bitmap."""
+        bitpos scan, RedissonBitSet.java:181-192). Binary search for the
+        last nonzero byte with ranged BITCOUNT — O(log n) round trips and
+        O(1) transfer regardless of bitmap contents (an all-zero bitmap
+        costs one BITCOUNT, not a full download)."""
         nbytes = int(self._x("STRLEN", key) or 0)
-        chunk = 4096
-        i = nbytes
-        while i > 0:
-            s = max(0, i - chunk)
-            raw = bytes(self._x("GETRANGE", key, s, i - 1) or b"")
-            for j in range(len(raw) - 1, -1, -1):
-                v = raw[j]
-                if v:
-                    # Redis bit n -> byte n>>3, mask 0x80>>(n&7): within a
-                    # byte the HIGHEST bit index is its least significant
-                    # set bit.
-                    low = (v & -v).bit_length() - 1
-                    op.future.set_result((s + j) * 8 + (7 - low) + 1)
-                    return
-            i = s
-        op.future.set_result(0)
+        if nbytes == 0 or int(self._x("BITCOUNT", key) or 0) == 0:
+            op.future.set_result(0)
+            return
+        # Invariant: bytes [lo, nbytes) contain at least one set bit.
+        lo, hi = 0, nbytes - 1
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if int(self._x("BITCOUNT", key, mid, nbytes - 1) or 0) > 0:
+                lo = mid
+            else:
+                hi = mid - 1
+        raw = bytes(self._x("GETRANGE", key, lo, lo) or b"")
+        v = raw[0] if raw else 0
+        if not v:
+            op.future.set_result(0)
+            return
+        # Redis bit n -> byte n>>3, mask 0x80>>(n&7): within a byte the
+        # HIGHEST bit index is its least significant set bit.
+        low = (v & -v).bit_length() - 1
+        op.future.set_result(lo * 8 + (7 - low) + 1)
 
     def _op_bitset_set_range(self, key: str, op: Op) -> None:
         """Range set/clear. The reference issues one SETBIT per bit in a
@@ -699,6 +704,19 @@ class RedisBackend(RedisBloomMixin):
     def _op_hll_merge_with(self, key: str, op: Op) -> None:
         self._x("PFMERGE", key, *op.payload["names"])
         op.future.set_result(None)
+
+    def _op_hll_merge_count(self, key: str, op: Op) -> None:
+        """Fused merge+count: PFMERGE and PFCOUNT pipelined in ONE wire
+        round trip (the reference's RBatch shape,
+        RedissonHyperLogLog.java:78-97). pipeline() returns RespError
+        replies inline rather than raising — _ck() surfaces either
+        command's error (a swallowed WRONGTYPE on the PFMERGE would return
+        a stale count)."""
+        names = op.payload["names"]
+        merged, cnt = self.client.pipeline(
+            [("PFMERGE", key, *names), ("PFCOUNT", key)])
+        _ck(merged)
+        op.future.set_result(int(_ck(cnt)))
 
     def _op_hll_export(self, key: str, op: Op) -> None:
         """(registers uint8[16384], version) decoded from the server's own

@@ -596,6 +596,16 @@ class FakeRedisServer:
 
     def _cmd_bitcount(self, a):
         buf = self.data.get(bytes(a[0]), b"")
+        if len(a) >= 3:  # BITCOUNT key start end (byte offsets, negatives ok)
+            start, end = int(a[1]), int(a[2])
+            n = len(buf)
+            if start < 0:
+                start = max(0, n + start)
+            if end < 0:
+                end = max(0, n + end)  # redis clamps past-the-start to byte 0
+            buf = buf[start:end + 1] if end >= start else b""
+        if not buf:
+            return _int(0)
         return _int(int(np.unpackbits(np.frombuffer(buf, np.uint8)).sum()))
 
     def _cmd_bitop(self, a):

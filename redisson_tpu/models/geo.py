@@ -31,15 +31,18 @@ class RGeo(RExpirable):
 
     _GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
 
-    def hash(self, *members: Any) -> Dict[Any, str]:
+    def hash(self, *members: Any) -> Dict[Any, Optional[str]]:
         """Reference hash() -> GEOHASH strings (11-char base32 geohash of
         each member's position, computed from the stored coordinates).
         Matches Redis GEOHASH exactly: ten characters from the first 50 of
         its 52 interleaved bits, and a literal '0' eleventh character
         (Redis discards the last two bits and hard-codes that char —
-        geohashCommand in geo.c)."""
-        out: Dict[Any, str] = {}
-        for member, (lon, lat) in self.pos(*members).items():
+        geohashCommand in geo.c). Members with no stored position map to
+        None, mirroring GEOHASH's per-member nil reply — callers can tell
+        'missing member' from 'not queried'."""
+        pos = self.pos(*members)
+        out: Dict[Any, Optional[str]] = {m: None for m in members}
+        for member, (lon, lat) in pos.items():
             lat_rng, lon_rng = [-90.0, 90.0], [-180.0, 180.0]
             bits = []
             even = True

@@ -90,3 +90,17 @@ class RHyperLogLog(RObject):
         return self._executor.execute_async(
             self.name, "hll_merge_with", {"names": list(other_names)}
         )
+
+    def merge_with_and_count(self, *other_names: str) -> int:
+        """Fused PFMERGE+PFCOUNT: fold `other_names` into this sketch and
+        return the merged cardinality with ONE dependent device sync (one
+        wire round trip in redis mode). The blocking twin of what the
+        reference achieves by pipelining mergeWith+count in an RBatch
+        (RedissonHyperLogLog.java:78-97) — `merge_with(); count()` pays two
+        dependent syncs, this pays one."""
+        return self.merge_with_and_count_async(*other_names).result()
+
+    def merge_with_and_count_async(self, *other_names: str):
+        return self._executor.execute_async(
+            self.name, "hll_merge_count", {"names": list(other_names)}
+        )

@@ -57,9 +57,18 @@ class RKeys:
             "", "keys", {"pattern": pattern})
 
     def delete_async(self, *names: str):
-        """Stage/async delete; resolves to the number of keys removed."""
+        """Stage/async delete; resolves to the number of keys removed.
+
+        The aggregate never blocks inside a done-callback: callbacks run on
+        the dispatcher thread, and waiting there for a sibling future that
+        the same thread must complete would deadlock the client.  Instead
+        each future decrements a counter and the last one to finish sums the
+        (all-done) results.
+        """
         from redisson_tpu.models.object import map_future
 
+        if not names:
+            return None
         if len(names) == 1:
             return map_future(
                 self._executor.execute_async(names[0], "delete", None),
@@ -67,10 +76,34 @@ class RKeys:
         futs = [self._executor.execute_async(n, "delete", None)
                 for n in names]
 
-        def _sum(_last):
-            return sum(int(bool(f.result())) for f in futs)
+        import threading
+        from concurrent.futures import Future
 
-        return map_future(futs[-1], _sum) if futs else None
+        out = Future()
+        remaining = [len(futs)]
+        lock = threading.Lock()
+
+        def _one_done(_f):
+            with lock:
+                remaining[0] -= 1
+                if remaining[0]:
+                    return
+            # All siblings are done; reading result() cannot block now.
+            try:
+                total = 0
+                for f in futs:
+                    exc = f.exception()
+                    if exc is not None:
+                        out.set_exception(exc)
+                        return
+                    total += int(bool(f.result()))
+                out.set_result(total)
+            except Exception as e:  # pragma: no cover - defensive
+                out.set_exception(e)
+
+        for f in futs:
+            f.add_done_callback(_one_done)
+        return out
 
     def flushall(self) -> None:
         self._executor.execute_sync("", "flushall", None)

@@ -158,6 +158,11 @@ class PodBackend:
         own handler would zero ITS bank, which pod mode never allocates)."""
         for op in ops:
             new = op.payload["newkey"]
+            # Source check first: Redis errors on a missing source regardless
+            # of NX and leaves the destination untouched.
+            if target not in self._rows and not self.store.exists(target):
+                op.future.set_exception(KeyError(f"no such key '{target}'"))
+                continue
             if op.payload.get("nx") and (
                     new in self._rows or self.store.exists(new)):
                 op.future.set_result(False)
@@ -171,14 +176,11 @@ class PodBackend:
                 self._alloc.rows[new] = self._alloc.rows.pop(target)
                 self._alloc.versions[new] = (
                     self._alloc.versions.pop(target, 0) + 1)
-            elif self.store.exists(target):
+            else:
                 self.store.rename(target, new)
                 mir = self._delegate._bloom_mirrors.pop(target, None)
                 if mir is not None:
                     self._delegate._bloom_mirrors[new] = mir
-            else:
-                op.future.set_exception(KeyError(f"no such key '{target}'"))
-                continue
             op.future.set_result(True)
 
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
@@ -281,18 +283,36 @@ class PodBackend:
             self.completer.submit(
                 _complete_all([op], lambda est=est: int(round(float(est)))))
 
-    def _op_hll_merge_with(self, target: str, ops: List[Op]) -> None:
-        import jax.numpy as jnp
+    def _merge_rows(self, target: str):
+        """(target_row, fn(names) -> padded source rows incl. target) —
+        shared by the merge_with / fused merge_count pair."""
+        trow = self.row_of(target)
 
+        def rows_of(names):
+            rows = [trow] + [self._rows[n] for n in names if n in self._rows]
+            return engine.pad_rows_repeat(np.array(rows, np.int32))
+
+        return trow, rows_of
+
+    def _op_hll_merge_with(self, target: str, ops: List[Op]) -> None:
+        trow, rows_of = self._merge_rows(target)
         for op in ops:
-            rows = [self.row_of(target)] + [
-                self._rows[n] for n in op.payload["names"] if n in self._rows
-            ]
-            rows_arr = engine.pad_rows_repeat(np.array(rows, np.int32))
-            merged = jnp.max(self.bank[rows_arr], axis=0)
-            self.bank = self.bank.at[self.row_of(target)].set(merged)
+            self.bank = sharded.bank_merge_rows(
+                self.bank, rows_of(op.payload["names"]), np.int32(trow))
             self._row_versions[target] = self._row_versions.get(target, 0) + 1
             op.future.set_result(None)
+
+    def _op_hll_merge_count(self, target: str, ops: List[Op]) -> None:
+        """Fused PFMERGE+PFCOUNT (one program, one sync) — pod twin of the
+        single-chip handler."""
+        trow, rows_of = self._merge_rows(target)
+        for op in ops:
+            self.bank, est = sharded.bank_merge_count_rows(
+                self.bank, rows_of(op.payload["names"]), np.int32(trow))
+            self._row_versions[target] = self._row_versions.get(target, 0) + 1
+            est = _start_d2h(est)
+            self.completer.submit(
+                _complete_all([op], lambda est=est: int(round(float(est)))))
 
     def _op_hll_count_all(self, target: str, ops: List[Op]) -> None:
         """Union count of the entire bank — one ICI pmax all-reduce."""

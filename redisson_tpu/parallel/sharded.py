@@ -140,6 +140,24 @@ def bank_count_rows_merged(bank, rows, mesh: Mesh):
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
+def bank_merge_rows(bank, rows, target):
+    """PFMERGE `rows` (caller includes `target`) into row `target` over the
+    sharded bank (XLA inserts the cross-device gather/update)."""
+    merged = jnp.max(bank[rows], axis=0)
+    return bank.at[target].set(merged)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bank_merge_count_rows(bank, rows, target):
+    """Fused PFMERGE+PFCOUNT over the sharded bank: fold `rows` (includes
+    `target`) into row `target` and estimate the union in one program —
+    one dependent D2H sync on the blocking path (XLA inserts the
+    cross-device gather/update for the row sharding)."""
+    merged = jnp.max(bank[rows], axis=0)
+    return bank.at[target].set(merged), hll.count(merged)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _absorb_host(bank, host_bank):
     return jnp.maximum(bank, host_bank.astype(jnp.int32))
 

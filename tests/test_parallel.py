@@ -165,5 +165,11 @@ def test_pod_int_and_byte_key_groups_coalesce():
         assert fb.result() in (True, False)
         assert abs(a.count() - 2048) / 2048 < 0.1
         assert abs(b.count() - 2048) / 2048 < 0.1
+        # fused merge+count over the sharded bank: one program, one sync,
+        # same value as the two-step path
+        dest = pod.get_hyper_log_log("grp:dest")
+        got = dest.merge_with_and_count("grp:a", "grp:b")
+        assert got == a.count_with("grp:b")
+        assert dest.count() == got
     finally:
         pod.shutdown()

@@ -126,6 +126,15 @@ def test_hll_over_redis(rclient):
     assert h.count_with("rm:hll2") >= est
     h.merge_with("rm:hll2")
     assert h.count() >= est
+    # fused merge+count: one pipelined round trip, same semantics
+    h3 = rclient.get_hyper_log_log("rm:hll3")
+    got = h3.merge_with_and_count("rm:hll", "rm:hll2")
+    assert got == h.count_with("rm:hll2")
+    # a WRONGTYPE source surfaces as an error, not a stale count (the
+    # pipelined PFMERGE reply is checked, review r5)
+    rclient.get_bucket("rm:str").set("plain")
+    with pytest.raises(Exception):
+        h3.merge_with_and_count("rm:str")
 
 
 def test_expiry_over_redis(rclient):
