@@ -1271,6 +1271,42 @@ class TpuBackend:
         for op in ops:
             op.future.set_result(int(round(est)))
 
+    def _op_bits_export(self, target: str, ops: List[Op]) -> None:
+        """(otype, host cells, meta, version) for a bitset/bloom — the
+        generic checkpoint/durability read (pod mode's sharded twin trims
+        its shard padding; here the array is already logical-length)."""
+        obj = self.store.get(target)
+        if obj is None or obj.otype not in (ObjectType.BITSET, ObjectType.BLOOM):
+            for op in ops:
+                op.future.set_result(None)
+            return
+        if obj.otype == ObjectType.BLOOM:
+            self._bloom_device_sync(target)
+            obj = self.store.get(target)
+        host = np.asarray(obj.state).astype(np.uint8)
+        for op in ops:
+            op.future.set_result((obj.otype, host, dict(obj.meta), obj.version))
+
+    def _op_bits_import(self, target: str, ops: List[Op]) -> None:
+        """Create/overwrite a store bitset/bloom from host cells (the
+        checkpoint-restore path; pod checkpoints restore into the
+        single-chip tier through this — portability both ways)."""
+        import jax
+
+        for op in ops:
+            otype = op.payload["otype"]
+            host = np.asarray(op.payload["array"]).astype(np.uint8)
+            meta = dict(op.payload.get("meta") or {})
+            self._check_not_hll(target, otype)
+            arr = jax.device_put(host, self.store.device)
+            if otype == ObjectType.BITSET:
+                meta.setdefault("nbits", host.shape[0])
+            obj = self.store.get_or_create(target, otype, lambda: arr, meta)
+            self.store.swap(target, arr)
+            obj.meta.update(meta)
+            self._bloom_mirrors.pop(target, None)
+            op.future.set_result(True)
+
     # -- generic ------------------------------------------------------------
 
     def _op_delete(self, target: str, ops: List[Op]) -> None:

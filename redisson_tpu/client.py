@@ -340,6 +340,17 @@ class RedissonTPU:
                 if exported is not None:
                     regs, version = exported
                     extra[n] = ("hll", regs, {}, version)
+            # Mesh-sharded bitsets/blooms live outside the store too (only
+            # the pod backend has them; the single-chip TpuBackend also
+            # passes the bank_names probe above but keeps bits in the store).
+            for n in (pod.sharded_bits_names()
+                      if hasattr(pod, "sharded_bits_names") else []):
+                if names is not None and n not in names:
+                    continue
+                exported = self._executor.execute_sync(n, "bits_export", None)
+                if exported is not None:
+                    otype, host, meta, version = exported
+                    extra[n] = (otype, host, meta, version)
         # Bloom barrier: host-mirror bits must reach device state before the
         # store snapshot reads it (same reason as the durability flush).
         from redisson_tpu.store import ObjectType
@@ -358,6 +369,8 @@ class RedissonTPU:
 
         self._require_store("checkpointing")
 
+        pod = self._pod_backend()
+
         def put(name, otype, host, meta) -> bool:
             if otype == "hll":
                 self._executor.execute_sync(name, "hll_import", {"regs": host})
@@ -365,6 +378,13 @@ class RedissonTPU:
                     obj = self._store.get(name)
                     if obj is not None:
                         obj.meta.update(meta)
+                return True
+            if pod is not None and otype in ("bitset", "bloom"):
+                # Pod mode: restore into a mesh-sharded array, not the
+                # single-chip delegate store.
+                self._executor.execute_sync(
+                    name, "bits_import",
+                    {"otype": otype, "array": host, "meta": meta})
                 return True
             return False  # default store path
 

@@ -114,6 +114,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("lrem_index", "LUA", True, _ALL),
     _d("linsert", "LINSERT", True, _ALL),
     _d("linsert_at", "LUA", True, _ALL),
+    _d("lretain", "LUA", True, _ALL),
     _d("ltrim", "LTRIM", True, _ALL),
     _d("lpop", "LPOP", True, _ALL),
     _d("rpop", "RPOP", True, _ALL),
@@ -218,6 +219,10 @@ OP_TABLE = {d.kind: d for d in [
     _d("bloom_contains_count", "BITCOUNT", False, "tpu redis"),
     _d("bloom_count", "BITCOUNT", False, "tpu redis"),
     _d("bloom_meta", "HGETALL", False, "tpu redis"),
+    # Generic bitset/bloom state export/import (checkpoint + durability;
+    # the sharded pod tier serves these from mesh-sharded arrays).
+    _d("bits_export", "DUMP", False, "tpu"),
+    _d("bits_import", "RESTORE", True, "tpu"),
     # Barrier flushing host-mirror bloom bits into device state before a
     # device-side read (durability/checkpoint); internal, no wire analogue.
     _d("bloom_sync", "-", True, "tpu"),

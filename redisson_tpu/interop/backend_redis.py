@@ -595,33 +595,52 @@ class RedisBackend(RedisBloomMixin):
             self._x("BITOP", kind.upper(), key, key, *names)
         op.future.set_result(None)
 
+    @staticmethod
+    def _last_set_bit(raw: bytes, base_byte: int):
+        """Highest set bit + 1 within `raw` at byte offset base_byte, or
+        None if raw is all zero. Redis bit n -> byte n>>3, mask 0x80>>(n&7):
+        within a byte the HIGHEST bit index is its least significant set
+        bit."""
+        for j in range(len(raw) - 1, -1, -1):
+            v = raw[j]
+            if v:
+                low = (v & -v).bit_length() - 1
+                return (base_byte + j) * 8 + (7 - low) + 1
+        return None
+
     def _op_bitset_length(self, key: str, op: Op) -> None:
         """Logical length = highest set bit + 1 (reference lengthAsync's Lua
-        bitpos scan, RedissonBitSet.java:181-192). Binary search for the
-        last nonzero byte with ranged BITCOUNT — O(log n) round trips and
-        O(1) transfer regardless of bitmap contents (an all-zero bitmap
-        costs one BITCOUNT, not a full download)."""
+        bitpos scan, RedissonBitSet.java:181-192). Common dense-tail case:
+        one trailing-chunk GETRANGE answers in 2 round trips. Zero tail:
+        binary search the prefix with ranged BITCOUNT — O(log n) round
+        trips and O(1) transfer instead of downloading the whole bitmap
+        (review r5 latency + advisor r4 transfer findings together)."""
         nbytes = int(self._x("STRLEN", key) or 0)
-        if nbytes == 0 or int(self._x("BITCOUNT", key) or 0) == 0:
+        if nbytes == 0:
             op.future.set_result(0)
             return
-        # Invariant: bytes [lo, nbytes) contain at least one set bit.
-        lo, hi = 0, nbytes - 1
+        chunk = 4096
+        tail_start = max(0, nbytes - chunk)
+        raw = bytes(self._x("GETRANGE", key, tail_start, nbytes - 1) or b"")
+        hit = self._last_set_bit(raw, tail_start)
+        if hit is not None:
+            op.future.set_result(hit)
+            return
+        if tail_start == 0 or int(
+                self._x("BITCOUNT", key, 0, tail_start - 1) or 0) == 0:
+            op.future.set_result(0)
+            return
+        # Invariant: bytes [lo, tail_start) contain at least one set bit.
+        lo, hi = 0, tail_start - 1
         while lo < hi:
             mid = (lo + hi + 1) // 2
-            if int(self._x("BITCOUNT", key, mid, nbytes - 1) or 0) > 0:
+            if int(self._x("BITCOUNT", key, mid, tail_start - 1) or 0) > 0:
                 lo = mid
             else:
                 hi = mid - 1
         raw = bytes(self._x("GETRANGE", key, lo, lo) or b"")
-        v = raw[0] if raw else 0
-        if not v:
-            op.future.set_result(0)
-            return
-        # Redis bit n -> byte n>>3, mask 0x80>>(n&7): within a byte the
-        # HIGHEST bit index is its least significant set bit.
-        low = (v & -v).bit_length() - 1
-        op.future.set_result(lo * 8 + (7 - low) + 1)
+        hit = self._last_set_bit(raw, lo)
+        op.future.set_result(hit or 0)
 
     def _op_bitset_set_range(self, key: str, op: Op) -> None:
         """Range set/clear. The reference issues one SETBIT per bit in a
@@ -748,6 +767,14 @@ class RedisBackend(RedisBloomMixin):
     def _score_bound(val, inc: bool, default: str) -> str:
         if val is None:
             return default
+        # Explicit ±inf bounds must render as redis -inf/+inf, not go
+        # through the numeric formatter (conformance vs
+        # RedissonScoredSortedSetTest.java:131-159 — the reference passes
+        # Double.NEGATIVE_INFINITY/POSITIVE_INFINITY straight through).
+        import math
+
+        if isinstance(val, float) and math.isinf(val):
+            return "-inf" if val < 0 else "+inf"
         s = _fmt_num(val)
         return s if inc else "(" + s
 
@@ -855,6 +882,36 @@ class RedisBackend(RedisBloomMixin):
             "  if keep == 0 then "
             "    redis.call('srem', KEYS[1], members[i]) "
             "    changed = 1 "
+            "  end "
+            "end "
+            "return changed",
+            [key], list(op.payload["members"]))
+        op.future.set_result(changed == 1)
+
+    def _op_lretain(self, key: str, op: Op) -> None:
+        """List retainAll server-side: rebuild keeping only ARGV values,
+        TTL preserved across the rebuild (review r5 — the old client-side
+        delete+rpush dropped it)."""
+        changed = self._eval(
+            "local vals = redis.call('lrange', KEYS[1], 0, -1) "
+            "local kept = {} "
+            "local changed = 0 "
+            "for i = 1, #vals do "
+            "  local keep = 0 "
+            "  for j = 1, #ARGV do "
+            "    if vals[i] == ARGV[j] then keep = 1 end "
+            "  end "
+            "  if keep == 1 then kept[#kept + 1] = vals[i] "
+            "  else changed = 1 end "
+            "end "
+            "if changed == 1 then "
+            "  local ttl = redis.call('pttl', KEYS[1]) "
+            "  redis.call('del', KEYS[1]) "
+            "  for i = 1, #kept do "
+            "    redis.call('rpush', KEYS[1], kept[i]) "
+            "  end "
+            "  if ttl > 0 and #kept > 0 then "
+            "    redis.call('pexpire', KEYS[1], ttl) "
             "  end "
             "end "
             "return changed",

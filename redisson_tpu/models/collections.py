@@ -62,8 +62,14 @@ class RSet(RExpirable):
             for m in self._executor.execute_sync(self.name, "srandmember", {"count": count})
         ]
 
-    def remove_random(self, count: int = 1) -> List[Any]:
-        return [self._d(m) for m in self._executor.execute_sync(self.name, "spop", {"count": count})]
+    def remove_random(self, count: int = None):
+        """removeRandom() -> one element or None (SPOP single,
+        RedissonSet.java:138-145); removeRandom(count) -> list."""
+        out = [self._d(m) for m in self._executor.execute_sync(
+            self.name, "spop", {"count": 1 if count is None else count})]
+        if count is None:
+            return out[0] if out else None
+        return out
 
     def move(self, destination: str, member: Any) -> bool:
         return self._executor.execute_sync(
@@ -91,19 +97,34 @@ class RSet(RExpirable):
         }
 
     def intersection(self, *names: str) -> int:
-        """SINTERSTORE into this set; returns the resulting size."""
+        """SINTERSTORE this <- inter(names): the destination is OVERWRITTEN
+        with the named sets' result, not included as a source
+        (RedissonSet.java:296-303; conformance vs
+        RedissonSetTest.java:363-379 pinned this — the old behavior mixed
+        this set's own members in)."""
         return self._executor.execute_sync(
-            self.name, "sstore", {"op": "inter", "names": [self.name, *names]}
+            self.name, "sstore", {"op": "inter", "names": self._store_names(names)}
         )
 
+    @staticmethod
+    def _store_names(names) -> list:
+        """The store ops need >=1 source (redis arity); with zero names the
+        engine tier would compute an empty result and WIPE the destination
+        while the redis tier errors — fail loudly and identically instead."""
+        if not names:
+            raise ValueError("at least one source set name is required")
+        return list(names)
+
     def union(self, *names: str) -> int:
+        """SUNIONSTORE this <- union(names) (RedissonSet.java:244-251)."""
         return self._executor.execute_sync(
-            self.name, "sstore", {"op": "union", "names": [self.name, *names]}
+            self.name, "sstore", {"op": "union", "names": self._store_names(names)}
         )
 
     def diff(self, *names: str) -> int:
+        """SDIFFSTORE this <- diff(names) (RedissonSet.java:270-277)."""
         return self._executor.execute_sync(
-            self.name, "sstore", {"op": "diff", "names": [self.name, *names]}
+            self.name, "sstore", {"op": "diff", "names": self._store_names(names)}
         )
 
     def iterator(self, count: int = 10) -> Iterator[Any]:
@@ -222,6 +243,41 @@ class RList(RExpirable):
         if to_index <= from_index:
             return []
         return self.range(from_index, to_index - 1)
+
+    def remove_all(self, values: Iterable[Any]) -> bool:
+        """Reference List.removeAll (RedissonList.java over LREM): remove
+        every occurrence of each value; True iff the list changed."""
+        removed = 0
+        for v in dict.fromkeys(self._e(x) for x in values):
+            removed += self._executor.execute_sync(
+                self.name, "lrem", {"value": v, "count": 0})
+        return removed > 0
+
+    def retain_all(self, values: Iterable[Any]) -> bool:
+        """Reference List.retainAll: keep only listed values (order and
+        duplicates of the kept elements preserved); True iff changed. One
+        atomic server/engine-side op — expiry preserved."""
+        return self._executor.execute_sync(
+            self.name, "lretain", {"members": [self._e(x) for x in values]})
+
+    def add_all_at(self, index: int, values: Iterable[Any]) -> bool:
+        """Reference addAll(index, values): splice at `index`; errors when
+        index exceeds the current size (RedissonListTest.java:715-719
+        expects an error on an empty list at index 2)."""
+        vals = [self._e(v) for v in values]
+        if not vals:
+            return False
+        size = self.size()
+        if index > size:
+            raise IndexError(
+                f"insert index {index} beyond list size {size}")
+        for off, v in enumerate(vals):
+            self._executor.execute_sync(
+                self.name, "linsert_at", {"index": index + off, "value": v})
+        return True
+
+    def is_empty(self) -> bool:
+        return self.size() == 0
 
     def fast_remove(self, *indexes: int) -> None:
         """Remove elements by index without returning them (reference

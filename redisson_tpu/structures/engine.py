@@ -852,6 +852,23 @@ class StructureBackend(ExtendedOps):
         self._drop_if_empty(key, kv)
         op.future.set_result(removed)
 
+    def _op_lretain(self, key: str, op: Op) -> None:
+        """List retainAll: in-place filter keeping order/dups of kept
+        elements — one atomic op, expiry untouched (review r5: the old
+        model-level delete()+rpush dropped the TTL and exposed a transient
+        empty list)."""
+        kv = self._entry(key, T.LIST)
+        if kv is None:
+            op.future.set_result(False)
+            return
+        keep = set(op.payload["members"])
+        out = deque(v for v in kv.value if v in keep)
+        changed = len(out) != len(kv.value)
+        kv.value.clear()
+        kv.value.extend(out)
+        self._drop_if_empty(key, kv)
+        op.future.set_result(changed)
+
     def _op_lrem_index(self, key: str, op: Op) -> None:
         kv = self._entry(key, T.LIST)
         i = op.payload["index"]

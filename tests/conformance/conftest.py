@@ -1,0 +1,36 @@
+"""Conformance harness: reference-derived behavioral assertions run against
+BOTH execution tiers (VERDICT r4 next #4 — 538/538 hasattr parity proves
+surface, not semantics; this suite transcribes the reference's per-object
+test corpus, `/root/reference/src/test/java/org/redisson/*Test.java`).
+
+Fixture model mirrors the reference's `BaseTest.java:14-49`: one shared
+client per tier per module, flushall between tests. Every test cites the
+reference test method it transcribes (file:line of the @Test body)."""
+
+import pytest
+
+
+@pytest.fixture(scope="package", params=["engine", "redis"])
+def tier(request):
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    if request.param == "engine":
+        c = RedissonTPU.create(Config())
+        yield c
+        c.shutdown()
+    else:
+        from redisson_tpu.interop.fake_server import EmbeddedRedis
+
+        with EmbeddedRedis() as er:
+            cfg = Config()
+            cfg.use_redis().address = f"redis://127.0.0.1:{er.port}"
+            c = RedissonTPU.create(cfg)
+            yield c
+            c.shutdown()
+
+
+@pytest.fixture()
+def client(tier):
+    tier.get_keys().flushall()
+    return tier

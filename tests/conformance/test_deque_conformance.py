@@ -1,0 +1,93 @@
+"""RDeque conformance vs the reference's RedissonDequeTest
+(`/root/reference/src/test/java/org/redisson/RedissonDequeTest.java`)."""
+
+
+def test_remove_last_occurrence(client):
+    # RedissonDequeTest.java:20-31 testRemoveLastOccurrence
+    q = client.get_deque("deque1")
+    q.add_first(3)
+    q.add_first(1)
+    q.add_first(2)
+    q.add_first(3)
+    q.remove_last_occurrence(3)
+    assert list(q.read_all()) == [3, 2, 1]
+
+
+def test_remove_first_occurrence(client):
+    # RedissonDequeTest.java:33-44 testRemoveFirstOccurrence
+    q = client.get_deque("deque1")
+    q.add_first(3)
+    q.add_first(1)
+    q.add_first(2)
+    q.add_first(3)
+    q.remove_first_occurrence(3)
+    assert list(q.read_all()) == [2, 1, 3]
+
+
+def test_remove_last(client):
+    # RedissonDequeTest.java:46-56 testRemoveLast
+    q = client.get_deque("deque1")
+    q.add_first(1)
+    q.add_first(2)
+    q.add_first(3)
+    assert q.remove_last() == 1
+    assert q.remove_last() == 2
+    assert q.remove_last() == 3
+
+
+def test_remove_first(client):
+    # RedissonDequeTest.java:58-68 testRemoveFirst
+    q = client.get_deque("deque1")
+    q.add_first(1)
+    q.add_first(2)
+    q.add_first(3)
+    assert q.remove_first() == 3
+    assert q.remove_first() == 2
+    assert q.remove_first() == 1
+
+
+def test_peek(client):
+    # RedissonDequeTest.java:70-79 testPeek
+    q = client.get_deque("deque1")
+    assert q.peek_first() is None
+    assert q.peek_last() is None
+    q.add_first(2)
+    assert q.peek_first() == 2
+    assert q.peek_last() == 2
+
+
+def test_poll_last_and_offer_first_to(client):
+    # RedissonDequeTest.java:81-95 testPollLastAndOfferFirstTo
+    q1 = client.get_deque("deque1")
+    q1.add_first(3)
+    q1.add_first(2)
+    q1.add_first(1)
+    q2 = client.get_deque("deque2")
+    q2.add_first(6)
+    q2.add_first(5)
+    q2.add_first(4)
+    q1.poll_last_and_offer_first_to("deque2")
+    assert list(q2.read_all()) == [3, 4, 5, 6]
+
+
+def test_add_first_order(client):
+    # RedissonDequeTest.java:97-106 testAddFirstOrigin semantics on RDeque
+    q = client.get_deque("deque")
+    q.add_first(1)
+    q.add_first(2)
+    q.add_first(3)
+    assert list(q.read_all()) == [3, 2, 1]
+
+
+def test_queue_fifo(client):
+    # RedissonQueueTest semantics through the deque's queue face:
+    # offer/poll/peek are FIFO (RedissonQueueTest.java testAddOffer)
+    q = client.get_queue("queue1")
+    assert q.offer(1) is True
+    q.offer(2)
+    q.offer(3)
+    assert q.peek() == 1
+    assert q.poll() == 1
+    assert q.poll() == 2
+    assert q.poll() == 3
+    assert q.poll() is None  # empty queue -> null

@@ -1,0 +1,277 @@
+"""RList conformance vs the reference's RedissonListTest
+(`/root/reference/src/test/java/org/redisson/RedissonListTest.java`)."""
+
+import pytest
+
+
+def test_add_before(client):
+    # RedissonListTest.java:21-30 testAddBefore
+    l = client.get_list("list")
+    l.add_all(["1", "2", "3"])
+    assert l.add_before("2", "0") == 4
+    assert l.read_all() == ["1", "0", "2", "3"]
+
+
+def test_add_after(client):
+    # RedissonListTest.java:33-42 testAddAfter
+    l = client.get_list("list")
+    l.add_all(["1", "2", "3"])
+    assert l.add_after("2", "0") == 4
+    assert l.read_all() == ["1", "2", "0", "3"]
+
+
+def test_trim(client):
+    # RedissonListTest.java:46-57 testTrim
+    l = client.get_list("list1")
+    l.add_all(["1", "2", "3", "4", "5", "6"])
+    l.trim(0, 3)
+    assert l.read_all() == ["1", "2", "3", "4"]
+
+
+def test_add_all_big_list(client):
+    # RedissonListTest.java:60-68 testAddAllBigList
+    l = client.get_list("list1")
+    l.add_all([str(i) for i in range(10000)])
+    l.insert(3, "123123")
+    assert l.size() == 10001
+    assert l.get(3) == "123123"
+
+
+def test_equals(client):
+    # RedissonListTest.java:72-90 testEquals
+    l1 = client.get_list("list1")
+    l1.add_all(["1", "2", "3"])
+    l2 = client.get_list("list2")
+    l2.add_all(["1", "2", "3"])
+    l3 = client.get_list("list3")
+    l3.add_all(["0", "2", "3"])
+    assert l1.read_all() == l2.read_all()
+    assert l1.read_all() != l3.read_all()
+
+
+def test_add_by_index(client):
+    # RedissonListTest.java:103-110 testAddByIndex
+    l = client.get_list("test2")
+    l.add("foo")
+    l.insert(0, "bar")
+    assert l.read_all() == ["bar", "foo"]
+
+
+def test_long_values(client):
+    # RedissonListTest.java:112-119 testLong
+    l = client.get_list("list")
+    l.add(1)
+    l.add(2)
+    assert l.read_all() == [1, 2]
+
+
+def test_last_index_of_none(client):
+    # RedissonListTest.java:356-366 testLastIndexOfNone
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.last_index_of(10) == -1
+
+
+def test_last_index_of(client):
+    # RedissonListTest.java:368-420 testLastIndexOf/2/1
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 3, 3, 3, 3, 3, 3, 3])  # indexes 2..9 hold 3
+    assert l.last_index_of(3) == 9
+    l2 = client.get_list("list2")
+    l2.add_all([1, 2, 3, 4, 3, 6, 3, 8])
+    assert l2.last_index_of(3) == 6
+
+
+def test_sub_list(client):
+    # RedissonListTest.java:422-470 testSubListMiddle / testSubListHead
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5, 6, 7, 8])
+    assert l.sub_list(2, 6) == [3, 4, 5, 6]
+    assert l.sub_list(0, 3) == [1, 2, 3]
+
+
+def test_index_of(client):
+    # RedissonListTest.java:531-543 testIndexOf (value assertions)
+    l = client.get_list("list")
+    l.add_all(list(range(1, 200)))
+    assert l.index_of(56) == 55
+    assert l.index_of(100) == 99
+    assert l.index_of(200) == -1
+    assert l.index_of(0) == -1
+
+
+def test_remove_at(client):
+    # RedissonListTest.java:545-562 testRemove — remove(index) returns value
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.remove_at(0) == 1
+    assert l.read_all() == [2, 3, 4, 5]
+    assert l.remove_at(2) == 4
+    assert l.read_all() == [2, 3, 5]
+
+
+def test_set_returns_old(client):
+    # RedissonListTest.java:590-602 testSet
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.set(4, 6) == 5
+    assert l.read_all() == [1, 2, 3, 4, 6]
+
+
+def test_set_out_of_bounds(client):
+    # RedissonListTest.java:604-614 testSetFail — IndexOutOfBounds
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    with pytest.raises(Exception):
+        l.set(5, 6)
+
+
+def test_remove_all_empty(client):
+    # RedissonListTest.java:631-642 testRemoveAllEmpty
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.remove_all([]) is False
+
+
+def test_remove_all(client):
+    # RedissonListTest.java:644-665 testRemoveAll
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.remove_all([]) is False
+    assert l.remove_all([3, 2, 10, 6]) is True
+    assert l.read_all() == [1, 4, 5]
+    assert l.remove_all([4]) is True
+    assert l.read_all() == [1, 5]
+    assert l.remove_all([1, 5, 1, 5]) is True
+    assert l.is_empty()
+
+
+def test_retain_all(client):
+    # RedissonListTest.java:667-680 testRetainAll
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.retain_all([3, 2, 10, 6]) is True
+    assert l.read_all() == [2, 3]
+    assert l.size() == 2
+
+
+def test_fast_set(client):
+    # RedissonListTest.java:682-690 testFastSet
+    l = client.get_list("list")
+    l.add_all([1, 2])
+    l.fast_set(0, 3)
+    assert l.get(0) == 3
+
+
+def test_retain_all_empty(client):
+    # RedissonListTest.java:692-703 testRetainAllEmpty
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.retain_all([]) is True
+    assert l.size() == 0
+
+
+def test_retain_all_no_modify(client):
+    # RedissonListTest.java:705-713 testRetainAllNoModify
+    l = client.get_list("list")
+    l.add_all([1, 2])
+    assert l.retain_all([1, 2]) is False
+    assert l.read_all() == [1, 2]
+
+
+def test_add_all_index_error(client):
+    # RedissonListTest.java:715-719 testAddAllIndexError
+    l = client.get_list("list")
+    with pytest.raises(Exception):
+        l.add_all_at(2, [7, 8, 9])
+
+
+def test_add_all_index(client):
+    # RedissonListTest.java:721-745 testAddAllIndex
+    l = client.get_list("list")
+    l.add_all([1, 2, 3, 4, 5])
+    assert l.add_all_at(2, [7, 8, 9]) is True
+    assert l.read_all() == [1, 2, 7, 8, 9, 3, 4, 5]
+
+
+def test_add_all(client):
+    # RedissonListTest.java:772-786 testAddAll
+    l = client.get_list("list")
+    l.add_all([1, 2, 3])
+    assert l.add_all([7, 8, 9]) is True
+    assert l.read_all() == [1, 2, 3, 7, 8, 9]
+
+
+def test_add_all_empty(client):
+    # RedissonListTest.java:788-793 testAddAllEmpty
+    l = client.get_list("list")
+    assert l.add_all([]) is False
+    assert l.size() == 0
+
+
+def test_contains_all(client):
+    # RedissonListTest.java:795-816 testContainsAll(+Empty)
+    l = client.get_list("list")
+    l.add_all(list(range(200)))
+    assert all(l.contains(v) for v in [30, 11])
+    assert not all(l.contains(v) for v in [30, 711, 11])
+
+
+def test_to_array(client):
+    # RedissonListTest.java:818-832 testToArray
+    l = client.get_list("list")
+    l.add_all(["1", "4", "2", "5", "3"])
+    assert l.read_all() == ["1", "4", "2", "5", "3"]
+
+
+def test_iterator_sequence(client):
+    # RedissonListTest.java:865-890 testIteratorSequence — insertion order
+    l = client.get_list("list")
+    l.add_all(["1", "4", "2", "5", "3"])
+    assert list(iter(l)) == ["1", "4", "2", "5", "3"]
+
+
+def test_contains(client):
+    # RedissonListTest.java:892-904 testContains
+    l = client.get_list("list")
+    l.add_all(["1", "4", "2", "5", "3"])
+    assert l.contains("3")
+    assert not l.contains("31")
+    assert l.contains("1")
+
+
+def test_get_fail(client):
+    # RedissonListTest.java:906-911 testGetFail — out-of-range index
+    l = client.get_list("list")
+    assert l.get(0) is None  # deliberate divergence: python None, not throw
+
+
+def test_add_get(client):
+    # RedissonListTest.java:913-927 testAddGet
+    l = client.get_list("list")
+    l.add_all(["1", "4", "2", "5", "3"])
+    assert l.get(0) == "1"
+    assert l.get(1) == "4"
+    assert l.get(2) == "2"
+    assert l.get(3) == "5"
+    assert l.get(4) == "3"
+
+
+def test_duplicates(client):
+    # RedissonListTest.java:929-940 testDuplicates — lists keep dupes
+    l = client.get_list("list")
+    l.add("1")
+    l.add("1")
+    l.add("2")
+    l.add("3")
+    assert l.size() == 4
+    assert l.read_all() == ["1", "1", "2", "3"]
+
+
+def test_size(client):
+    # RedissonListTest.java:942-962 testSize
+    l = client.get_list("list")
+    l.add_all(["1", "2", "3", "4", "5", "6"])
+    assert l.size() == 6
+    l.remove("2")
+    assert l.size() == 5

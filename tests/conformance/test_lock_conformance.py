@@ -1,0 +1,186 @@
+"""RLock conformance vs the reference's RedissonLockTest
+(`/root/reference/src/test/java/org/redisson/RedissonLockTest.java`).
+Thread-identity assertions run a second "thread" via a real thread, as the
+reference does."""
+
+import threading
+import time
+
+
+def test_force_unlock(client):
+    # RedissonLockTest.java:39-48 testForceUnlock
+    lock = client.get_lock("lock")
+    lock.lock()
+    lock.force_unlock()
+    assert not lock.is_locked()
+    assert not client.get_lock("lock").is_locked()
+
+
+def test_expire_releases(client):
+    # RedissonLockTest.java:50-70 testExpire — lease expiry frees the lock
+    lock = client.get_lock("lock")
+    lock.lock(lease_time_s=0.5)
+    t0 = time.monotonic()
+    other = client.get_lock("lock")
+    acquired = []
+
+    def worker():
+        l2 = client.get_lock("lock")
+        l2.lock()
+        acquired.append(time.monotonic() - t0)
+        l2.unlock()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=5)
+    assert acquired and acquired[0] < 2.0  # freed by expiry, not unlock
+
+
+def test_get_hold_count(client):
+    # RedissonLockTest.java:106-122 testGetHoldCount — reentrancy counter
+    lock = client.get_lock("lock")
+    assert lock.get_hold_count() == 0
+    lock.lock()
+    assert lock.get_hold_count() == 1
+    lock.unlock()
+    assert lock.get_hold_count() == 0
+    lock.lock()
+    lock.lock()
+    assert lock.get_hold_count() == 2
+    lock.unlock()
+    assert lock.get_hold_count() == 1
+    lock.unlock()
+    assert lock.get_hold_count() == 0
+
+
+def test_is_held_by_current_thread_other_thread(client):
+    # RedissonLockTest.java:124-141 testIsHeldByCurrentThreadOtherThread
+    lock = client.get_lock("lock")
+    lock.lock()
+    seen = []
+
+    def worker():
+        seen.append(client.get_lock("lock").is_held_by_current_thread())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen == [False]
+    lock.unlock()
+
+
+def test_is_held_by_current_thread(client):
+    # RedissonLockTest.java:133-142 testIsHeldByCurrentThread
+    lock = client.get_lock("lock")
+    assert not lock.is_held_by_current_thread()
+    lock.lock()
+    assert lock.is_held_by_current_thread()
+    lock.unlock()
+    assert not lock.is_held_by_current_thread()
+
+
+def test_is_locked_other_thread(client):
+    # RedissonLockTest.java:144-170 testIsLockedOtherThread
+    lock = client.get_lock("lock")
+    lock.lock()
+    seen = []
+
+    def worker():
+        seen.append(client.get_lock("lock").is_locked())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen == [True]
+    lock.unlock()
+
+    seen2 = []
+
+    def worker2():
+        seen2.append(client.get_lock("lock").is_locked())
+
+    t2 = threading.Thread(target=worker2)
+    t2.start()
+    t2.join()
+    assert seen2 == [False]
+
+
+def test_is_locked(client):
+    # RedissonLockTest.java:171-180 testIsLocked
+    lock = client.get_lock("lock")
+    assert not lock.is_locked()
+    lock.lock()
+    assert lock.is_locked()
+    lock.unlock()
+    assert not lock.is_locked()
+
+
+def test_unlock_fail(client):
+    # RedissonLockTest.java:181-199 testUnlockFail — unlocking a lock held
+    # by another thread raises (IllegalMonitorState in the reference)
+    lock = client.get_lock("lock")
+    done = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        l2 = client.get_lock("lock")
+        l2.lock()
+        done.set()
+        release.wait(timeout=5)
+        l2.unlock()
+
+    t = threading.Thread(target=holder)
+    t.start()
+    done.wait(timeout=5)
+    try:
+        lock.unlock()
+        raised = False
+    except Exception:
+        raised = True
+    assert raised
+    release.set()
+    t.join(timeout=5)
+    assert not client.get_lock("lock").is_locked()
+
+
+def test_lock_unlock_and_reentrancy(client):
+    # RedissonLockTest.java:211-241 testLockUnlock / testReentrancy
+    lock = client.get_lock("lock1")
+    lock.lock()
+    lock.unlock()
+    lock.lock()
+    lock.unlock()
+    assert lock.try_lock()
+    assert lock.try_lock()  # reentrant
+    lock.unlock()
+    # still held once: another thread cannot take it
+    grabbed = []
+
+    def worker():
+        grabbed.append(client.get_lock("lock1").try_lock())
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert grabbed == [False]
+    lock.unlock()
+
+
+def test_concurrency_single_instance(client):
+    # RedissonLockTest.java:242-256 testConcurrency_SingleInstance —
+    # N threads x lock/increment/unlock: every increment lands
+    iterations = 15
+    counter = [0]
+
+    def worker():
+        l = client.get_lock("testConcurrency_SingleInstance")
+        l.lock()
+        counter[0] += 1
+        l.unlock()
+
+    threads = [threading.Thread(target=worker) for _ in range(iterations)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert counter[0] == iterations

@@ -1,0 +1,346 @@
+"""RMap conformance vs the reference's RedissonMapTest
+(`/root/reference/src/test/java/org/redisson/RedissonMapTest.java`).
+Each test names the reference @Test it transcribes."""
+
+
+def test_add_and_get(client):
+    # RedissonMapTest.java:132-155 testAddAndGet
+    m = client.get_map("getAll")
+    m.put(1, 100)
+    assert m.add_and_get(1, 12) == 112
+    assert m.get(1) == 112
+    m2 = client.get_map("getAll2")
+    m2.put(1, 100.2)
+    assert abs(m2.add_and_get(1, 12.1) - 112.3) < 1e-9
+    assert abs(m2.get(1) - 112.3) < 1e-9
+    ms = client.get_map("mapStr")
+    assert ms.put("1", 100) is None
+    assert ms.add_and_get("1", 12) == 112
+    assert ms.get("1") == 112
+
+
+def test_get_all(client):
+    # RedissonMapTest.java:157-171 testGetAll
+    m = client.get_map("getAll")
+    for k, v in ((1, 100), (2, 200), (3, 300), (4, 400)):
+        m.put(k, v)
+    assert m.get_all({2, 3, 5}) == {2: 200, 3: 300}
+
+
+def test_get_all_string_keys(client):
+    # RedissonMapTest.java:173-187 testGetAllWithStringKeys
+    m = client.get_map("getAllStrings")
+    for k, v in (("A", 100), ("B", 200), ("C", 300), ("D", 400)):
+        m.put(k, v)
+    assert m.get_all({"B", "C", "E"}) == {"B": 200, "C": 300}
+
+
+def test_filter_keys(client):
+    # RedissonMapTest.java:189-203 testFilterKeys
+    m = client.get_map("filterKeys")
+    for k, v in ((1, 100), (2, 200), (3, 300), (4, 400)):
+        m.put(k, v)
+    assert m.filter_keys(lambda k: 2 <= k <= 3) == {2: 200, 3: 300}
+
+
+def test_integer_and_long(client):
+    # RedissonMapTest.java:224-252 testInteger / testLong
+    m = client.get_map("test_int")
+    m.put(1, 2)
+    m.put(3, 4)
+    assert m.size() == 2
+    assert m.get(1) == 2
+    assert m.get(3) == 4
+
+
+def test_iterator(client):
+    # RedissonMapTest.java:274-299 testIterator
+    m = client.get_map("123")
+    size = 1000
+    for i in range(size):
+        m.put(i, i)
+    assert m.size() == size
+    assert len(list(m.key_iterator())) == size
+    assert len(list(m.value_iterator())) == size
+    assert len(list(m.entry_iterator())) == size
+
+
+def test_null_values(client):
+    # RedissonMapTest.java:301-316 testNull — a stored null is a real entry
+    m = client.get_map("simple12")
+    m.put(1, None)
+    m.put(2, None)
+    m.put(3, "43")
+    assert m.size() == 3
+    assert m.get(2) is None
+    assert m.get(1) is None
+    assert m.get(3) == "43"
+
+
+def test_entry_set(client):
+    # RedissonMapTest.java:318-340 testEntrySet / testReadAllEntrySet
+    m = client.get_map("simple12")
+    m.put(1, "12")
+    m.put(2, "33")
+    m.put(3, "43")
+    assert len(m.entry_set()) == 3
+    assert sorted(m.read_all_entry_set()) == [(1, "12"), (2, "33"), (3, "43")]
+
+
+def test_simple_types(client):
+    # RedissonMapTest.java:342-351 testSimpleTypes
+    m = client.get_map("simple12")
+    m.put(1, "12")
+    m.put(2, "33")
+    m.put(3, "43")
+    assert m.get(2) == "33"
+
+
+def test_remove(client):
+    # RedissonMapTest.java:353-364 testRemove
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("33", "44")
+    m.put("5", "6")
+    m.remove("33")
+    m.remove("5")
+    assert m.size() == 1
+
+
+def test_put_all(client):
+    # RedissonMapTest.java:366-380 testPutAll
+    m = client.get_map("simple")
+    m.put(1, "1")
+    m.put(2, "2")
+    m.put(3, "3")
+    m.put_all({4: "4", 5: "5", 6: "6"})
+    assert sorted(m.key_set()) == [1, 2, 3, 4, 5, 6]
+
+
+def test_key_set_contains(client):
+    # RedissonMapTest.java:382-391 testKeySet
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("33", "44")
+    m.put("5", "6")
+    assert "33" in m.key_set()
+    assert "44" not in m.key_set()
+
+
+def test_read_all_key_set(client):
+    # RedissonMapTest.java:393-415 testReadAllKeySet(+HighAmount)
+    m = client.get_map("simple")
+    for i in range(1000):
+        m.put(str(i), str(i))
+    assert len(m.read_all_key_set()) == 1000
+    assert m.read_all_key_set() == {str(i) for i in range(1000)}
+
+
+def test_read_all_values(client):
+    # RedissonMapTest.java:417-427 testReadAllValues
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("33", "44")
+    m.put("5", "6")
+    assert sorted(m.read_all_values()) == ["2", "44", "6"]
+
+
+def test_contains_value(client):
+    # RedissonMapTest.java:429-439 testContainsValue
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("33", "44")
+    m.put("5", "6")
+    assert m.contains_value("2")
+    assert not m.contains_value("441")
+
+
+def test_contains_key(client):
+    # RedissonMapTest.java:441-450 testContainsKey
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("33", "44")
+    assert m.contains_key("33")
+    assert not m.contains_key("34")
+
+
+def test_remove_value(client):
+    # RedissonMapTest.java:452-464 testRemoveValue
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.remove("1", "2") is True
+    assert m.get("1") is None
+    assert m.size() == 0
+
+
+def test_remove_value_fail(client):
+    # RedissonMapTest.java:466-479 testRemoveValueFail
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.remove("2", "1") is False
+    assert m.remove("1", "3") is False
+    assert m.get("1") == "2"
+
+
+def test_replace_old_value_fail(client):
+    # RedissonMapTest.java:482-492 testReplaceOldValueFail
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.replace("1", "43", "31") is False
+    assert m.get("1") == "2"
+
+
+def test_replace_old_value_success(client):
+    # RedissonMapTest.java:494-507 testReplaceOldValueSuccess
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.replace("1", "2", "3") is True
+    assert m.replace("1", "2", "3") is False
+    assert m.get("1") == "3"
+
+
+def test_replace_value(client):
+    # RedissonMapTest.java:509-519 testReplaceValue
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.replace("1", "3") == "2"
+    assert m.get("1") == "3"
+
+
+def test_replace_via_put(client):
+    # RedissonMapTest.java:522-535 testReplace — put overwrites
+    m = client.get_map("simple")
+    m.put("33", "44")
+    assert m.get("33") == "44"
+    m.put("33", "abc")
+    assert m.get("33") == "abc"
+
+
+def test_put_if_absent(client):
+    # RedissonMapTest.java:551-564 testPutIfAbsent
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.put_if_absent("1", "3") == "2"
+    assert m.get("1") == "2"
+    assert m.put_if_absent("2", "4") is None
+    assert m.get("2") == "4"
+
+
+def test_fast_put_if_absent(client):
+    # RedissonMapTest.java:566-579 testFastPutIfAbsent
+    m = client.get_map("simple")
+    m.put("1", "2")
+    assert m.fast_put_if_absent("1", "3") is False
+    assert m.get("1") == "2"
+    assert m.fast_put_if_absent("2", "4") is True
+    assert m.get("2") == "4"
+
+
+def test_size_overwrites(client):
+    # RedissonMapTest.java:581-603 testSize — overwrites don't grow size
+    m = client.get_map("simple")
+    m.put("1", "2")
+    m.put("3", "4")
+    m.put("5", "6")
+    assert m.size() == 3
+    m.put("1", "2")
+    m.put("3", "4")
+    assert m.size() == 3
+    m.put("1", "21")
+    m.put("3", "41")
+    assert m.size() == 3
+    m.put("51", "6")
+    assert m.size() == 4
+    m.remove("3")
+    assert m.size() == 3
+
+
+def test_empty_remove(client):
+    # RedissonMapTest.java:605-611 testEmptyRemove
+    m = client.get_map("simple")
+    assert m.remove(1, 3) is False
+    m.put(4, 5)
+    assert m.remove(4, 5) is True
+
+
+def test_put_async(client):
+    # RedissonMapTest.java:613-625 testPutAsync — put returns previous value
+    m = client.get_map("simple")
+    assert m.put_async(2, 3).result() is None
+    assert m.get(2) == 3
+    assert m.put_async(2, 4).result() == 3
+    assert m.get(2) == 4
+
+
+def test_remove_async(client):
+    # RedissonMapTest.java:627-638 testRemoveAsync
+    m = client.get_map("simple")
+    m.put(1, 3)
+    m.put(3, 5)
+    m.put(7, 8)
+    assert m.remove(1) == 3
+    assert m.remove(3) == 5
+    assert m.remove(10) is None
+    assert m.remove(7) == 8
+
+
+def test_fast_remove(client):
+    # RedissonMapTest.java:640-651 testFastRemoveAsync — count of removed
+    m = client.get_map("simple")
+    m.put(1, 3)
+    m.put(3, 5)
+    m.put(4, 6)
+    m.put(7, 8)
+    assert m.fast_remove(1, 3, 7) == 3
+    assert m.size() == 1
+
+
+def test_key_iterator(client):
+    # RedissonMapTest.java:653-671 testKeyIterator
+    m = client.get_map("simple")
+    m.put(1, 0)
+    m.put(3, 5)
+    m.put(4, 6)
+    m.put(7, 8)
+    keys = set(m.key_set())
+    assert keys == {1, 3, 4, 7}
+    for k in m.key_iterator():
+        keys.remove(k)  # raises if a key repeats or is foreign
+    assert not keys
+
+
+def test_value_iterator(client):
+    # RedissonMapTest.java:673-691 testValueIterator
+    m = client.get_map("simple")
+    m.put(1, 0)
+    m.put(3, 5)
+    m.put(4, 6)
+    m.put(7, 8)
+    values = sorted(m.values())
+    assert values == [0, 5, 6, 8]
+    assert sorted(m.value_iterator()) == values
+
+
+def test_fast_put(client):
+    # RedissonMapTest.java:693-699 testFastPut — True iff field was new
+    m = client.get_map("simple")
+    assert m.fast_put(1, 2) is True
+    assert m.fast_put(1, 3) is False
+    assert m.size() == 1
+
+
+def test_equals_plain_dict(client):
+    # RedissonMapTest.java:701-715 testEquals
+    m = client.get_map("simple")
+    m.put("1", "7")
+    m.put("2", "4")
+    m.put("3", "5")
+    assert dict(m.iter_entries()) == {"1": "7", "2": "4", "3": "5"}
+
+
+def test_fast_remove_empty(client):
+    # RedissonMapTest.java:717-724 testFastRemoveEmpty — no keys -> 0
+    m = client.get_map("simple")
+    m.put(1, 3)
+    assert m.fast_remove() == 0
+    assert m.size() == 1

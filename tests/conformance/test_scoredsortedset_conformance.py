@@ -1,0 +1,205 @@
+"""RScoredSortedSet conformance vs the reference's
+RedissonScoredSortedSetTest
+(`/root/reference/src/test/java/org/redisson/RedissonScoredSortedSetTest.java`)."""
+
+NEG_INF = float("-inf")
+POS_INF = float("inf")
+
+
+def _fill(client, scored):
+    z = client.get_scored_sorted_set("simple")
+    for score, member in scored:
+        z.add(score, member)
+    return z
+
+
+ABC7 = [(0.1, "a"), (0.2, "b"), (0.3, "c"), (0.4, "d"), (0.5, "e"),
+        (0.6, "f"), (0.7, "g")]
+
+
+def test_count(client):
+    # RedissonScoredSortedSetTest.java:30-39 testCount
+    z = _fill(client, [(0, "1"), (1, "4"), (2, "2"), (3, "5"), (4, "3")])
+    assert z.count(0, True, 3, False) == 3
+
+
+def test_read_all(client):
+    # RedissonScoredSortedSetTest.java:42-51 testReadAll
+    z = _fill(client, [(0, "1"), (1, "4"), (2, "2"), (3, "5"), (4, "3")])
+    assert set(z.read_all()) == {"1", "2", "3", "4", "5"}
+
+
+def test_add_all(client):
+    # RedissonScoredSortedSetTest.java:54-64 testAddAll
+    z = client.get_scored_sorted_set("simple")
+    assert z.add_all([(0.1, "1"), (0.2, "2"), (0.3, "3")]) == 3
+    assert z.entry_range(0, -1) == [("1", 0.1), ("2", 0.2), ("3", 0.3)]
+
+
+def test_try_add(client):
+    # RedissonScoredSortedSetTest.java:67-75 testTryAdd
+    z = client.get_scored_sorted_set("simple")
+    assert z.try_add(123.81, "1980") is True
+    assert z.try_add(99, "1980") is False
+    assert z.get_score("1980") == 123.81
+
+
+def test_poll_last(client):
+    # RedissonScoredSortedSetTest.java:77-88 testPollLast
+    z = client.get_scored_sorted_set("simple")
+    assert z.poll_last() is None
+    for s, m in ((0.1, "a"), (0.2, "b"), (0.3, "c")):
+        z.add(s, m)
+    assert z.poll_last() == "c"
+    assert z.read_all() == ["a", "b"]
+
+
+def test_poll_first(client):
+    # RedissonScoredSortedSetTest.java:90-101 testPollFirst
+    z = client.get_scored_sorted_set("simple")
+    assert z.poll_first() is None
+    for s, m in ((0.1, "a"), (0.2, "b"), (0.3, "c")):
+        z.add(s, m)
+    assert z.poll_first() == "a"
+    assert z.read_all() == ["b", "c"]
+
+
+def test_first_last(client):
+    # RedissonScoredSortedSetTest.java:103-113 testFirstLast
+    z = _fill(client, [(0.1, "a"), (0.2, "b"), (0.3, "c"), (0.4, "d")])
+    assert z.first() == "a"
+    assert z.last() == "d"
+
+
+def test_remove_range_by_score(client):
+    # RedissonScoredSortedSetTest.java:116-129 testRemoveRangeByScore
+    z = _fill(client, ABC7)
+    assert z.remove_range_by_score(0.1, False, 0.3, True) == 2
+    assert z.read_all() == ["a", "d", "e", "f", "g"]
+
+
+def test_remove_range_by_score_negative_inf(client):
+    # RedissonScoredSortedSetTest.java:131-144 testRemoveRangeByScoreNegativeInf
+    z = _fill(client, ABC7)
+    assert z.remove_range_by_score(NEG_INF, False, 0.3, True) == 3
+    assert z.read_all() == ["d", "e", "f", "g"]
+
+
+def test_remove_range_by_score_positive_inf(client):
+    # RedissonScoredSortedSetTest.java:146-159 testRemoveRangeByScorePositiveInf
+    z = _fill(client, ABC7)
+    assert z.remove_range_by_score(0.4, False, POS_INF, True) == 3
+    assert z.read_all() == ["a", "b", "c", "d"]
+
+
+def test_remove_range_by_rank(client):
+    # RedissonScoredSortedSetTest.java:161-174 testRemoveRangeByRank
+    z = _fill(client, ABC7)
+    assert z.remove_range_by_rank(0, 1) == 2
+    assert z.read_all() == ["c", "d", "e", "f", "g"]
+
+
+def test_rank(client):
+    # RedissonScoredSortedSetTest.java:176-189 testRank
+    z = _fill(client, ABC7)
+    assert z.rev_rank("d") == 3
+    assert z.rank("abc") is None
+
+
+def test_rev_rank(client):
+    # RedissonScoredSortedSetTest.java:191-205 testRevRank
+    z = _fill(client, ABC7)
+    assert z.rev_rank("f") == 1
+    assert z.rev_rank("abc") is None
+
+
+def test_retain_all(client):
+    # RedissonScoredSortedSetTest.java:306-318 testRetainAll
+    z = client.get_scored_sorted_set("simple")
+    for i in range(2000):
+        z.add(i * 10, i)
+    assert z.retain_all([1, 2]) is True
+    assert z.read_all() == [1, 2]
+    assert z.size() == 2
+    assert z.get_score(1) == 10
+    assert z.get_score(2) == 20
+
+
+def test_remove_all(client):
+    # RedissonScoredSortedSetTest.java:320-331 testRemoveAll
+    z = _fill(client, [(0.1, 1), (0.2, 2), (0.3, 3)])
+    assert z.remove_all([1, 2]) is True
+    assert z.read_all() == [3]
+    assert z.size() == 1
+
+
+def test_sort_order(client):
+    # RedissonScoredSortedSetTest.java:438-450 testSort
+    z = client.get_scored_sorted_set("simple")
+    for s, m in ((4, 2), (5, 3), (3, 1), (6, 4), (1000, 10), (1, -1), (2, 0)):
+        assert z.add(s, m) is True
+    assert z.read_all() == [-1, 0, 1, 2, 3, 4, 10]
+
+
+def test_remove(client):
+    # RedissonScoredSortedSetTest.java:452-465 testRemove
+    z = _fill(client, [(4, 5), (2, 3), (0, 1), (1, 2), (3, 4)])
+    assert z.remove(0) is False
+    assert z.remove(3) is True
+    assert z.read_all() == [1, 2, 4, 5]
+
+
+def test_contains_and_duplicates(client):
+    # RedissonScoredSortedSetTest.java:493-519 testContains / testDuplicates
+    z = _fill(client, [(0, "1"), (1, "4"), (2, "2"), (3, "5"), (4, "3")])
+    assert z.contains("3")
+    assert not z.contains("31")
+    z2 = client.get_scored_sorted_set("simple2")
+    assert z2.add(0.1, "a") is True
+    assert z2.add(0.2, "a") is False  # re-add updates score, not size
+    assert z2.size() == 1
+    assert z2.get_score("a") == 0.2
+
+
+def test_value_range(client):
+    # RedissonScoredSortedSetTest.java:535-547 testValueRange
+    z = _fill(client, [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (4, 5)])
+    assert z.value_range(0, -1) == [1, 2, 3, 4, 5]
+
+
+def test_entry_range(client):
+    # RedissonScoredSortedSetTest.java:549-564 testEntryRange
+    z = _fill(client, [(10, 1), (20, 2), (30, 3), (40, 4), (50, 5)])
+    assert z.entry_range(0, -1) == [
+        (1, 10.0), (2, 20.0), (3, 30.0), (4, 40.0), (5, 50.0)]
+
+
+def test_value_range_by_score_limit(client):
+    # RedissonScoredSortedSetTest.java:581-593 testScoredSortedSetValueRangeLimit
+    z = _fill(client, [(0, "a"), (1, "b"), (2, "c"), (3, "d"), (4, "e")])
+    assert z.value_range_by_score(1, True, 4, False, offset=1, count=2) == ["c", "d"]
+
+
+def test_value_range_by_score(client):
+    # RedissonScoredSortedSetTest.java:595-607 testScoredSortedSetValueRange
+    z = _fill(client, [(0, "a"), (1, "b"), (2, "c"), (3, "d"), (4, "e")])
+    assert z.value_range_by_score(1, True, 4, False) == ["b", "c", "d"]
+
+
+def test_value_range_by_score_reversed_limit(client):
+    # RedissonScoredSortedSetTest.java:609-621 testScoredSortedSetValueRangeReversedLimit
+    z = _fill(client, [(0, "a"), (1, "b"), (2, "c"), (3, "d"), (4, "e")])
+    assert z.value_range_by_score(
+        1, True, 4, False, offset=1, count=2, reversed=True) == ["c", "b"]
+
+
+def test_add_score(client):
+    # RedissonScoredSortedSetTest.java:741-757 testAddAndGet (addScore)
+    z = client.get_scored_sorted_set("simple")
+    z.add(1, 100)
+    assert z.add_score(100, 11) == 12
+    assert z.get_score(100) == 12
+    z2 = client.get_scored_sorted_set("simple2")
+    z2.add(100.2, 1)
+    assert abs(z2.add_score(1, 12.1) - 112.3) < 1e-9
+    assert abs(z2.get_score(1) - 112.3) < 1e-9

@@ -1,0 +1,242 @@
+"""RSet conformance vs the reference's RedissonSetTest
+(`/root/reference/src/test/java/org/redisson/RedissonSetTest.java`)."""
+
+
+def test_remove_random(client):
+    # RedissonSetTest.java:37-48 testRemoveRandom
+    s = client.get_set("simple")
+    s.add(1)
+    s.add(2)
+    s.add(3)
+    popped = set(s.remove_random() for _ in range(3))
+    assert popped == {1, 2, 3}
+    assert s.remove_random() is None  # empty -> null
+
+
+def test_add_long(client):
+    # RedissonSetTest.java:59-66 testAddLong
+    s = client.get_set("simple_longs")
+    s.add(1 << 40)
+    assert s.contains(1 << 40)
+    assert s.read_all() == {1 << 40}
+
+
+def test_add_async_remove_async(client):
+    # RedissonSetTest.java:77-103 testAddAsync / testRemoveAsync
+    s = client.get_set("simple")
+    assert s.add_async(1).result() is True
+    assert s.contains(1)
+    s.add(3)
+    s.add(7)
+    assert s.remove(1) is True
+    assert not s.contains(1)
+    assert s.remove(1) is False  # absent -> False
+
+
+def test_iterator_sequence(client):
+    # RedissonSetTest.java:136-160 testIteratorSequence
+    s = client.get_set("set")
+    for i in range(1000):
+        s.add(i)
+    seen = set(s.iterator())
+    assert seen == set(range(1000))
+
+
+def test_long(client):
+    # RedissonSetTest.java:162-169 testLong
+    s = client.get_set("set")
+    s.add(1)
+    s.add(2)
+    assert s.read_all() == {1, 2}
+
+
+def test_retain_all(client):
+    # RedissonSetTest.java:171-181 testRetainAll
+    s = client.get_set("set")
+    for i in range(20000):
+        s.add(i)
+    assert s.retain_all([1, 2]) is True
+    assert s.read_all() == {1, 2}
+    assert s.size() == 2
+
+
+def test_contains_all(client):
+    # RedissonSetTest.java:201-211 testContainsAll
+    s = client.get_set("set")
+    for i in range(200):
+        s.add(i)
+    assert s.contains_all([30, 11])
+    assert not s.contains_all([30, 711, 11])
+
+
+def test_contains(client):
+    # RedissonSetTest.java:228-241 testContains
+    s = client.get_set("set")
+    for v in ("1", "4", "2", "5", "3"):
+        s.add(v)
+    assert s.contains("3")
+    assert not s.contains("31")
+    assert s.contains("1")
+
+
+def test_duplicates(client):
+    # RedissonSetTest.java:243-254 testDuplicates — sets dedupe
+    s = client.get_set("set")
+    assert s.add("1") is True
+    assert s.add("1") is False
+    s.add("2")
+    s.add("3")
+    assert s.size() == 3
+
+
+def test_size(client):
+    # RedissonSetTest.java:256-269 testSize
+    s = client.get_set("set")
+    for i in (1, 2, 3, 3, 4, 5):  # re-adds don't grow
+        s.add(i)
+    assert s.size() == 5
+
+
+def test_retain_all_empty(client):
+    # RedissonSetTest.java:271-282 testRetainAllEmpty
+    s = client.get_set("set")
+    for i in (1, 2, 3, 4, 5):
+        s.add(i)
+    assert s.retain_all([]) is True
+    assert s.size() == 0
+
+
+def test_retain_all_no_modify(client):
+    # RedissonSetTest.java:284-292 testRetainAllNoModify
+    s = client.get_set("set")
+    s.add(1)
+    s.add(2)
+    assert s.retain_all([1, 2]) is False
+    assert s.read_all() == {1, 2}
+
+
+def test_union(client):
+    # RedissonSetTest.java:294-307 testUnion — SINTERSTORE-family semantics
+    s = client.get_set("set")
+    s.add(5)
+    s.add(6)
+    s1 = client.get_set("set1")
+    s1.add(1)
+    s1.add(2)
+    s2 = client.get_set("set2")
+    s2.add(3)
+    s2.add(4)
+    assert s.union("set1", "set2") == 4
+    assert s.read_all() == {1, 2, 3, 4}
+
+
+def test_read_union(client):
+    # RedissonSetTest.java:309-323 testReadUnion — non-mutating
+    s = client.get_set("set")
+    s.add(5)
+    s.add(6)
+    s1 = client.get_set("set1")
+    s1.add(1)
+    s1.add(2)
+    s2 = client.get_set("set2")
+    s2.add(3)
+    s2.add(4)
+    assert s.read_union("set1", "set2") == {1, 2, 3, 4, 5, 6}
+    assert s.read_all() == {5, 6}
+
+
+def test_diff(client):
+    # RedissonSetTest.java:326-342 testDiff
+    s = client.get_set("set")
+    s.add(5)
+    s.add(6)
+    s1 = client.get_set("set1")
+    for v in (1, 2, 3):
+        s1.add(v)
+    s2 = client.get_set("set2")
+    for v in (3, 4, 5):
+        s2.add(v)
+    assert s.diff("set1", "set2") == 2
+    assert s.read_all() == {1, 2}
+
+
+def test_read_diff(client):
+    # RedissonSetTest.java:344-361 testReadDiff
+    s = client.get_set("set")
+    for v in (5, 7, 6):
+        s.add(v)
+    s1 = client.get_set("set1")
+    for v in (1, 2, 5):
+        s1.add(v)
+    s2 = client.get_set("set2")
+    for v in (3, 4, 5):
+        s2.add(v)
+    assert s.read_diff("set1", "set2") == {7, 6}
+    assert s.read_all() == {6, 5, 7}
+
+
+def test_intersection(client):
+    # RedissonSetTest.java:363-379 testIntersection
+    s = client.get_set("set")
+    s.add(5)
+    s.add(6)
+    s1 = client.get_set("set1")
+    for v in (1, 2, 3):
+        s1.add(v)
+    s2 = client.get_set("set2")
+    for v in (3, 4, 5):
+        s2.add(v)
+    assert s.intersection("set1", "set2") == 1
+    assert s.read_all() == {3}
+
+
+def test_read_intersection(client):
+    # RedissonSetTest.java:381-399 testReadIntersection
+    s = client.get_set("set")
+    for v in (5, 7, 6):
+        s.add(v)
+    s1 = client.get_set("set1")
+    for v in (1, 2, 5):
+        s1.add(v)
+    s2 = client.get_set("set2")
+    for v in (3, 4, 5):
+        s2.add(v)
+    assert s.read_intersection("set1", "set2") == {5}
+    assert s.read_all() == {6, 5, 7}
+
+
+def test_move(client):
+    # RedissonSetTest.java:401-416 testMove
+    s = client.get_set("set")
+    other = client.get_set("otherSet")
+    s.add(1)
+    s.add(2)
+    assert s.move("otherSet", 1) is True
+    assert s.size() == 1
+    assert s.contains(2)
+    assert other.size() == 1
+    assert other.contains(1)
+
+
+def test_move_no_member(client):
+    # RedissonSetTest.java:418-429 testMoveNoMember
+    s = client.get_set("set")
+    other = client.get_set("otherSet")
+    s.add(1)
+    assert s.move("otherSet", 2) is False
+    assert s.size() == 1
+    assert other.size() == 0
+
+
+def test_remove_all(client):
+    # RedissonSetTest.java:444-465 testRemoveAll
+    s = client.get_set("list")
+    for i in (1, 2, 3, 4, 5):
+        s.add(i)
+    assert s.remove_all([]) is False
+    assert s.remove_all([3, 2, 10, 6]) is True
+    assert s.read_all() == {1, 4, 5}
+    assert s.remove_all([4]) is True
+    assert s.read_all() == {1, 5}
+    assert s.remove_all([1, 5, 1, 5]) is True
+    assert s.size() == 0

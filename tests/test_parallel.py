@@ -173,3 +173,189 @@ def test_pod_int_and_byte_key_groups_coalesce():
         assert dest.count() == got
     finally:
         pod.shutdown()
+
+
+# -- sharded bit tier (VERDICT r4 missing #1) -------------------------------
+
+
+@pytest.fixture(scope="module")
+def podc():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_pod().bank_capacity = 16
+    c = RedissonTPU.create(cfg)
+    yield c
+    c.shutdown()
+
+
+@pytest.fixture(scope="module")
+def localc():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    c = RedissonTPU.create(Config())
+    yield c
+    c.shutdown()
+
+
+def test_pod_bitset_is_mesh_sharded(podc):
+    """Pod bitsets live as bit-range-sharded arrays, not in the single-chip
+    delegate store."""
+    bs = podc.get_bit_set("sb:shardcheck")
+    bs.set(100_000)
+    back = podc._routing.sketch
+    obj = back._bits["sb:shardcheck"]
+    ndev = back.mesh.devices.size
+    assert len({s.data.shape for s in obj.state.addressable_shards}) == 1
+    assert len(list(obj.state.addressable_shards)) == ndev
+    assert back.store.get("sb:shardcheck") is None  # NOT delegated
+
+
+def test_pod_bitset_matches_single_chip(podc, localc):
+    """Same op sequence -> identical observable state across tiers."""
+    rng = np.random.default_rng(17)
+    idx = rng.integers(0, 50_000, 400)
+    for c in (podc, localc):
+        bs = c.get_bit_set("sb:eq")
+        bs.set_bits([int(i) for i in idx[:200]])
+        bs.clear_bits([int(i) for i in idx[100:250]])
+        bs.set_bits([int(i) for i in idx[250:]])
+    p, l = podc.get_bit_set("sb:eq"), localc.get_bit_set("sb:eq")
+    assert p.cardinality() == l.cardinality()
+    assert p.length() == l.length()
+    assert p.size() == l.size()
+    probe = [int(i) for i in rng.integers(0, 60_000, 300)]
+    assert list(p.get_bits(probe)) == list(l.get_bits(probe))
+
+
+def test_pod_bitop_matches_single_chip(podc, localc):
+    for c in (podc, localc):
+        a = c.get_bit_set("sb:a")
+        b = c.get_bit_set("sb:b")
+        a.set_bits(list(range(0, 3000, 3)))
+        b.set_bits(list(range(0, 3000, 5)))
+        d = c.get_bit_set("sb:and")
+        d.or_("sb:a")
+        d.and_("sb:b")
+        x = c.get_bit_set("sb:xor")
+        x.or_("sb:a")
+        x.xor("sb:b")
+    assert (podc.get_bit_set("sb:and").cardinality()
+            == localc.get_bit_set("sb:and").cardinality() == 200)
+    assert (podc.get_bit_set("sb:xor").cardinality()
+            == localc.get_bit_set("sb:xor").cardinality())
+
+
+def test_pod_bitset_not_and_range(podc, localc):
+    for c in (podc, localc):
+        bs = c.get_bit_set("sb:not")
+        bs.set_bits([0, 10, 100])
+        bs.not_()
+    p, l = podc.get_bit_set("sb:not"), localc.get_bit_set("sb:not")
+    assert p.cardinality() == l.cardinality()
+    for c in (podc, localc):
+        r = c.get_bit_set("sb:rng")
+        r.set_range(1000, 5000)
+        r.clear(1200, 1300)
+    assert (podc.get_bit_set("sb:rng").cardinality()
+            == localc.get_bit_set("sb:rng").cardinality() == 3900)
+
+
+def test_pod_bloom_bit_identical_and_fpr(podc, localc):
+    """Pod bloom over the sharded array: identical add/contains results to
+    the single-chip filter for the same keys, and a sane FPR."""
+    rng = np.random.default_rng(23)
+    keys = rng.integers(0, 2**63, 3000, np.uint64)
+    fresh = rng.integers(0, 2**63, 3000, np.uint64)
+    for c in (podc, localc):
+        bf = c.get_bloom_filter("sb:bloom")
+        assert bf.try_init(3000, 0.01) in (True, False)
+        bf.add_ints(keys)
+    pb, lb = podc.get_bloom_filter("sb:bloom"), localc.get_bloom_filter("sb:bloom")
+    assert pb.contains_count_ints(keys) == 3000
+    assert lb.contains_count_ints(keys) == 3000
+    p_fp = pb.contains_count_ints(fresh)
+    l_fp = lb.contains_count_ints(fresh)
+    assert p_fp == l_fp  # same hash family, same bits -> identical FPs
+    assert p_fp / 3000 < 0.03
+    assert pb.count() == lb.count()
+
+
+def test_pod_bloom_byte_keys_match(podc, localc):
+    for c in (podc, localc):
+        bf = c.get_bloom_filter("sb:bloomb")
+        bf.try_init(500, 0.02)
+        bf.add_all([b"key-%d" % i for i in range(300)])
+    pb = podc.get_bloom_filter("sb:bloomb")
+    lb = localc.get_bloom_filter("sb:bloomb")
+    probe = [b"key-%d" % i for i in range(0, 600, 7)]
+    assert list(pb.contains_all(probe)) == list(lb.contains_all(probe))
+
+
+def test_pod_bits_lifecycle(podc):
+    bs = podc.get_bit_set("sb:life")
+    bs.set(7)
+    assert podc.get_keys().delete("sb:life") == 1
+    assert podc.get_bit_set("sb:life").cardinality() == 0
+    bs = podc.get_bit_set("sb:ren")
+    bs.set(3)
+    bs.rename("sb:ren2")
+    assert podc.get_bit_set("sb:ren2").get(3)
+    assert "sb:ren2" in podc.get_keys().get_keys("sb:ren*")
+    # wrongtype guards hold across the bank/bits tiers
+    from redisson_tpu.store import WrongTypeError
+
+    podc.get_hyper_log_log("sb:h").add(b"x")
+    with pytest.raises(WrongTypeError):
+        podc.get_bit_set("sb:h").set(1)
+    with pytest.raises(WrongTypeError):
+        podc.get_hyper_log_log("sb:ren2").add(b"x")
+    with pytest.raises(WrongTypeError):
+        podc.get_bloom_filter("sb:ren2").try_init(100, 0.01)
+
+
+def test_pod_bits_checkpoint_roundtrip(tmp_path, podc):
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    bs = podc.get_bit_set("sb:ck")
+    bs.set_bits([5, 17, 40_000])
+    bf = podc.get_bloom_filter("sb:ckb")
+    bf.try_init(1000, 0.01)
+    keys = np.arange(500, dtype=np.uint64)
+    bf.add_ints(keys)
+    path = str(tmp_path / "podbits")
+    podc.save_checkpoint(path, names=["sb:ck", "sb:ckb"])
+
+    # restore into a FRESH pod client
+    cfg = Config()
+    cfg.use_pod().bank_capacity = 16
+    c2 = RedissonTPU.create(cfg)
+    try:
+        assert c2.load_checkpoint(path) == 2
+        assert list(c2.get_bit_set("sb:ck").get_bits([5, 17, 40_000, 6])) == [
+            True, True, True, False]
+        assert c2.get_bloom_filter("sb:ckb").contains_count_ints(keys) == 500
+    finally:
+        c2.shutdown()
+
+    # and into a single-chip client (portability across modes)
+    c3 = RedissonTPU.create(Config())
+    try:
+        assert c3.load_checkpoint(path) == 2
+        assert c3.get_bit_set("sb:ck").cardinality() == 3
+        assert c3.get_bloom_filter("sb:ckb").contains_count_ints(keys) == 500
+    finally:
+        c3.shutdown()
+
+
+def test_pod_bitset_growth_preserves_bits(podc):
+    bs = podc.get_bit_set("sb:grow")
+    bs.set(10)
+    for hi in (2_000, 60_000, 300_000):
+        bs.set(hi)
+    assert bs.cardinality() == 4
+    assert bs.length() == 300_001
+    assert list(bs.get_bits([10, 2_000, 60_000, 300_000])) == [True] * 4

@@ -185,8 +185,11 @@ def test_set_algebra(client):
     assert s1.retain_all([1, 2, 3])  # changed
     assert s1.read_all() == {1, 2, 3}
     assert not s1.retain_all([1, 2, 3])  # unchanged
-    assert s1.union("sb") == 5
-    assert s1.read_all() == {1, 2, 3, 4, 5}
+    # union() OVERWRITES this set with the named sets' union (the
+    # destination is not a source — RedissonSet.java:244-251, pinned by
+    # conformance vs RedissonSetTest.java:294-307).
+    assert s1.union("sb") == 3
+    assert s1.read_all() == {3, 4, 5}
 
 
 def test_set_move_and_iter(client):
@@ -585,3 +588,31 @@ def test_multimap_cache_all_keys_expired_drops_structure(client):
     time.sleep(0.2)
     assert mm.key_size() == 0
     assert "mmc3" not in client.get_keys().get_keys("mmc3")
+
+
+def test_list_retain_all_preserves_ttl(client):
+    """retain_all is one atomic op that keeps the list's expiry (review r5:
+    the old delete()+rpush rebuild dropped the TTL)."""
+    l = client.get_list("lr:ttl")
+    l.add_all([1, 2, 3, 4])
+    l.expire(60)
+    assert l.retain_all([2, 3]) is True
+    assert l.read_all() == [2, 3]
+    ttl = l.remain_time_to_live()
+    assert ttl is not None and 0 < ttl <= 60_000
+
+
+def test_set_store_ops_require_sources(client):
+    """union()/diff()/intersection() with no names raise instead of wiping
+    the destination (review r5)."""
+    import pytest
+
+    s = client.get_set("ss:guard")
+    s.add(1)
+    with pytest.raises(ValueError):
+        s.union()
+    with pytest.raises(ValueError):
+        s.diff()
+    with pytest.raises(ValueError):
+        s.intersection()
+    assert s.read_all() == {1}

@@ -138,6 +138,38 @@ def test_reshard_preserves_sketches(pod_client):
     assert pod_client.get_hyper_log_log("rs:h").count() == est
 
 
+def test_device_loss_carries_all_sharded_state(pod_client):
+    """Failure-driven reshard (VERDICT r4 next #8): HLL bank + sharded
+    bitset/bloom all survive a device loss, keep serving on the degraded
+    mesh, and survive re-growth."""
+    backend = pod_client._backend.sketch
+    h = pod_client.get_hyper_log_log("dl:h")
+    h.add_all([b"v%d" % i for i in range(5000)])
+    est = h.count()
+    bs = pod_client.get_bit_set("dl:bits")
+    bs.set_bits(list(range(0, 9000, 3)))
+    card = bs.cardinality()
+    bf = pod_client.get_bloom_filter("dl:bloom")
+    bf.try_init(1000, 0.01)
+    keys = np.arange(700, dtype=np.uint64)
+    bf.add_ints(keys)
+
+    ndev0 = backend.mesh.devices.size
+    backend.on_device_loss(ndev0 // 2)
+    assert backend.mesh.devices.size == ndev0 // 2
+    assert pod_client.get_hyper_log_log("dl:h").count() == est
+    assert pod_client.get_bit_set("dl:bits").cardinality() == card
+    assert pod_client.get_bloom_filter("dl:bloom").contains_count_ints(keys) == 700
+    # still serving: writes land on the degraded mesh
+    bs.set(9001)
+    assert pod_client.get_bit_set("dl:bits").cardinality() == card + 1
+
+    backend.reshard(ndev0)  # capacity returned
+    assert pod_client.get_bit_set("dl:bits").cardinality() == card + 1
+    assert pod_client.get_hyper_log_log("dl:h").count() == est
+    assert pod_client.get_bloom_filter("dl:bloom").contains_count_ints(keys) == 700
+
+
 def test_client_topology_manager_facade():
     from redisson_tpu.client import RedissonTPU
 
