@@ -69,6 +69,11 @@ class RedissonTPU:
             from redisson_tpu.parallel.backend_pod import PodBackend
 
             tcfg = self.config.pod
+            if getattr(tcfg, "hll_hash", "murmur3") == "redis":
+                raise NotImplementedError(
+                    "hll_hash='redis' is a single-chip (local/tpu) mode "
+                    "feature; the pod bank kernels and native pre-hash "
+                    "implement the murmur3 family")
             sketch = PodBackend(tcfg)
             self._store = sketch.store
         else:
@@ -84,6 +89,7 @@ class RedissonTPU:
             sketch = TpuBackend(
                 self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed,
                 ingest=getattr(tcfg, "ingest", "auto"),
+                hll_hash=getattr(tcfg, "hll_hash", "murmur3"),
             )
         self._routing = RoutingBackend(sketch)
         self._backend = self._routing
@@ -301,7 +307,8 @@ class RedissonTPU:
         self._resp.connect()
         self._durability = DurabilityManager(
             self._store, self._resp,
-            executor=self._executor, pod_backend=self._pod_backend())
+            executor=self._executor, pod_backend=self._pod_backend(),
+            hll_family=getattr(self._pod_backend(), "family", "m3"))
         if self.config.flush_interval_s > 0:
             self._durability.start_periodic(self.config.flush_interval_s)
 

@@ -36,6 +36,11 @@ class TpuConfig:
 
     device_index: int = 0
     hll_impl: str = "scatter"  # "scatter" | "sort"; scatter ~2x faster at 1M-key batches on v5e (ops/hll.py)
+    # HLL hash family: "murmur3" (framework-native murmur3 x64 128) or
+    # "redis" (MurmurHash64A seed 0xadc83b19, exactly redis hyperloglog.c
+    # hllPatLen) — choose "redis" when flushed sketches must stay
+    # server-mergeable under later server-side PFADDs (mixed writers).
+    hll_hash: str = "murmur3"
     # HLL key ingest: "device" ships raw keys (8 B/key) and hashes on-chip;
     # "hostfold" folds into a 16 KB sketch natively and ships that; "auto"
     # probes the link once and picks (backend_tpu.LinkProfile).

@@ -97,22 +97,46 @@ def pad_ints(arr, fill=0):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
-def hll_add_bytes(regs, data, lengths, valid, impl: str = "scatter", seed: int = 0):
-    """PFADD of a padded byte-key batch. Returns (new_regs, changed)."""
+def _hll_h1_u64(x, seed: int, family: str):
+    """The HLL hash for 8-byte LE keys by family: 'm3' = murmur3 x64 128
+    low half (the framework's native family); 'redis' = MurmurHash64A
+    (0xadc83b19) — exactly what a real server's PFADD computes
+    (hyperloglog.c hllPatLen), so registers stay server-mergeable."""
+    if family == "redis":
+        return hashing.murmur2_64a_u64(x)
+    h1, _ = hashing.murmur3_x64_128_u64(x, seed)
+    return h1
+
+
+def _hll_h1_bytes(data, lengths, seed: int, family: str):
+    if family == "redis":
+        return hashing.murmur2_64a(data, lengths)
     h1, _ = hashing.murmur3_x64_128(data, lengths, seed)
+    return h1
+
+
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed", "family"))
+def hll_add_bytes(regs, data, lengths, valid, impl: str = "scatter",
+                  seed: int = 0, family: str = "m3"):
+    """PFADD of a padded byte-key batch. Returns (new_regs, changed)."""
+    h1 = _hll_h1_bytes(data, lengths, seed, family)
     return _hll_add(regs, h1, valid, impl)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
-def hll_add_u64(regs, hi, lo, valid, impl: str = "scatter", seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed", "family"))
+def hll_add_u64(regs, hi, lo, valid, impl: str = "scatter", seed: int = 0,
+                family: str = "m3"):
     """PFADD of a padded uint64-key batch (8-byte LE fast path)."""
-    h1, _ = hashing.murmur3_x64_128_u64(U64(hi, lo), seed)
+    h1 = _hll_h1_u64(U64(hi, lo), seed, family)
     return _hll_add(regs, h1, valid, impl)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed"))
-def hll_add_packed(regs, packed, count, impl: str = "scatter", seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("impl", "seed", "family"))
+def hll_add_packed(regs, packed, count, impl: str = "scatter", seed: int = 0,
+                   family: str = "m3"):
     """PFADD of a uint64-key batch shipped as its raw little-endian uint32
     view `[n, 2]` ([:, 0]=lo, [:, 1]=hi) — the zero-copy ingest path: the
     client transfers the key buffer as-is and the lane split + validity mask
@@ -120,7 +144,7 @@ def hll_add_packed(regs, packed, count, impl: str = "scatter", seed: int = 0):
     on device. This is what makes the 100M/s host path feasible: per batch
     the host touches only the 8 B/key payload once, for the DMA."""
     valid = jnp.arange(packed.shape[0], dtype=jnp.int32) < count
-    h1, _ = hashing.murmur3_x64_128_u64(U64(packed[:, 1], packed[:, 0]), seed)
+    h1 = _hll_h1_u64(U64(packed[:, 1], packed[:, 0]), seed, family)
     return _hll_add(regs, h1, valid, impl)
 
 
@@ -241,48 +265,60 @@ def _bank_add_row(bank, h1, row, valid):
     return new, changed_rows
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_packed(bank, packed, count, row, seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_packed(bank, packed, count, row, seed: int = 0,
+                        family: str = "m3"):
     """Single-target PFADD into bank row `row` (a traced scalar — no per-key
     row vector ships over the link, preserving the 8 B/key transfer profile
     of the flat hll_add_packed path)."""
     valid = jnp.arange(packed.shape[0], dtype=jnp.int32) < count
-    h1, _ = hashing.murmur3_x64_128_u64(U64(packed[:, 1], packed[:, 0]), seed)
+    h1 = _hll_h1_u64(U64(packed[:, 1], packed[:, 0]), seed, family)
     return _bank_add_row(bank, h1, row, valid)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_packed_rows(bank, packed, rows, count, seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_packed_rows(bank, packed, rows, count, seed: int = 0,
+                             family: str = "m3"):
     """Multi-target PFADD: per-key target row (cross-sketch coalesced run)."""
     valid = jnp.arange(packed.shape[0], dtype=jnp.int32) < count
-    h1, _ = hashing.murmur3_x64_128_u64(U64(packed[:, 1], packed[:, 0]), seed)
+    h1 = _hll_h1_u64(U64(packed[:, 1], packed[:, 0]), seed, family)
     return _bank_add(bank, h1, rows, valid)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_u64_rows(bank, hi, lo, rows, valid, seed: int = 0):
-    h1, _ = hashing.murmur3_x64_128_u64(U64(hi, lo), seed)
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_u64_rows(bank, hi, lo, rows, valid, seed: int = 0,
+                          family: str = "m3"):
+    h1 = _hll_h1_u64(U64(hi, lo), seed, family)
     return _bank_add(bank, h1, rows, valid)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_u64(bank, hi, lo, valid, row, seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_u64(bank, hi, lo, valid, row, seed: int = 0,
+                     family: str = "m3"):
     """Single-target u64 PFADD (scalar row broadcast on device — no
     4 B/key row vector crosses the link)."""
-    h1, _ = hashing.murmur3_x64_128_u64(U64(hi, lo), seed)
+    h1 = _hll_h1_u64(U64(hi, lo), seed, family)
     return _bank_add_row(bank, h1, row, valid)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_bytes_rows(bank, data, lengths, rows, valid, seed: int = 0):
-    h1, _ = hashing.murmur3_x64_128(data, lengths, seed)
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_bytes_rows(bank, data, lengths, rows, valid, seed: int = 0,
+                            family: str = "m3"):
+    h1 = _hll_h1_bytes(data, lengths, seed, family)
     return _bank_add(bank, h1, rows, valid)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("seed",))
-def hll_bank_add_bytes(bank, data, lengths, valid, row, seed: int = 0):
+@functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+def hll_bank_add_bytes(bank, data, lengths, valid, row, seed: int = 0,
+                       family: str = "m3"):
     """Single-target byte-key PFADD (scalar row, see hll_bank_add_u64)."""
-    h1, _ = hashing.murmur3_x64_128(data, lengths, seed)
+    h1 = _hll_h1_bytes(data, lengths, seed, family)
     return _bank_add_row(bank, h1, row, valid)
 
 

@@ -769,13 +769,16 @@ class RedisBackend(RedisBloomMixin):
             return default
         # Explicit ±inf bounds must render as redis -inf/+inf, not go
         # through the numeric formatter (conformance vs
-        # RedissonScoredSortedSetTest.java:131-159 — the reference passes
-        # Double.NEGATIVE_INFINITY/POSITIVE_INFINITY straight through).
+        # RedissonScoredSortedSetTest.java:131-159). The exclusivity prefix
+        # still applies — the reference prepends "(" before the infinity
+        # branch (RedissonScoredSortedSet.java:185-196), and redis parses
+        # "(+inf" as an exclusive bound over an infinite-score member.
         import math
 
         if isinstance(val, float) and math.isinf(val):
-            return "-inf" if val < 0 else "+inf"
-        s = _fmt_num(val)
+            s = "-inf" if val < 0 else "+inf"
+        else:
+            s = _fmt_num(val)
         return s if inc else "(" + s
 
     @staticmethod

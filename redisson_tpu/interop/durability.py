@@ -44,16 +44,22 @@ def bloom_config_key(name: str) -> str:
 
 class DurabilityManager:
     def __init__(self, store: SketchStore, client: SyncRespClient,
-                 prefix: str = "", executor=None, pod_backend=None):
+                 prefix: str = "", executor=None, pod_backend=None,
+                 hll_family: str = "m3"):
         """executor + pod_backend wire the pod tier in: bank-resident HLL
         rows (the flagship multi-chip state) flush and restore through
         dispatcher-serialized hll_export/hll_import ops instead of being
-        invisible to durability (VERDICT r1 item #5)."""
+        invisible to durability (VERDICT r1 item #5).
+
+        hll_family ('m3' | 'redis') is the hash family the backend builds
+        registers with: exports carry it as the blob tag, imports refuse
+        cross-family blobs (see load_hll)."""
         self.store = store
         self.client = client
         self.prefix = prefix
         self.executor = executor
         self.pod_backend = pod_backend
+        self.hll_family = hll_family
         self._timer: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.flushes = 0
@@ -63,6 +69,8 @@ class DurabilityManager:
         self._flushed_versions: Dict[str, int] = {}
         # name -> bank row version at last flush (pod tier dirty tracking).
         self._flushed_bank_versions: Dict[str, int] = {}
+        # name -> sharded-bit-object version at last flush (pod tier).
+        self._flushed_bits_versions: Dict[str, int] = {}
 
     # -- flush --------------------------------------------------------------
 
@@ -74,32 +82,38 @@ class DurabilityManager:
         key = self.prefix + name
         if obj.otype == ObjectType.HLL:
             regs = np.asarray(obj.state).astype(np.uint8)
-            return [["SET", key, hyll.encode_dense(regs)]]
+            return [["SET", key,
+                     hyll.encode_dense(regs, family=self.hll_family)]]
         if obj.otype == ObjectType.BITSET:
             packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
             return [["SET", key, packed.tobytes()]]
         if obj.otype == ObjectType.BLOOM:
-            packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
-            meta = obj.meta or {}
-            cfg: List = ["HSET", self.prefix + bloom_config_key(name)]
-            # snake_case store meta -> the reference's camelCase hash fields
-            # ({name}__config, RedissonBloomFilter.java:254-256)
-            for field, wire in (("size", "size"),
-                                ("hash_iterations", "hashIterations"),
-                                ("expected_insertions", "expectedInsertions"),
-                                ("false_probability", "falseProbability")):
-                if field in meta:
-                    cfg += [wire, str(meta[field])]
-            if meta.get("blocked"):
-                # Layout flag (no reference analogue): without it a reload
-                # would run classic index derivation over blocked-layout
-                # bits -> false negatives.
-                cfg += ["blocked", "1"]
-            cmds = [["SET", key, packed.tobytes()]]
-            if len(cfg) > 2:
-                cmds.append(cfg)
-            return cmds
+            return self._bloom_cmds(name, np.asarray(obj.state), obj.meta)
         return []
+
+    def _bloom_cmds(self, name: str, cells: np.ndarray, meta) -> List[List]:
+        """SET of the packed bits + the {name}__config sidecar (shared by
+        the store and pod-sharded export paths)."""
+        packed = np.packbits(cells.astype(np.uint8))
+        meta = meta or {}
+        cfg: List = ["HSET", self.prefix + bloom_config_key(name)]
+        # snake_case store meta -> the reference's camelCase hash fields
+        # ({name}__config, RedissonBloomFilter.java:254-256)
+        for field, wire in (("size", "size"),
+                            ("hash_iterations", "hashIterations"),
+                            ("expected_insertions", "expectedInsertions"),
+                            ("false_probability", "falseProbability")):
+            if field in meta:
+                cfg += [wire, str(meta[field])]
+        if meta.get("blocked"):
+            # Layout flag (no reference analogue): without it a reload
+            # would run classic index derivation over blocked-layout
+            # bits -> false negatives.
+            cfg += ["blocked", "1"]
+        cmds = [["SET", self.prefix + name, packed.tobytes()]]
+        if len(cfg) > 2:
+            cmds.append(cfg)
+        return cmds
 
     def flush(self, names: Optional[List[str]] = None,
               only_dirty: bool = False) -> int:
@@ -108,13 +122,36 @@ class DurabilityManager:
         whose store version hasn't changed since the last flush are skipped
         (the periodic flusher uses this)."""
         bank_names = set(self.pod_backend.bank_names()) if self.pod_backend else set()
+        # Pod-tier mesh-sharded bitsets/blooms live outside the store too
+        # (review r5: they were invisible to durability — silent data loss
+        # on restart).
+        bits_names = (set(self.pod_backend.sharded_bits_names())
+                      if hasattr(self.pod_backend, "sharded_bits_names")
+                      else set())
         if names is None:
-            names = self.store.keys() + sorted(bank_names)
+            names = self.store.keys() + sorted(bank_names) + sorted(bits_names)
         cmds: List[List] = []
         counted = 0
         written: List[tuple] = []  # (name, version) to record AFTER the write
         bank_written: List[tuple] = []
+        bits_written: List[tuple] = []
         for n in names:
+            if n in bits_names:
+                exported = self.executor.execute_sync(n, "bits_export", None)
+                if exported is None:
+                    continue
+                otype, cells, meta, version = exported
+                if (only_dirty
+                        and self._flushed_bits_versions.get(n) == version):
+                    continue
+                counted += 1
+                if otype == ObjectType.BLOOM:
+                    cmds.extend(self._bloom_cmds(n, cells, meta))
+                else:
+                    cmds.append(["SET", self.prefix + n,
+                                 np.packbits(cells).tobytes()])
+                bits_written.append((n, version))
+                continue
             if n in bank_names:
                 if (only_dirty and self._flushed_bank_versions.get(n)
                         == self.pod_backend.row_version(n)):
@@ -124,7 +161,8 @@ class DurabilityManager:
                     continue
                 regs, version = exported
                 counted += 1
-                cmds.append(["SET", self.prefix + n, hyll.encode_dense(regs)])
+                cmds.append(["SET", self.prefix + n,
+                             hyll.encode_dense(regs, family=self.hll_family)])
                 bank_written.append((n, version))
                 continue
             obj = self.store.get(n)
@@ -160,16 +198,53 @@ class DurabilityManager:
             self._flushed_versions[n] = version
         for n, version in bank_written:
             self._flushed_bank_versions[n] = version
+        for n, version in bits_written:
+            self._flushed_bits_versions[n] = version
         self.flushes += 1
         return counted
 
     # -- import -------------------------------------------------------------
 
-    def load_hll(self, name: str) -> bool:
+    def load_hll(self, name: str, force: bool = False) -> bool:
+        """Import a HYLL blob into the backend, guarding against hash-family
+        mixing (framework-murmur3 registers vs a real server's MurmurHash64A
+        registers — merging/PFADDing across families silently corrupts the
+        estimate):
+
+          * an M3-tagged blob into a redis-family client is a CERTAIN
+            mismatch -> ValueError (force=True imports for read-only use);
+          * an untagged blob into a murmur3 client is AMBIGUOUS — it may be
+            a real server's sketch (foreign) or this framework's own
+            pre-tagging flush (legacy m3, perfectly safe) -> warn and
+            import; force=True silences the warning.
+        """
         blob = self.client.execute("GET", self.prefix + name)
         if blob is None:
             return False
-        regs = hyll.decode(bytes(blob)).astype(np.int32)
+        blob = bytes(blob)
+        src = hyll.blob_family(blob)
+        if src == "m3" and self.hll_family == "redis" and not force:
+            raise ValueError(
+                f"HLL blob for '{name}' is tagged as framework-murmur3 but "
+                "this client inserts with the redis (MurmurHash64A) family; "
+                "importing would mix hash families in one sketch and corrupt "
+                "later estimates. Re-create the client with "
+                "TpuConfig.hll_hash='murmur3', or pass force=True to import "
+                "for read-only counting.")
+        if src == "redis" and self.hll_family == "m3" and not force:
+            import warnings
+
+            warnings.warn(
+                f"HLL blob for '{name}' carries no framework hash-family "
+                "tag: it is either a real server's sketch (whose "
+                "MurmurHash64A registers will skew under this client's "
+                "murmur3 inserts) or a pre-tagging flush from this "
+                "framework (safe). If the sketch will only be counted, or "
+                "it is legacy framework data, pass force=True to silence "
+                "this; for true mixed-writer use configure "
+                "TpuConfig.hll_hash='redis'.",
+                stacklevel=2)
+        regs = hyll.decode(blob).astype(np.int32)
         if self.executor is not None:
             # Dispatcher-serialized import: lands in the pod bank row (or
             # the single-device store) without racing donating inserts.
@@ -187,7 +262,7 @@ class DurabilityManager:
             out = np.zeros(nbits, np.uint8)
             out[:min(nbits, bits.size)] = bits[:nbits]
             bits = out
-        self._put(name, ObjectType.BITSET, bits.astype(np.uint8))
+        self._put_bits(name, ObjectType.BITSET, bits.astype(np.uint8))
         return True
 
     def load_bloom(self, name: str) -> bool:
@@ -223,8 +298,23 @@ class DurabilityManager:
         size = int(meta.get("size", bits.size))
         out = np.zeros(size, np.uint8)
         out[:min(size, bits.size)] = bits[:size]
-        self._put(name, ObjectType.BLOOM, out, meta)
+        self._put_bits(name, ObjectType.BLOOM, out, meta)
         return True
+
+    def _put_bits(self, name: str, otype: str, state: np.ndarray,
+                  meta: Optional[Dict] = None) -> None:
+        """Route a restored bitset/bloom to where the backend keeps bit
+        state: pod mode -> mesh-sharded array via the dispatcher-serialized
+        bits_import op (a store _put there would collide with the pod
+        keyspace guards and leave the object unusable, review r5); single
+        chip -> the store."""
+        if (self.executor is not None
+                and hasattr(self.pod_backend, "sharded_bits_names")):
+            self.executor.execute_sync(
+                name, "bits_import",
+                {"otype": otype, "array": state, "meta": meta or {}})
+            return
+        self._put(name, otype, state, meta)
 
     def _put(self, name: str, otype: str, state: np.ndarray,
              meta: Optional[Dict] = None) -> None:

@@ -86,10 +86,15 @@ class FakeRedisServer:
     listening port is self.port (0 -> ephemeral)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 password: Optional[str] = None):
+                 password: Optional[str] = None, hll_hash: str = "murmur3"):
         self.host = host
         self.port = port
         self.password = password
+        # PFADD hash family: "murmur3" (default — self-consistent with the
+        # TPU sketches, see module docstring) or "redis" (MurmurHash64A per
+        # hyperloglog.c — emulates a REAL server for mixed-writer tests of
+        # the durability path).
+        self.hll_hash = hll_hash
         self.data: Dict[bytes, object] = {}
         self.expires: Dict[bytes, int] = {}  # key -> unix ms deadline
         self._server: Optional[asyncio.AbstractServer] = None
@@ -948,8 +953,12 @@ class FakeRedisServer:
         before = regs.copy()
         keys = [bytes(x) for x in a[1:]]
         if keys:
-            native.hll_fold(keys, regs)
-        self.data[k] = hyll.encode_dense(regs)
+            if self.hll_hash == "redis":
+                hyll.fold_redis(keys, regs)  # real-server semantics
+            else:
+                native.hll_fold(keys, regs)
+        self.data[k] = hyll.encode_dense(
+            regs, family="redis" if self.hll_hash == "redis" else "m3")
         return _int(1 if (regs != before).any() or not existed else 0)
 
     def _cmd_pfcount(self, a):
@@ -965,7 +974,8 @@ class FakeRedisServer:
         regs = self._regs(dest)
         for k in a[1:]:
             regs = np.maximum(regs, self._regs(bytes(k)))
-        self.data[dest] = hyll.encode_dense(regs)
+        self.data[dest] = hyll.encode_dense(
+            regs, family="redis" if self.hll_hash == "redis" else "m3")
         return _ok()
 
     # zset range-by-score family (mapcache TTL zsets + eviction scripts)
@@ -1677,7 +1687,8 @@ class EmbeddedRedis:
     test fixture analogue of RedisRunner.startDefaultRedisServerInstance."""
 
     def __init__(self, password: Optional[str] = None, port: int = 0,
-                 share_with: Optional["EmbeddedRedis"] = None):
+                 share_with: Optional["EmbeddedRedis"] = None,
+                 hll_hash: str = "murmur3"):
         import threading
         if share_with is None:
             self._loop = asyncio.new_event_loop()
@@ -1691,7 +1702,8 @@ class EmbeddedRedis:
             self._loop = share_with._loop
             self._thread = share_with._thread
             self._owns_loop = False
-        self.server = FakeRedisServer(password=password, port=port)
+        self.server = FakeRedisServer(password=password, port=port,
+                                      hll_hash=hll_hash)
         asyncio.run_coroutine_threadsafe(self.server.start(), self._loop).result(10)
 
     @classmethod

@@ -428,7 +428,7 @@ class PodBackend:
         kernel = sharded_bits.set_bits if set_value else sharded_bits.clear_bits
         outs, spans = [], []
         for s, e in engine.chunk_spans(idx.shape[0]):
-            pidx, valid = engine.pad_ints(idx[s:e].astype(np.int32))
+            pidx, valid = engine.pad_ints(idx[s:e].astype(np.uint32))
             obj.state, old = kernel(obj.state, pidx, valid, self.mesh)
             outs.append(old)
             spans.append(e - s)
@@ -457,7 +457,7 @@ class PodBackend:
             return
         idx = np.concatenate([op.payload["idx"] for op in ops])
         nbits = obj.logical_n
-        clipped = np.clip(idx, 0, nbits - 1).astype(np.int32)
+        clipped = np.clip(idx, 0, nbits - 1).astype(np.uint32)
         outs, spans = [], []
         for s, e in engine.chunk_spans(clipped.shape[0]):
             pidx, valid = engine.pad_ints(clipped[s:e])
@@ -501,7 +501,7 @@ class PodBackend:
             if end > 0:
                 self._bits_grow(obj, end - 1)
             obj.state = sharded_bits.set_range(
-                obj.state, np.int32(start), np.int32(end), bool(value))
+                obj.state, np.uint32(start), np.uint32(end), bool(value))
             obj.version += 1
             op.future.set_result(None)
 
@@ -515,7 +515,7 @@ class PodBackend:
                 self._bits_check(target, ObjectType.BITSET)
                 if obj is not None:
                     obj.state = sharded_bits.bitop_not(
-                        obj.state, np.int32(obj.logical_n))
+                        obj.state, np.uint32(obj.logical_n))
                     obj.version += 1
                 op.future.set_result(None)
                 continue

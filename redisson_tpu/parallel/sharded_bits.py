@@ -67,19 +67,26 @@ def make_bits(mesh: Mesh, nbits: int) -> jax.Array:
 # -- scatter/gather bodies ---------------------------------------------------
 
 
-def _span(bits_local):
+def _local_mask(bits_local, idx, valid):
+    """(mine, safe_local_index) for this device's contiguous bit range.
+
+    All index math is UNSIGNED 32-bit modular distance: li = idx - start
+    wraps past 2^32, and `li < n_local` selects exactly [start,
+    start+n_local) for any idx up to 2^32-1 — int32 would silently wrap
+    indexes >= 2^31 negative and drop the bits (review r5: a 2^32-bit
+    filter is within check_size and the whole point of the sharded tier)."""
     n_local = bits_local.shape[0]
-    start = lax.axis_index(SHARD_AXIS).astype(jnp.int32) * n_local
-    return n_local, start
+    start = lax.axis_index(SHARD_AXIS).astype(jnp.uint32) * jnp.uint32(n_local)
+    li = idx.astype(jnp.uint32) - start
+    mine = valid & (li < jnp.uint32(n_local))
+    safe = jnp.where(mine, li, jnp.uint32(0))
+    return mine, safe
 
 
 def _scatter_body(bits_local, idx, valid, set_value: bool):
     """Per-device SETBIT/clear: mask my bit range, scatter locally, fan the
     pre-write values in with psum (one owner per bit => sum == select)."""
-    n_local, start = _span(bits_local)
-    li = idx.astype(jnp.int32) - start
-    mine = valid & (li >= 0) & (li < n_local)
-    safe = jnp.where(mine, li, 0)
+    mine, safe = _local_mask(bits_local, idx, valid)
     old_local = jnp.where(mine, bits_local[safe], 0).astype(jnp.int32)
     if set_value:
         new = bits_local.at[safe].max(mine.astype(jnp.uint8))
@@ -90,10 +97,7 @@ def _scatter_body(bits_local, idx, valid, set_value: bool):
 
 
 def _gather_body(bits_local, idx, valid):
-    n_local, start = _span(bits_local)
-    li = idx.astype(jnp.int32) - start
-    mine = valid & (li >= 0) & (li < n_local)
-    safe = jnp.where(mine, li, 0)
+    mine, safe = _local_mask(bits_local, idx, valid)
     local = jnp.where(mine, bits_local[safe], 0).astype(jnp.int32)
     return lax.psum(local, SHARD_AXIS)
 
@@ -143,24 +147,26 @@ def cardinality(bits):
 
 @jax.jit
 def length(bits):
-    """Highest set bit + 1 (0 if empty) — reference lengthAsync."""
-    pos = jnp.arange(bits.shape[0], dtype=jnp.int32)
+    """Highest set bit + 1 (0 if empty) — reference lengthAsync. uint32
+    positions so arrays past 2^31 cells report correctly."""
+    pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
     return jnp.max(jnp.where(bits != 0, pos + 1, 0))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("value",))
 def set_range(bits, start, end, value: bool):
     """Set [start, end) — elementwise select, no communication."""
-    pos = jnp.arange(bits.shape[0], dtype=jnp.int32)
-    in_range = (pos >= start) & (pos < end)
+    pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
+    in_range = (pos >= start.astype(jnp.uint32)) & (pos < end.astype(jnp.uint32))
     return jnp.where(in_range, jnp.uint8(1 if value else 0), bits)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def bitop_not(bits, logical_n):
     """BITOP NOT over the logical range; padding cells stay 0."""
-    pos = jnp.arange(bits.shape[0], dtype=jnp.int32)
-    return jnp.where(pos < logical_n, jnp.uint8(1) - bits, bits)
+    pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
+    return jnp.where(pos < logical_n.astype(jnp.uint32),
+                     jnp.uint8(1) - bits, bits)
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
@@ -190,10 +196,7 @@ def _bloom_add_body(bits_local, h1, h2, valid, k: int, m: int, layout: str):
     idx = _bloom_idx(h1, h2, valid, k, m, layout)  # replicated [N, k]
     flat = idx.reshape(-1)
     vflat = jnp.broadcast_to(valid[:, None], idx.shape).reshape(-1)
-    n_local, start = _span(bits_local)
-    li = flat - start
-    mine = vflat & (li >= 0) & (li < n_local)
-    safe = jnp.where(mine, li, 0)
+    mine, safe = _local_mask(bits_local, flat, vflat)
     old_local = jnp.where(mine, bits_local[safe], 0).astype(jnp.int32)
     new = bits_local.at[safe].max(mine.astype(jnp.uint8))
     old = lax.psum(old_local, SHARD_AXIS).reshape(idx.shape)
@@ -205,10 +208,7 @@ def _bloom_contains_body(bits_local, h1, h2, valid, k: int, m: int,
     idx = _bloom_idx(h1, h2, valid, k, m, layout)
     flat = idx.reshape(-1)
     vflat = jnp.broadcast_to(valid[:, None], idx.shape).reshape(-1)
-    n_local, start = _span(bits_local)
-    li = flat - start
-    mine = vflat & (li >= 0) & (li < n_local)
-    safe = jnp.where(mine, li, 0)
+    mine, safe = _local_mask(bits_local, flat, vflat)
     local = jnp.where(mine, bits_local[safe], 0).astype(jnp.int32)
     got = lax.psum(local, SHARD_AXIS).reshape(idx.shape)
     return jnp.all(got == 1, axis=-1) & valid

@@ -135,3 +135,50 @@ def xxhash64(data: bytes, seed: int = 0):
     h = (h * _P3) & MASK64
     h ^= h >> 32
     return h
+
+
+def murmur2_64a(data: bytes, seed: int = 0xADC83B19) -> int:
+    """Scalar MurmurHash64A — independent reference for the redis-compat
+    HLL hash (transcribed from the public MurmurHash2 spec; redis
+    hyperloglog.c hllPatLen calls it with seed 0xadc83b19)."""
+    m = 0xC6A4A7935BD1E995
+    r = 47
+    mask = (1 << 64) - 1
+    h = (seed ^ (len(data) * m)) & mask
+    nblocks = len(data) // 8
+    for i in range(nblocks):
+        k = int.from_bytes(data[8 * i : 8 * i + 8], "little")
+        k = (k * m) & mask
+        k ^= k >> r
+        k = (k * m) & mask
+        h ^= k
+        h = (h * m) & mask
+    tail = data[nblocks * 8 :]
+    if tail:
+        h ^= int.from_bytes(tail, "little")
+        h = (h * m) & mask
+    h ^= h >> r
+    h = (h * m) & mask
+    h ^= h >> r
+    return h
+
+
+def redis_hll_registers(keys, p: int = 14):
+    """Registers exactly as a real Redis server builds them (hllPatLen):
+    index = low p bits of MurmurHash64A(key, 0xadc83b19); rank = trailing
+    zeros of (hash >> p | 1<<(64-p)) + 1. Independent of every repo kernel
+    — the oracle that breaks the self-consistency cycle."""
+    import numpy as np
+
+    m = 1 << p
+    regs = np.zeros(m, np.uint8)
+    for key in keys:
+        h = murmur2_64a(key)
+        idx = h & (m - 1)
+        rest = (h >> p) | (1 << (64 - p))
+        rank = 1
+        while rest & 1 == 0:
+            rank += 1
+            rest >>= 1
+        regs[idx] = max(regs[idx], rank)
+    return regs
