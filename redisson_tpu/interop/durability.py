@@ -137,13 +137,17 @@ class DurabilityManager:
         bits_written: List[tuple] = []
         for n in names:
             if n in bits_names:
+                # Cheap version probe BEFORE the full cell-array export (a
+                # dispatcher-serialized D2H gather of up to 4 GB — the
+                # periodic only_dirty flush must not pay it for clean
+                # objects; review r5).
+                if (only_dirty and self._flushed_bits_versions.get(n)
+                        == self.pod_backend.bits_version(n)):
+                    continue
                 exported = self.executor.execute_sync(n, "bits_export", None)
                 if exported is None:
                     continue
                 otype, cells, meta, version = exported
-                if (only_dirty
-                        and self._flushed_bits_versions.get(n) == version):
-                    continue
                 counted += 1
                 if otype == ObjectType.BLOOM:
                     cmds.extend(self._bloom_cmds(n, cells, meta))

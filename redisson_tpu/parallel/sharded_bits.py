@@ -146,26 +146,38 @@ def cardinality(bits):
 
 
 @jax.jit
-def length(bits):
-    """Highest set bit + 1 (0 if empty) — reference lengthAsync. uint32
-    positions so arrays past 2^31 cells report correctly."""
+def _length_parts(bits):
+    """(highest set INDEX as uint32, any-set flag). The +1 happens on the
+    host in python ints — adding it on device would wrap index 2^32-1 to 0
+    (review r5)."""
     pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
-    return jnp.max(jnp.where(bits != 0, pos + 1, 0))
+    return (jnp.max(jnp.where(bits != 0, pos, 0)), jnp.any(bits != 0))
+
+
+def length(bits) -> int:
+    """Highest set bit + 1 (0 if empty) — reference lengthAsync. Correct
+    up to 2^32 cells (the top index + 1 is computed host-side)."""
+    idx, has = _length_parts(bits)
+    return int(idx) + 1 if bool(has) else 0
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("value",))
-def set_range(bits, start, end, value: bool):
-    """Set [start, end) — elementwise select, no communication."""
+def set_range(bits, start, last, value: bool):
+    """Set [start, last] INCLUSIVE — the exclusive end of a full 2^32-bit
+    range is unrepresentable in uint32 scalars (review r5), so callers pass
+    end-1 and guard empty ranges themselves."""
     pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
-    in_range = (pos >= start.astype(jnp.uint32)) & (pos < end.astype(jnp.uint32))
+    in_range = ((pos >= start.astype(jnp.uint32))
+                & (pos <= last.astype(jnp.uint32)))
     return jnp.where(in_range, jnp.uint8(1 if value else 0), bits)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
-def bitop_not(bits, logical_n):
-    """BITOP NOT over the logical range; padding cells stay 0."""
+def bitop_not(bits, last):
+    """BITOP NOT over cells [0, last] inclusive; padding cells stay 0
+    (inclusive bound for the same uint32-boundary reason as set_range)."""
     pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
-    return jnp.where(pos < logical_n.astype(jnp.uint32),
+    return jnp.where(pos <= last.astype(jnp.uint32),
                      jnp.uint8(1) - bits, bits)
 
 
