@@ -836,6 +836,16 @@ class TpuBackend:
             )
         return obj
 
+    @staticmethod
+    def _extend(obj, max_index: int) -> None:
+        """Track the WRITTEN extent in redis byte granularity: SETBIT
+        extends the string to the byte holding the index, and size()/NOT
+        operate on that extent, not the pow2 device allocation
+        (conformance vs RedissonBitSetTest.java:82-104 size asserts)."""
+        ext = ((int(max_index) // 8) + 1) * 8
+        if ext > obj.meta.get("extent_bits", 0):
+            obj.meta["extent_bits"] = ext
+
     def _grow_for(self, obj, max_index: int):
         """Redis SETBIT auto-grows the string; grow in power-of-two bytes."""
         nbits = obj.state.shape[0]
@@ -851,6 +861,8 @@ class TpuBackend:
         idx = np.concatenate([op.payload["idx"] for op in ops])
         obj = self._bitset(target, nbits=1024)
         obj = self._grow_for(obj, int(idx.max()) if idx.size else 0)
+        if idx.size:
+            self._extend(obj, int(idx.max()))
         outs = []
         spans = []
         for s, e in engine.chunk_spans(idx.shape[0]):
@@ -947,10 +959,13 @@ class TpuBackend:
         self.completer.submit(_complete_all(ops, lambda: int(v)))
 
     def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
-        """STRLEN * 8 — allocated bit capacity (reference sizeAsync)."""
+        """STRLEN * 8 — the WRITTEN byte extent, exactly what redis
+        reports (not the pow2 device allocation; conformance vs
+        RedissonBitSetTest.java:82-104)."""
         self._check_not_hll(target, ObjectType.BITSET)
         obj = self.store.get(target, ObjectType.BITSET)
-        val = 0 if obj is None else obj.state.shape[0]
+        val = 0 if obj is None else obj.meta.get(
+            "extent_bits", obj.state.shape[0])
         for op in ops:
             op.future.set_result(val)
 
@@ -960,6 +975,13 @@ class TpuBackend:
             obj = self._bitset(target, nbits=1024)
             if end > 0:
                 obj = self._grow_for(obj, end - 1)
+                if value:
+                    # Range-CLEAR does not extend the written extent — the
+                    # wire tier clamps range-clears to the current string
+                    # (r4: no zero-padding writes), and the tiers must
+                    # agree on size(). Single-bit clears extend on both
+                    # tiers, mirroring SETBIT.
+                    self._extend(obj, end - 1)
             new = bitset_ops.set_range(obj.state, start, end, value)
             self.store.swap(target, new)
             op.future.set_result(None)
@@ -970,6 +992,7 @@ class TpuBackend:
             kind = op.payload["op"]
             sources = op.payload["names"]
             arrays = []
+            src_objs = []
             for n in sources:
                 # HLLs live in the bank, not the store: without this guard
                 # an HLL source would read as absent and be silently
@@ -978,10 +1001,14 @@ class TpuBackend:
                 o = self.store.get(n, ObjectType.BITSET)
                 if o is not None:
                     arrays.append(o.state)
+                    src_objs.append(o)
             if kind == "not":
                 obj = self.store.get(target, ObjectType.BITSET)
                 if obj is not None:
-                    self.store.swap(target, bitset_ops.bitop_not(obj.state))
+                    ext = obj.meta.get("extent_bits", 0)
+                    if ext:  # NOT of a never-written string is a no-op
+                        self.store.swap(target, engine.bitset_not_masked(
+                            obj.state, np.uint32(ext)))
                 op.future.set_result(None)
                 continue
             obj = self._bitset(target, nbits=1024)
@@ -999,6 +1026,13 @@ class TpuBackend:
             else:
                 acc = engine.bitset_bitop(jnp.stack(padded), kind)
             obj.meta["nbits"] = width
+            # BITOP dest width = max of the operands' written extents
+            # (redis: STRLEN of the result equals the widest source). A
+            # fresh dest defaults to 0 — its pow2 allocation must not leak
+            # into size() (review r5).
+            obj.meta["extent_bits"] = max(
+                [obj.meta.get("extent_bits", 0)]
+                + [o.meta.get("extent_bits", 0) for o in src_objs])
             self.store.swap(target, acc)
             op.future.set_result(None)
 
@@ -1319,6 +1353,7 @@ class TpuBackend:
             arr = jax.device_put(host, self.store.device)
             if otype == ObjectType.BITSET:
                 meta.setdefault("nbits", host.shape[0])
+                meta.setdefault("extent_bits", host.shape[0])
             obj = self.store.get_or_create(target, otype, lambda: arr, meta)
             self.store.swap(target, arr)
             obj.meta.update(meta)

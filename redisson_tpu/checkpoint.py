@@ -139,6 +139,10 @@ def load(store: SketchStore, path: str,
                 continue
             host = z[_KEY_PREFIX + name]
             meta = info.get("meta") or {}
+            if info["otype"] == "bitset":
+                # Legacy checkpoints predate extent tracking: default the
+                # written extent to the array length so size() stays sane.
+                meta.setdefault("extent_bits", int(np.prod(host.shape)))
             if info["otype"] == "bloom":
                 # Layout flag is merge-unsafe (only written when true): an
                 # absent key must clear any stale blocked=True on a live

@@ -483,6 +483,15 @@ def bitset_length(bits):
     return bitset.length(bits)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bitset_not_masked(bits, n):
+    """BITOP NOT over cells [0, n) only — redis NOT operates on the string's
+    written bytes (STRLEN), not the backing allocation; cells past the
+    written extent stay 0 (conformance vs RedissonBitSetTest.java:57-64)."""
+    pos = jnp.arange(bits.shape[0], dtype=jnp.uint32)
+    return jnp.where(pos < n.astype(jnp.uint32), jnp.uint8(1) - bits, bits)
+
+
 # ---------------------------------------------------------------------------
 # Bloom
 # ---------------------------------------------------------------------------

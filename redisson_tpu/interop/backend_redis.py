@@ -160,6 +160,15 @@ class RedisBackend(RedisBloomMixin):
         op.future.set_result(None)
 
     def _op_getset(self, key: str, op: Op) -> None:
+        if op.payload["value"] is None:
+            # getAndSet(null) = read + delete in one server-side step
+            # (None == absent, RedissonBucketTest.java:33-43).
+            v = self._eval(
+                "local v = redis.call('get', KEYS[1]) "
+                "redis.call('del', KEYS[1]) "
+                "return v", [key], [])
+            op.future.set_result(None if v is None else bytes(v))
+            return
         v = self._x("GETSET", key, op.payload["value"])
         op.future.set_result(None if v is None else bytes(v))
 
@@ -171,14 +180,26 @@ class RedisBackend(RedisBloomMixin):
         op.future.set_result(ok)
 
     def _op_compare_and_set(self, key: str, op: Op) -> None:
-        # Non-atomic GET+SET in v1 (reference uses Lua CAS).
-        cur = self._x("GET", key)
-        cur = None if cur is None else bytes(cur)
-        if cur != op.payload["expect"]:
-            op.future.set_result(False)
+        """Server-side Lua CAS (the reference's own mechanism); a None
+        expect means 'must be absent', a None update deletes on match."""
+        expect, update = op.payload["expect"], op.payload["update"]
+        if expect is None and update is None:
+            op.future.set_result(self._x("EXISTS", key) == 0)
             return
-        self._x("SET", key, op.payload["update"])
-        op.future.set_result(True)
+        if expect is None:
+            op.future.set_result(self._x("SETNX", key, update) == 1)
+            return
+        if update is None:
+            ok = self._eval(
+                "if redis.call('get', KEYS[1]) == ARGV[1] then "
+                "redis.call('del', KEYS[1]) return 1 else return 0 end",
+                [key], [expect])
+        else:
+            ok = self._eval(
+                "if redis.call('get', KEYS[1]) == ARGV[1] then "
+                "redis.call('set', KEYS[1], ARGV[2]) return 1 else return 0 end",
+                [key], [expect, update])
+        op.future.set_result(ok == 1)
 
     def _op_incr(self, key: str, op: Op) -> None:
         if op.payload.get("float"):

@@ -266,7 +266,10 @@ class DurabilityManager:
             out = np.zeros(nbits, np.uint8)
             out[:min(nbits, bits.size)] = bits[:nbits]
             bits = out
-        self._put_bits(name, ObjectType.BITSET, bits.astype(np.uint8))
+        # The blob length IS the written extent (STRLEN semantics).
+        self._put_bits(name, ObjectType.BITSET, bits.astype(np.uint8),
+                       {"nbits": int(bits.size),
+                        "extent_bits": int(bits.size)})
         return True
 
     def load_bloom(self, name: str) -> bool:

@@ -28,28 +28,44 @@ class RBucket(RExpirable):
         self.set_async(value, ttl_s).result()
 
     def set_async(self, value: Any, ttl_s: Optional[float] = None):
+        if value is None:
+            # None == absent across the whole bucket surface (the
+            # reference's setAsync(null) issues DEL; review r5 made
+            # get_and_set/compare_and_set follow this — set must agree).
+            return self._executor.execute_async(self.name, "delete", None)
         payload = {"value": self._codec.encode(value)}
         if ttl_s:
             payload["ttl_ms"] = int(ttl_s * 1000)
         return self._executor.execute_async(self.name, "set", payload)
 
     def get_and_set(self, value: Any) -> Any:
-        raw = self._executor.execute_sync(self.name, "getset", {"value": self._codec.encode(value)})
+        """getAndSet; a None value DELETES the key (None == absent, the
+        reference contract — RedissonBucketTest.java:33-43)."""
+        raw = self._executor.execute_sync(
+            self.name, "getset",
+            {"value": None if value is None else self._codec.encode(value)})
         return None if raw is None else self._codec.decode(raw)
 
     def try_set(self, value: Any, ttl_s: Optional[float] = None) -> bool:
+        if value is None:
+            # trySet(null): succeed iff absent, writing nothing (None ==
+            # absent, same contract as set/compare_and_set).
+            return not self.is_exists()
         payload = {"value": self._codec.encode(value)}
         if ttl_s:
             payload["ttl_ms"] = int(ttl_s * 1000)
         return self._executor.execute_sync(self.name, "setnx", payload)
 
     def compare_and_set(self, expect: Any, update: Any) -> bool:
+        """compareAndSet; None on either side means ABSENT — expect=None
+        requires a missing key, update=None deletes on match
+        (RedissonBucketTest.java:16-31)."""
         return self._executor.execute_sync(
             self.name,
             "compare_and_set",
             {
                 "expect": None if expect is None else self._codec.encode(expect),
-                "update": self._codec.encode(update),
+                "update": None if update is None else self._codec.encode(update),
             },
         )
 

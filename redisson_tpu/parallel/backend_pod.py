@@ -411,6 +411,14 @@ class PodBackend:
             self._bits[name] = obj
         return obj
 
+    @staticmethod
+    def _extend(obj: _PodBits, max_index: int) -> None:
+        """Written extent in redis byte granularity (same rule as
+        TpuBackend._extend: size()/NOT follow STRLEN semantics)."""
+        ext = ((int(max_index) // 8) + 1) * 8
+        if ext > obj.meta.get("extent_bits", 0):
+            obj.meta["extent_bits"] = ext
+
     def _bits_grow(self, obj: _PodBits, max_index: int) -> None:
         """SETBIT auto-grow (same pow2 logical sizing as the single-chip
         tier; physical padding to a device multiple is the shard grain)."""
@@ -425,6 +433,8 @@ class PodBackend:
         idx = np.concatenate([op.payload["idx"] for op in ops])
         obj = self._bitset_obj(target, nbits=1024)
         self._bits_grow(obj, int(idx.max()) if idx.size else 0)
+        if idx.size:
+            self._extend(obj, int(idx.max()))
         kernel = sharded_bits.set_bits if set_value else sharded_bits.clear_bits
         outs, spans = [], []
         for s, e in engine.chunk_spans(idx.shape[0]):
@@ -491,7 +501,7 @@ class PodBackend:
     def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
         self._bits_check(target, ObjectType.BITSET)
         obj = self._bits.get(target)
-        val = 0 if obj is None else obj.logical_n
+        val = 0 if obj is None else obj.meta.get("extent_bits", obj.logical_n)
         for op in ops:
             op.future.set_result(val)
 
@@ -504,6 +514,8 @@ class PodBackend:
                 op.future.set_result(None)
                 continue
             self._bits_grow(obj, end - 1)
+            if value:
+                self._extend(obj, end - 1)
             obj.state = sharded_bits.set_range(
                 obj.state, np.uint32(start), np.uint32(end - 1), bool(value))
             obj.version += 1
@@ -518,9 +530,11 @@ class PodBackend:
                 obj = self._bits.get(target)
                 self._bits_check(target, ObjectType.BITSET)
                 if obj is not None:
-                    obj.state = sharded_bits.bitop_not(
-                        obj.state, np.uint32(obj.logical_n - 1))
-                    obj.version += 1
+                    ext = obj.meta.get("extent_bits", 0)
+                    if ext:  # NOT of a never-written string is a no-op
+                        obj.state = sharded_bits.bitop_not(
+                            obj.state, np.uint32(ext - 1))
+                        obj.version += 1
                 op.future.set_result(None)
                 continue
             sources = []
@@ -539,6 +553,9 @@ class PodBackend:
                 ]
                 obj.state = sharded_bits.bitop(jnp.stack(stack), kind)
             obj.meta["nbits"] = width
+            obj.meta["extent_bits"] = max(
+                [obj.meta.get("extent_bits", 0)]
+                + [s.meta.get("extent_bits", 0) for s in sources])
             obj.version += 1
             op.future.set_result(None)
 
@@ -696,6 +713,7 @@ class PodBackend:
                 padded, sharded_bits.bits_sharding(self.mesh))
             if otype == ObjectType.BITSET:
                 meta.setdefault("nbits", host.shape[0])
+                meta.setdefault("extent_bits", host.shape[0])
             obj = _PodBits(target, otype, state, meta)
             obj.version = 1
             self._bits[target] = obj

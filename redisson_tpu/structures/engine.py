@@ -369,6 +369,15 @@ class StructureBackend(ExtendedOps):
         op.future.set_result(None)
 
     def _op_getset(self, key: str, op: Op) -> None:
+        # A None value means ABSENT: getAndSet(null) deletes the key
+        # (reference contract, RedissonBucketTest.java:33-43 — the bucket
+        # must not exist afterwards).
+        if op.payload["value"] is None:
+            kv = self._entry(key, T.STRING)
+            old = None if kv is None else kv.value
+            self._drop(key)
+            op.future.set_result(old)
+            return
         kv = self._create(key, T.STRING, lambda: None)
         old, kv.value = kv.value, op.payload["value"]
         op.future.set_result(old)
@@ -389,6 +398,12 @@ class StructureBackend(ExtendedOps):
         current = None if kv is None else kv.value
         if current != op.payload["expect"]:
             op.future.set_result(False)
+            return
+        # compareAndSet(expect, null) deletes on match (None == absent,
+        # RedissonBucketTest.java:16-31).
+        if op.payload["update"] is None:
+            self._drop(key)
+            op.future.set_result(True)
             return
         kv = self._create(key, T.STRING, lambda: None)
         kv.value = op.payload["update"]

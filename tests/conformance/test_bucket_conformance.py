@@ -1,0 +1,231 @@
+"""RBucket / RAtomicLong / RAtomicDouble / RHyperLogLog conformance vs the
+reference's RedissonBucketTest / RedissonAtomicLongTest /
+RedissonAtomicDoubleTest / RedissonHyperLogLogTest."""
+
+import time
+
+import pytest
+
+
+# ---- RBucket (RedissonBucketTest.java) ------------------------------------
+
+
+def test_bucket_compare_and_set(client):
+    # RedissonBucketTest.java:16-31 testCompareAndSet — None = absent
+    b = client.get_bucket("testCompareAndSet")
+    assert b.compare_and_set(None, ["81"]) is True
+    assert b.compare_and_set(None, ["12"]) is False
+    assert b.compare_and_set(["81"], ["0"]) is True
+    assert b.get() == ["0"]
+    assert b.compare_and_set(["1"], ["2"]) is False
+    assert b.get() == ["0"]
+    assert b.compare_and_set(["0"], None) is True
+    assert b.get() is None
+    assert b.is_exists() is False
+
+
+def test_bucket_get_and_set(client):
+    # RedissonBucketTest.java:33-43 testGetAndSet
+    b = client.get_bucket("testGetAndSet")
+    assert b.get_and_set(["81"]) is None
+    assert b.get_and_set(["1"]) == ["81"]
+    assert b.get() == ["1"]
+    assert b.get_and_set(None) == ["1"]
+    assert b.get() is None
+    assert b.is_exists() is False
+
+
+def test_bucket_try_set(client):
+    # RedissonBucketTest.java:45-51 testTrySet
+    b = client.get_bucket("testTrySet")
+    assert b.try_set("3") is True
+    assert b.try_set("4") is False
+    assert b.get() == "3"
+
+
+def test_bucket_try_set_ttl(client):
+    # RedissonBucketTest.java:53-63 testTrySetTTL (scaled down)
+    b = client.get_bucket("testTrySetTTL")
+    assert b.try_set("3", ttl_s=0.12) is True
+    assert b.try_set("4", ttl_s=0.12) is False
+    assert b.get() == "3"
+    time.sleep(0.25)
+    assert b.get() is None
+
+
+def test_bucket_expire(client):
+    # RedissonBucketTest.java:65-73 testExpire (scaled down)
+    b = client.get_bucket("test1")
+    b.set("someValue", ttl_s=0.1)
+    time.sleep(0.22)
+    assert b.get() is None
+
+
+def test_bucket_renamenx(client):
+    # RedissonBucketTest.java:75-87 testRenamenx
+    b = client.get_bucket("test")
+    b.set("someValue")
+    b2 = client.get_bucket("test2")
+    b2.set("someValue2")
+    assert b.renamenx("test1") is True
+    assert client.get_bucket("test").get() is None
+    new_b = client.get_bucket("test1")
+    assert new_b.get() == "someValue"
+    assert new_b.renamenx("test2") is False
+
+
+def test_bucket_rename(client):
+    # RedissonBucketTest.java:89-98 testRename
+    b = client.get_bucket("test")
+    b.set("someValue")
+    b.rename("test1")
+    assert client.get_bucket("test").get() is None
+    assert client.get_bucket("test1").get() == "someValue"
+
+
+def test_bucket_set_get_delete_exist(client):
+    # RedissonBucketTest.java:100-131 testSetGet/testSetDelete/testSetExist
+    b = client.get_bucket("test")
+    assert b.get() is None
+    b.set("somevalue")
+    assert b.get() == "somevalue"
+    assert b.is_exists() is True
+    assert b.delete() is True
+    assert b.get() is None
+    assert b.delete() is False
+
+
+# ---- RAtomicLong (RedissonAtomicLongTest.java) ----------------------------
+
+
+def test_atomic_compare_and_set_zero(client):
+    # RedissonAtomicLongTest.java:10-20 testCompareAndSetZero — a missing
+    # counter reads 0 and CAS(0, x) succeeds
+    al = client.get_atomic_long("test")
+    assert al.compare_and_set(0, 2) is True
+    assert al.get() == 2
+    al2 = client.get_atomic_long("test1")
+    al2.set(0)
+    assert al2.compare_and_set(0, 2) is True
+    assert al2.get() == 2
+
+
+def test_atomic_compare_and_set(client):
+    # RedissonAtomicLongTest.java:23-30 testCompareAndSet
+    al = client.get_atomic_long("test")
+    assert al.compare_and_set(-1, 2) is False
+    assert al.get() == 0
+    assert al.compare_and_set(0, 2) is True
+    assert al.get() == 2
+
+
+def test_atomic_set_then_increment(client):
+    # RedissonAtomicLongTest.java:32-38 testSetThenIncrement
+    al = client.get_atomic_long("test")
+    al.set(2)
+    assert al.get_and_increment() == 2
+    assert al.get() == 3
+
+
+def test_atomic_increment_and_get(client):
+    # RedissonAtomicLongTest.java:40-51 testIncrementAndGet/testGetAndIncrement
+    al = client.get_atomic_long("test")
+    assert al.increment_and_get() == 1
+    assert al.get() == 1
+    al2 = client.get_atomic_long("test2")
+    assert al2.get_and_increment() == 0
+    assert al2.get() == 1
+
+
+def test_atomic_full_sequence(client):
+    # RedissonAtomicLongTest.java:53-73 test — the full op walk incl. a
+    # value near Long.MAX_VALUE
+    al = client.get_atomic_long("test")
+    assert al.get() == 0
+    assert al.get_and_increment() == 0
+    assert al.get() == 1
+    assert al.get_and_decrement() == 1
+    assert al.get() == 0
+    assert al.get_and_increment() == 0
+    assert al.get_and_set(12) == 1
+    assert al.get() == 12
+    al.set(1)
+    assert client.get_atomic_long("test").get() == 1
+    big = (1 << 63) - 1 - 1000
+    al.set(big)
+    assert client.get_atomic_long("test").get() == big
+
+
+def test_atomic_double(client):
+    # RedissonAtomicDoubleTest.java — float counterpart surface
+    ad = client.get_atomic_double("testad")
+    assert ad.get() == 0.0
+    assert ad.add_and_get(1.5) == pytest.approx(1.5)
+    assert ad.compare_and_set(1.5, 3.0) is True
+    assert ad.compare_and_set(1.5, 9.0) is False
+    assert ad.get_and_set(7.5) == pytest.approx(3.0)
+    assert ad.increment_and_get() == pytest.approx(8.5)
+    assert ad.decrement_and_get() == pytest.approx(7.5)
+
+
+# ---- RHyperLogLog (RedissonHyperLogLogTest.java) --------------------------
+
+
+def test_hll_add(client):
+    # RedissonHyperLogLogTest.java:10-17 testAdd — tiny cardinalities exact
+    log = client.get_hyper_log_log("log")
+    log.add(b"1")
+    log.add(b"2")
+    log.add(b"3")
+    assert log.count() == 3
+
+
+def test_hll_merge(client):
+    # RedissonHyperLogLogTest.java:20-38 testMerge — add() True on change,
+    # False on a re-add; union of {foo,bar,zap,a} and {a,b,c,foo} counts 6
+    hll1 = client.get_hyper_log_log("hll1")
+    assert hll1.add(b"foo") is True
+    assert hll1.add(b"bar") is True
+    assert hll1.add(b"zap") is True
+    assert hll1.add(b"a") is True
+    hll2 = client.get_hyper_log_log("hll2")
+    assert hll2.add(b"a") is True
+    assert hll2.add(b"b") is True
+    assert hll2.add(b"c") is True
+    assert hll2.add(b"foo") is True
+    assert hll2.add(b"c") is False
+    hll3 = client.get_hyper_log_log("hll3")
+    hll3.merge_with("hll1", "hll2")
+    assert hll3.count() == 6
+
+
+def test_bucket_set_none_deletes(client):
+    # review r5: setAsync(null) issues DEL in the reference — all four
+    # null-write paths (set/trySet/getAndSet/compareAndSet) agree
+    b = client.get_bucket("nulls")
+    b.set("v")
+    b.set(None)
+    assert b.get() is None and b.is_exists() is False
+    assert b.try_set(None) is True  # absent -> "set" succeeds, writes nothing
+    assert b.is_exists() is False
+    b.set("w")
+    assert b.try_set(None) is False  # present -> fails
+
+
+def test_bitset_fresh_dest_bitop_size(client):
+    # review r5: BITOP into a fresh destination must not leak the pow2
+    # device allocation into size() (redis: STRLEN of the widest source)
+    a = client.get_bit_set("fd:a")
+    a.set(5)
+    x = client.get_bit_set("fd:x")
+    x.or_("fd:a")
+    assert x.size() == 8
+    assert x.cardinality() == 1
+
+
+def test_bitset_not_on_fresh_is_noop(client):
+    # review r5: NOT of a never-written string leaves it empty
+    bs = client.get_bit_set("fn:x")
+    bs.not_()
+    assert bs.cardinality() == 0
+    assert bs.size() == 0
