@@ -964,8 +964,10 @@ class TpuBackend:
         RedissonBitSetTest.java:82-104)."""
         self._check_not_hll(target, ObjectType.BITSET)
         obj = self.store.get(target, ObjectType.BITSET)
-        val = 0 if obj is None else obj.meta.get(
-            "extent_bits", obj.state.shape[0])
+        # Default 0, never the pow2 allocation: an object created by a
+        # write-less path (range-clear on a fresh key) has no written
+        # extent and redis would report STRLEN 0 (review r5).
+        val = 0 if obj is None else obj.meta.get("extent_bits", 0)
         for op in ops:
             op.future.set_result(val)
 

@@ -657,6 +657,21 @@ class RedisCountDownLatch:
         v = self._scripts.resp.execute("GET", self.name)
         return int(v) if v is not None else 0
 
+    def delete(self) -> bool:
+        """Drop the latch; True if it existed, waking waiters (reference
+        deleteAsync: del + zero-count publish,
+        RedissonCountDownLatchTest.java:120-131)."""
+        return bool(self._scripts.run(
+            """
+            if (redis.call('exists', KEYS[1]) == 1) then
+                redis.call('del', KEYS[1])
+                redis.call('publish', KEYS[2], ARGV[1])
+                return 1
+            end
+            return 0
+            """,
+            [self.name, self.channel], [ZERO_COUNT_MESSAGE]))
+
     def await_(self, timeout_s: Optional[float] = None) -> bool:
         if self.get_count() == 0:
             return True

@@ -85,7 +85,11 @@ class DurabilityManager:
             return [["SET", key,
                      hyll.encode_dense(regs, family=self.hll_family)]]
         if obj.otype == ObjectType.BITSET:
-            packed = np.packbits(np.asarray(obj.state).astype(np.uint8))
+            # Pack only the WRITTEN extent: a real server's STRLEN of the
+            # flushed key must match the extent size() reports, not the
+            # pow2 device allocation (review r5).
+            ext = obj.meta.get("extent_bits", 0)
+            packed = np.packbits(np.asarray(obj.state).astype(np.uint8)[:ext])
             return [["SET", key, packed.tobytes()]]
         if obj.otype == ObjectType.BLOOM:
             return self._bloom_cmds(name, np.asarray(obj.state), obj.meta)
@@ -152,8 +156,9 @@ class DurabilityManager:
                 if otype == ObjectType.BLOOM:
                     cmds.extend(self._bloom_cmds(n, cells, meta))
                 else:
+                    ext = (meta or {}).get("extent_bits", 0)
                     cmds.append(["SET", self.prefix + n,
-                                 np.packbits(cells).tobytes()])
+                                 np.packbits(cells[:ext]).tobytes()])
                 bits_written.append((n, version))
                 continue
             if n in bank_names:

@@ -406,6 +406,15 @@ class RCountDownLatch:
     def get_count(self) -> int:
         return self._executor.execute_sync(self.name, "latch_get", None)
 
+    def delete(self) -> bool:
+        """Drop the latch; True if it existed (reference deleteAsync,
+        RedissonCountDownLatchTest.java:120-131). Waiters wake — a deleted
+        latch reads count 0."""
+        existed = bool(self._executor.execute_sync(self.name, "delete", None))
+        if existed:
+            self._pubsub.publish(LATCH_CHANNEL_PREFIX + self.name, b"0")
+        return existed
+
     def await_(self, timeout_s: Optional[float] = None) -> bool:
         """Block until count hits zero; True if it did within the timeout."""
         if self.get_count() == 0:

@@ -86,10 +86,6 @@ def bitop_xor(a, b):
     return a ^ b
 
 
-def bitop_not(a):
-    return jnp.uint8(1) - a
-
-
 def pack(bits: jnp.ndarray) -> jnp.ndarray:
     """Unpacked cells -> Redis byte layout (bit 0 is MSB of byte 0)."""
     n = bits.shape[0]

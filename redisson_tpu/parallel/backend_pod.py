@@ -501,7 +501,7 @@ class PodBackend:
     def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
         self._bits_check(target, ObjectType.BITSET)
         obj = self._bits.get(target)
-        val = 0 if obj is None else obj.meta.get("extent_bits", obj.logical_n)
+        val = 0 if obj is None else obj.meta.get("extent_bits", 0)
         for op in ops:
             op.future.set_result(val)
 

@@ -1,0 +1,364 @@
+"""RSemaphore / RCountDownLatch / RReadWriteLock / RBlockingQueue / RKeys /
+RSetMultimap conformance vs the reference's per-object suites."""
+
+import threading
+import time
+
+
+# ---- RSemaphore (RedissonSemaphoreTest.java) ------------------------------
+
+
+def test_semaphore_blocking_acquire(client):
+    # RedissonSemaphoreTest.java:19-45 testBlockingAcquire
+    s = client.get_semaphore("test")
+    s.set_permits(1)
+    s.acquire()
+
+    def releaser():
+        time.sleep(0.2)
+        client.get_semaphore("test").release()
+
+    t = threading.Thread(target=releaser)
+    t.start()
+    assert s.available_permits() == 0
+    s.acquire()  # blocks until the thread releases
+    assert s.try_acquire() is False
+    assert s.available_permits() == 0
+    t.join()
+
+
+def test_semaphore_blocking_n_acquire(client):
+    # RedissonSemaphoreTest.java:47-79 testBlockingNAcquire
+    s = client.get_semaphore("test")
+    s.set_permits(5)
+    s.acquire(3)
+
+    def releaser():
+        sem = client.get_semaphore("test")
+        time.sleep(0.1)
+        sem.release()
+        time.sleep(0.1)
+        sem.release()
+
+    assert s.available_permits() == 2
+    t = threading.Thread(target=releaser)
+    t.start()
+    s.acquire(4)  # needs both releases
+    assert s.available_permits() == 0
+    t.join()
+
+
+def test_semaphore_try_n_acquire(client):
+    # RedissonSemaphoreTest.java:81-100 testTryNAcquire
+    s = client.get_semaphore("test")
+    s.set_permits(5)
+    assert s.try_acquire(3) is True
+    assert s.try_acquire(4) is False
+    s.release()
+    s.release()
+    assert s.try_acquire(4) is True
+
+
+# ---- RCountDownLatch (RedissonCountDownLatchTest.java) --------------------
+
+
+def test_latch_count_down(client):
+    # RedissonCountDownLatchTest.java:78-118 testCountDown
+    latch = client.get_count_down_latch("latch")
+    latch.try_set_count(2)
+    assert latch.get_count() == 2
+    latch.count_down()
+    assert latch.get_count() == 1
+    latch.count_down()
+    assert latch.get_count() == 0
+    assert latch.await_(timeout_s=1) is True
+    latch.count_down()
+    assert latch.get_count() == 0  # never below zero
+    # a latch never armed has count 0 and await returns immediately
+    latch3 = client.get_count_down_latch("latch3")
+    assert latch3.get_count() == 0
+    assert latch3.await_(timeout_s=1) is True
+
+
+def test_latch_await_timeout(client):
+    # RedissonCountDownLatchTest.java:15-76 testAwaitTimeout(+Fail)
+    latch = client.get_count_down_latch("latch")
+    latch.try_set_count(1)
+
+    def opener():
+        time.sleep(0.15)
+        client.get_count_down_latch("latch").count_down()
+
+    t = threading.Thread(target=opener)
+    t.start()
+    assert latch.await_(timeout_s=5) is True  # opened well within timeout
+    t.join()
+    latch2 = client.get_count_down_latch("latch2")
+    latch2.try_set_count(1)
+    t0 = time.monotonic()
+    assert latch2.await_(timeout_s=0.2) is False  # never opened
+    assert time.monotonic() - t0 >= 0.18
+
+
+def test_latch_delete(client):
+    # RedissonCountDownLatchTest.java:120-131 testDelete(+Failed)
+    latch = client.get_count_down_latch("latch")
+    latch.try_set_count(1)
+    assert latch.delete() is True
+    latch2 = client.get_count_down_latch("latchX")
+    assert latch2.delete() is False
+
+
+# ---- RReadWriteLock (RedissonReadWriteLockTest.java) ----------------------
+
+
+def test_rw_lock_multiple_readers(client):
+    # RedissonReadWriteLockTest — concurrent read locks coexist
+    rw = client.get_read_write_lock("rw")
+    r1 = rw.read_lock()
+    r1.lock()
+    got = []
+
+    def reader():
+        r = client.get_read_write_lock("rw").read_lock()
+        got.append(r.try_lock())
+        if got[-1]:
+            r.unlock()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join()
+    assert got == [True]
+    r1.unlock()
+
+
+def test_rw_lock_writer_excludes(client):
+    # write lock excludes other threads' readers AND writers
+    rw = client.get_read_write_lock("rw")
+    w = rw.write_lock()
+    w.lock()
+    got = []
+
+    def contender():
+        other = client.get_read_write_lock("rw")
+        got.append(other.read_lock().try_lock())
+        got.append(other.write_lock().try_lock())
+
+    t = threading.Thread(target=contender)
+    t.start()
+    t.join()
+    assert got == [False, False]
+    w.unlock()
+
+
+# ---- RBlockingQueue (RedissonBlockingQueueTest.java) ----------------------
+
+
+def test_blocking_queue_take(client):
+    # RedissonBlockingQueueTest.java:234-252 testTake (scaled down)
+    q = client.get_blocking_queue("queue:take")
+
+    def producer():
+        time.sleep(0.2)
+        client.get_blocking_queue("queue:take").put(3)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    t0 = time.monotonic()
+    assert q.take() == 3
+    assert time.monotonic() - t0 >= 0.15
+    t.join()
+
+
+def test_blocking_queue_poll_timeout(client):
+    # RedissonBlockingQueueTest.java:254-262 testPoll
+    q = client.get_blocking_queue("queue1")
+    q.put(1)
+    assert q.poll(timeout_s=2) == 1
+    t0 = time.monotonic()
+    assert q.poll(timeout_s=0.3) is None
+    assert time.monotonic() - t0 >= 0.28
+
+
+def test_blocking_queue_poll_last_and_offer_first_to(client):
+    # RedissonBlockingQueueTest.java:272-291 testPollLastAndOfferFirstTo
+    q1 = client.get_blocking_queue("{queue}1")
+
+    def producer():
+        time.sleep(0.15)
+        client.get_blocking_queue("{queue}1").put(3)
+
+    q2 = client.get_blocking_queue("{queue}2")
+    q2.put(4)
+    q2.put(5)
+    q2.put(6)
+    t = threading.Thread(target=producer)
+    t.start()
+    q1.poll_last_and_offer_first_to("{queue}2", timeout_s=5)
+    t.join()
+    assert [q2.poll() for _ in range(4)] == [3, 4, 5, 6]
+
+
+def test_blocking_queue_add_offer(client):
+    # RedissonBlockingQueueTest.java:307-319 testAddOffer
+    q = client.get_blocking_queue("blocking:queue")
+    q.put(1)
+    assert q.offer(2) is True
+    q.put(3)
+    q.offer(4)
+    assert [q.poll() for _ in range(4)] == [1, 2, 3, 4]
+
+
+# ---- RKeys (RedissonKeysTest.java) ----------------------------------------
+
+
+def test_keys_delete_by_pattern(client):
+    # RedissonKeysTest.java:66-86 testDeleteByPattern
+    client.get_bucket("test0").set("someValue3")
+    client.get_bucket("test9").set("someValue4")
+    client.get_map("test2").fast_put("1", "2")
+    client.get_map("test3").fast_put("1", "5")
+    assert client.get_keys().delete_by_pattern("test?") == 4
+    assert client.get_keys().delete_by_pattern("test?") == 0
+
+
+def test_keys_find_keys(client):
+    # RedissonKeysTest.java:89-101 testFindKeys
+    client.get_bucket("test1").set("someValue")
+    client.get_map("test2").fast_put("1", "2")
+    assert set(client.get_keys().find_keys_by_pattern("test?")) == {
+        "test1", "test2"}
+    assert client.get_keys().find_keys_by_pattern("test") == []
+
+
+def test_keys_mass_delete(client):
+    # RedissonKeysTest.java:103-123 testMassDelete
+    for n in ("test0", "test1", "test2", "test3", "test10", "test12"):
+        client.get_bucket(n).set("someValue")
+    client.get_map("map2").fast_put("1", "2")
+    names = ("test0", "test1", "test2", "test3", "test10", "test12", "map2")
+    assert client.get_keys().delete(*names) == 7
+    assert client.get_keys().delete(*names) == 0
+
+
+def test_keys_count_and_random(client):
+    # RedissonKeysTest.java:51-64,125-133 testRandomKey / testCount
+    client.get_bucket("test1").set("someValue1")
+    assert client.get_keys().count() == 1
+    assert client.get_keys().random_key() == "test1"
+    client.get_bucket("test2").set("someValue2")
+    assert client.get_keys().count() == 2
+    assert client.get_keys().random_key() in ("test1", "test2")
+
+
+# ---- RSetMultimap (RedissonSetMultimapTest.java) --------------------------
+
+
+def test_multimap_size(client):
+    # RedissonSetMultimapTest.java:121-133 testSize
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("0", "2")
+    assert mm.size() == 2
+    mm.fast_remove("0")
+    assert mm.get("0") == [] or set(mm.get("0")) == set()
+    assert mm.size() == 0
+
+
+def test_multimap_key_size(client):
+    # RedissonSetMultimapTest.java:136-150 testKeySize
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("0", "2")
+    mm.put("1", "3")
+    assert mm.key_size() == 2
+    assert len(mm.key_set()) == 2
+    mm.fast_remove("0")
+    assert mm.key_size() == 1
+
+
+def test_multimap_put(client):
+    # RedissonSetMultimapTest.java:153-171 testPut — set semantics dedupe
+    mm = client.get_set_multimap("test1")
+    assert mm.put("0", "1") is True
+    assert mm.put("0", "2") is True
+    assert mm.put("0", "3") is True
+    assert mm.put("0", "3") is False
+    assert mm.put("3", "4") is True
+    assert mm.size() == 4
+    assert set(mm.get("0")) == {"1", "2", "3"}
+    assert set(mm.get_all("0")) == {"1", "2", "3"}
+    assert set(mm.get("3")) == {"4"}
+
+
+def test_multimap_remove_all(client):
+    # RedissonSetMultimapTest.java:173-186 testRemoveAll
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("0", "2")
+    mm.put("0", "3")
+    assert set(mm.remove_all("0")) == {"1", "2", "3"}
+    assert mm.size() == 0
+    assert mm.remove_all("0") == []
+
+
+def test_multimap_fast_remove(client):
+    # RedissonSetMultimapTest.java:188-199 testFastRemove — count of keys
+    mm = client.get_set_multimap("test1")
+    assert mm.put("0", "1") is True
+    assert mm.put("0", "2") is True
+    assert mm.put("0", "2") is False
+    assert mm.put("0", "3") is True
+    assert mm.fast_remove("0", "1") == 1
+    assert mm.size() == 0
+
+
+def test_multimap_contains(client):
+    # RedissonSetMultimapTest.java:201-225 testContainsKey/Value/Entry
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    assert mm.contains_key("0") is True
+    assert mm.contains_key("1") is False
+    assert mm.contains_value("1") is True
+    assert mm.contains_value("0") is False
+    assert mm.contains_entry("0", "1") is True
+    assert mm.contains_entry("0", "2") is False
+
+
+def test_multimap_remove(client):
+    # RedissonSetMultimapTest.java:227-238 testRemove
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("0", "2")
+    mm.put("0", "3")
+    assert mm.remove("0", "2") is True
+    assert mm.remove("0", "5") is False
+    assert len(mm.get("0")) == 2
+
+
+def test_multimap_put_all(client):
+    # RedissonSetMultimapTest.java:240-248 testPutAll
+    mm = client.get_set_multimap("test1")
+    assert mm.put_all("0", ["1", "2", "3"]) is True
+    assert mm.put_all("0", ["1"]) is False
+    assert set(mm.get("0")) == {"1", "2", "3"}
+
+
+def test_multimap_key_set_values_entries(client):
+    # RedissonSetMultimapTest.java:250-280 testKeySet/testValues/testEntrySet
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("3", "4")
+    assert set(mm.key_set()) == {"0", "3"}
+    assert sorted(mm.values()) == ["1", "4"]
+    assert sorted(mm.entries()) == [("0", "1"), ("3", "4")]
+
+
+def test_multimap_replace_values(client):
+    # RedissonSetMultimapTest.java:282-294 testReplaceValues
+    mm = client.get_set_multimap("test1")
+    mm.put("0", "1")
+    mm.put("3", "4")
+    old = mm.replace_values("0", ["11", "12"])
+    assert set(old) == {"1"}
+    assert set(mm.get_all("0")) == {"11", "12"}
