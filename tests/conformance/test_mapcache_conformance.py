@@ -1,0 +1,125 @@
+"""RMapCache / RSetCache conformance vs the reference's
+RedissonMapCacheTest / RedissonSetCacheTest (TTL/maxIdle semantics scaled
+to sub-second leases; the reference sleeps seconds)."""
+
+import time
+
+
+def test_put_get_ttl(client):
+    # RedissonMapCacheTest.java:496-516 testPutGet
+    m = client.get_map_cache("simple04")
+    assert m.get("33") is None
+    m.put("33", "44", ttl_s=0.4)
+    assert m.get("33") == "44"
+    time.sleep(0.2)
+    assert m.size() == 1
+    assert m.get("33") == "44"
+    time.sleep(0.3)
+    assert m.get("33") is None
+
+
+def test_put_if_absent_ttl(client):
+    # RedissonMapCacheTest.java:518-538 testPutIfAbsent
+    m = client.get_map_cache("simple")
+    m.put("1", "2")
+    assert m.put_if_absent("1", "3", ttl_s=0.3) == "2"
+    assert m.get("1") == "2"
+    m.put_if_absent("4", "4", ttl_s=0.3)
+    assert m.get("4") == "4"
+    time.sleep(0.4)
+    assert m.get("4") is None
+    assert m.put_if_absent("2", "4", ttl_s=1) is None
+    assert m.get("2") == "4"
+
+
+def test_size_overwrites(client):
+    # RedissonMapCacheTest.java:540-562 testSize
+    m = client.get_map_cache("simple")
+    m.put("1", "2")
+    m.put("3", "4")
+    m.put("5", "6")
+    assert m.size() == 3
+    m.put("1", "2")
+    m.put("3", "4")
+    assert m.size() == 3
+    m.put("1", "21")
+    m.put("3", "41")
+    assert m.size() == 3
+    m.put("51", "6")
+    assert m.size() == 4
+    m.remove("3")
+    assert m.size() == 3
+
+
+def test_put_idle(client):
+    # RedissonMapCacheTest.java:635-649 testPutIdle — touches refresh the
+    # idle clock (scaled: maxIdle 0.3s, touch every 0.15s)
+    m = client.get_map_cache("simple")
+    m.put(1, 2, max_idle_s=0.3)
+    for _ in range(4):
+        time.sleep(0.15)
+        assert m.get(1) == 2  # each read resets the idle timer
+    time.sleep(0.45)
+    assert m.get(1) is None  # untouched past maxIdle -> gone
+
+
+def test_fast_put_with_ttl(client):
+    # RedissonMapCacheTest.java:683-697 testFastPutWithTTL(+MaxIdle)
+    m = client.get_map_cache("simple")
+    assert m.fast_put(1, 2, ttl_s=2) is True
+    assert m.fast_put(1, 2, ttl_s=2) is False
+    assert m.size() == 1
+    m2 = client.get_map_cache("simple2")
+    assert m2.fast_put(1, 2, ttl_s=200, max_idle_s=100) is True
+    assert m2.fast_put(1, 2, ttl_s=200, max_idle_s=100) is False
+    assert m2.size() == 1
+
+
+def test_expire_overwrite(client):
+    # RedissonMapCacheTest.java:715-730 testExpireOverwrite — re-put
+    # restarts the entry TTL
+    m = client.get_map_cache("simple")
+    m.put("123", 3, ttl_s=0.3)
+    time.sleep(0.2)
+    m.put("123", 3, ttl_s=0.3)
+    time.sleep(0.2)
+    assert m.get("123") == 3
+    time.sleep(0.25)
+    assert m.contains_key("123") is False
+
+
+def test_cache_values_skip_expired(client):
+    # RedissonMapCacheTest.java:130-156 testCacheValues / testGetAll — an
+    # expired entry is invisible to reads and aggregates
+    m = client.get_map_cache("simple")
+    m.put("a", 1)
+    m.put("b", 2, ttl_s=0.15)
+    time.sleep(0.25)
+    assert m.read_all_map() == {"a": 1}
+    assert m.size() == 1
+    assert m.contains_key("b") is False
+
+
+def test_scheduler_sweeps(client):
+    # RedissonMapCacheTest.java:479-494 testScheduler — expired entries
+    # vanish without an explicit read touching them
+    m = client.get_map_cache("simple3")
+    assert m.get("33") is None
+    m.put("33", "44", ttl_s=0.2)
+    m.put("10", "32", ttl_s=0.2, max_idle_s=0.1)
+    m.put("01", "92", max_idle_s=0.1)
+    assert m.size() == 3
+    time.sleep(0.5)
+    assert m.size() == 0
+
+
+def test_set_cache_ttl(client):
+    # RedissonSetCacheTest — add with TTL; expired values disappear
+    s = client.get_set_cache("setcache")
+    assert s.add("eternal") is True
+    assert s.add("brief", ttl_s=0.15) is True
+    assert s.contains("brief") is True
+    time.sleep(0.3)
+    assert s.contains("brief") is False
+    assert s.contains("eternal") is True
+    assert s.size() == 1
