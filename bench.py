@@ -18,8 +18,16 @@ latency print on stderr and ride along as extra JSON keys.
 'scatter' lowers to XLA's combining max-scatter on TPU (~9 ms per 1M-key
 batch measured by the device-loop method below — r1/r2's "30 us" was a
 block_until_ready artifact on this tunneled platform); 'sort' pre-compresses
-the batch through jnp.sort (bitonic on TPU) and lands ~2x slower. The sort
-path exists as a fallback/debugging aid (redisson_tpu/ops/hll.py).
+the batch through jnp.sort (bitonic on TPU) and lands ~2x slower; 'segment'
+is the Pallas segmented-scatter (sort + VMEM-tiled segment-max,
+redisson_tpu/ingest/kernels.py). Which path a production batch takes is
+decided per batch size by the measured cost table in
+redisson_tpu/ingest/planner.py — the ingest[auto] report below prints the
+planner's pick for this bench's batch size.
+
+`--quick` shrinks every section to smoke-test size (2^14-key batches, tiny
+roofline buffers) so the CPU run finishes in seconds — the test suite runs
+it as a tier-1 smoke (tests/test_ingest.py).
 
 Backend acquisition goes through redisson_tpu.tpu_boot: subprocess-probed
 init with retry/backoff, CPU fallback — this script must never exit non-zero
@@ -53,6 +61,7 @@ def bench_kernel(jax, dev, n, reps):
     from jax import lax
 
     from redisson_tpu import engine
+    from redisson_tpu.ingest import kernels as ingest_kernels
     from redisson_tpu.ops import hashing, hll
     from redisson_tpu.ops.u64 import U64
 
@@ -63,17 +72,22 @@ def bench_kernel(jax, dev, n, reps):
 
     @functools.partial(jax.jit, static_argnames=("impl", "iters"))
     def insert_loop(regs, packed, impl, iters):
+        p_bits = int(regs.shape[0]).bit_length() - 1
+
         def body(i, regs):
             # Perturb keys per iteration (defeats loop-invariant hoisting;
             # still n distinct keys per pass).
             p = packed.at[:, 0].set(packed[:, 0] ^ i.astype(jnp.uint32))
             h1, _ = hashing.murmur3_x64_128_u64(U64(p[:, 1], p[:, 0]), 0)
+            if impl == "segment":
+                bucket, rank = hll.bucket_rank(h1, p_bits)
+                return ingest_kernels.segmented_hll_add(regs, bucket, rank)
             return hll.add_hashes(regs, h1, impl)
         regs = lax.fori_loop(0, iters, body, regs)
         return regs, hll.count(regs)
 
     rates = {}
-    for impl in ("scatter", "sort"):
+    for impl in ("scatter", "sort", "segment"):
         iters = reps if impl == "scatter" else max(2, reps // 8)
         regs = jax.device_put(hll.make(), dev)
         _, est = insert_loop(regs, packed, impl, iters)
@@ -99,25 +113,39 @@ INGEST_CHOICE = {}
 
 
 def _report_ingest_choice(n):
-    """Print (and record for the JSON line) which ingest path the backend's
-    auto policy picks for this bench's batch size — same gates as
-    TpuBackend._use_hostfold (native lib, min-keys, link probe), so the
-    recorded path is the one the measured batches actually took."""
+    """Print (and record for the JSON line) which ingest path the planner
+    picks for this bench's batch size — the SAME inputs TpuBackend's
+    _plan_ingest feeds it (measured device-kernel cost table, 8 B/key link
+    overhead on device paths, a hostfold candidate priced from the link
+    profile), so the recorded path is the one the measured batches
+    actually took."""
     try:
         import jax
 
         from redisson_tpu import backend_tpu, native
+        from redisson_tpu.ingest.planner import default_planner
 
         dev = jax.devices()[0]
         prof = backend_tpu.link_profile(dev)
+        extra = None
+        overhead = 0.0
+        if native.available() and n >= backend_tpu.HOSTFOLD_MIN_KEYS:
+            overhead = prof.transfer_ns_per_byte * 8
+            extra = {"hostfold": prof.fold_ns_per_key
+                     + prof.transfer_ns_per_byte * 16384 / max(n, 1)}
+        plan = default_planner().plan(
+            "hll", n, extra_costs=extra, device_overhead=overhead)
         INGEST_CHOICE.update(
-            path="hostfold"
-            if backend_tpu.hostfold_policy("auto", n, dev) else "device",
+            path=plan.path,
+            costs_ns_per_key={k: round(v, 2) for k, v in plan.costs.items()},
             transfer_mb_per_s=round(1e3 / prof.transfer_ns_per_byte, 1),
             fold_mkeys_per_s=round(1e3 / prof.fold_ns_per_key, 1),
         )
+        costs = ", ".join(
+            f"{k} {v}" for k, v in INGEST_CHOICE["costs_ns_per_key"].items())
         print(
-            f"# ingest[auto] -> {INGEST_CHOICE['path']}: link "
+            f"# ingest[auto] -> {INGEST_CHOICE['path']} "
+            f"(ns/key: {costs}): link "
             f"{INGEST_CHOICE['transfer_mb_per_s']} MB/s, native fold "
             f"{INGEST_CHOICE['fold_mkeys_per_s']} M keys/s",
             file=sys.stderr,
@@ -262,7 +290,7 @@ def bench_device_ingest(jax, dev, n, reps):
         client.shutdown()
 
 
-def bench_roofline(jax, dev, n, kernel_rate):
+def bench_roofline(jax, dev, n, kernel_rate, segment_rate=0.0, quick=False):
     """Roofline for the HLL insert kernel (VERDICT r4 weak #6): relate the
     measured inserts/s to what the chip could do, so the number has a
     denominator.
@@ -290,7 +318,8 @@ def bench_roofline(jax, dev, n, kernel_rate):
     from redisson_tpu.ops import hll
 
     # -- effective HBM copy bandwidth (device loop, read+write) ------------
-    buf = jax.device_put(np.zeros(1 << 24, np.float32), dev)  # 64 MB
+    buf = jax.device_put(
+        np.zeros(1 << (20 if quick else 24), np.float32), dev)  # 4 / 64 MB
 
     @jax.jit
     def copy_loop(x, iters):
@@ -298,7 +327,7 @@ def bench_roofline(jax, dev, n, kernel_rate):
             return x + jnp.float32(1.0)  # read + write the full buffer
         return lax.fori_loop(0, iters, body, x)
 
-    iters = 32
+    iters = 4 if quick else 32
     out = copy_loop(buf, iters)
     out.block_until_ready()
     t0 = time.perf_counter()
@@ -338,28 +367,35 @@ def bench_roofline(jax, dev, n, kernel_rate):
     roofline = min(bw_bound, scatter_bound)
     bound = "scatter-issue" if scatter_bound <= bw_bound else "hbm-bandwidth"
     pct = 100.0 * kernel_rate / roofline if roofline else 0.0
+    # The segmented-scatter kernel (ingest/kernels.py) sidesteps the
+    # serialized scatter-issue bound, so its honest ceiling is the
+    # HBM-bandwidth bound alone.
+    pct_seg = 100.0 * segment_rate / bw_bound if bw_bound else 0.0
     print(
         f"# roofline: hbm {hbm_gb_s:.0f} GB/s -> {bw_bound/1e6:.0f} M/s; "
         f"bare scatter {scatter_bound/1e6:.1f} M/s; binding={bound}; "
-        f"kernel at {pct:.0f}% of roofline",
+        f"kernel at {pct:.0f}% of roofline"
+        f"; segment at {pct_seg:.0f}% of hbm bound",
         file=sys.stderr,
     )
     return {
         "roofline_inserts_per_sec": round(roofline, 1),
         "pct_of_roofline": round(pct, 1),
+        "pct_of_roofline_segment": round(pct_seg, 1),
         "roofline_bound": bound,
         "hbm_copy_gb_per_s": round(hbm_gb_s, 1),
         "scatter_issue_inserts_per_sec": round(scatter_bound, 1),
     }
 
 
-def bench_pfmerge(jax, dev):
+def bench_pfmerge(jax, dev, sketches=1000):
     """PFMERGE+count across 1K sketches (BASELINE: <50 ms)."""
     from redisson_tpu import engine
     from redisson_tpu.ops import hll
 
     stack = jax.device_put(
-        np.random.default_rng(1).integers(0, 52, size=(1000, hll.M), dtype=np.int32),
+        np.random.default_rng(1).integers(
+            0, 52, size=(sketches, hll.M), dtype=np.int32),
         dev,
     )
     merged = engine.hll_count_merged(stack)  # compile
@@ -371,12 +407,15 @@ def bench_pfmerge(jax, dev):
             merged = engine.hll_count_merged(stack)
         merged.block_until_ready()
         merge_ms = min(merge_ms, (time.perf_counter() - t0) / 10 * 1e3)
-    print(f"# pfmerge(1000 sketches)+count: {merge_ms:.2f} ms", file=sys.stderr)
+    print(f"# pfmerge({sketches} sketches)+count: {merge_ms:.2f} ms",
+          file=sys.stderr)
     return merge_ms
 
 
 def main():
     import os
+
+    quick = "--quick" in sys.argv[1:]
 
     from redisson_tpu.tpu_boot import (acquire_devices,
                                        enable_compilation_cache, probe_tpu,
@@ -398,7 +437,7 @@ def main():
     # transient tunnel outage usually heals. Rather than burn them on CPU,
     # hold here for one more budget window and re-exec this script on the
     # recovered TPU (once; RTPU_BENCH_REEXEC breaks the loop).
-    if (platform == "cpu" and not explicit_cpu
+    if (platform == "cpu" and not explicit_cpu and not quick
             and not os.environ.get("RTPU_BENCH_REEXEC")):
         print("# tpu_boot: CPU fallback engaged; late re-probe before the "
               "timed sections", file=sys.stderr)
@@ -417,8 +456,8 @@ def main():
         print("# tpu_boot: TPU still down after late budget; benching on CPU",
               file=sys.stderr)
 
-    n = 1 << 20
-    reps = 32
+    n = 1 << 14 if quick else 1 << 20
+    reps = 4 if quick else 32
     result = {
         "metric": "hll_inserts_per_sec_per_chip",
         "value": 0.0,
@@ -434,11 +473,14 @@ def main():
         kernel = bench_kernel(jax, dev, n, reps)
         result["kernel_inserts_per_sec"] = round(kernel["scatter"], 1)
         result["kernel_sort_inserts_per_sec"] = round(kernel["sort"], 1)
+        result["kernel_segment_inserts_per_sec"] = round(kernel["segment"], 1)
     except Exception as exc:  # noqa: BLE001
         print(f"# kernel bench failed: {exc!r}", file=sys.stderr)
     try:
         result.update(bench_roofline(
-            jax, dev, n, result.get("kernel_inserts_per_sec", 0.0)))
+            jax, dev, n, result.get("kernel_inserts_per_sec", 0.0),
+            segment_rate=result.get("kernel_segment_inserts_per_sec", 0.0),
+            quick=quick))
     except Exception as exc:  # noqa: BLE001
         print(f"# roofline bench failed: {exc!r}", file=sys.stderr)
     try:
@@ -459,9 +501,20 @@ def main():
     except Exception as exc:  # noqa: BLE001
         print(f"# device ingest bench failed: {exc!r}", file=sys.stderr)
     try:
-        result["pfmerge_1000_ms"] = round(bench_pfmerge(jax, dev), 3)
+        result["pfmerge_1000_ms"] = round(
+            bench_pfmerge(jax, dev, 32 if quick else 1000), 3)
     except Exception as exc:  # noqa: BLE001
         print(f"# pfmerge bench failed: {exc!r}", file=sys.stderr)
+    try:
+        from redisson_tpu.ingest.planner import default_planner
+
+        table = default_planner().table()
+        if table:
+            result["ingest_cost_table_ns_per_key"] = {
+                k: {p: round(v, 2) for p, v in costs.items()}
+                for k, costs in table.items()}
+    except Exception as exc:  # noqa: BLE001
+        print(f"# planner table dump failed: {exc!r}", file=sys.stderr)
     # HEADLINE = the chip: device-resident client-path ingest (VERDICT r3
     # weak #2 — the hostfold rate conflates host silicon with the TPU; it
     # stays reported as the link-starved adaptive path). Fallbacks keep a

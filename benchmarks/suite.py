@@ -44,6 +44,10 @@ if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
 
 _TINY = bool(os.environ.get("RTPU_BENCH_TINY"))
 
+# Ingest path for the sketch backends ("auto" = the measured planner,
+# redisson_tpu/ingest/planner.py); set once from --ingest in main().
+_INGEST = "auto"
+
 
 def _scale(n: int) -> int:
     """CI smoke scale: RTPU_BENCH_TINY=1 shrinks every size 100x."""
@@ -63,7 +67,7 @@ def _mkclient(mode: str):
         c = RedissonTPU.create(cfg)
         c._embedded = er  # keep alive; closed with the client
         return c
-    cfg.use_tpu()
+    cfg.use_tpu().ingest = _INGEST
     return RedissonTPU.create(cfg)
 
 
@@ -339,6 +343,7 @@ def config4(full: bool):
     cfg = Config()
     pod = cfg.use_pod()
     pod.bank_capacity = n_sketches
+    pod.ingest = _INGEST
     c = RedissonTPU.create(cfg)
     try:
         backend = c._backend.sketch
@@ -521,6 +526,7 @@ def config5(full: bool):
     cfg = Config()
     pod = cfg.use_pod()
     pod.bank_capacity = n_sketches
+    pod.ingest = _INGEST
     c = RedissonTPU.create(cfg)
     try:
         rng = np.random.default_rng(5)
@@ -578,7 +584,14 @@ def main():
                     help="BASELINE-paper sizes (slow)")
     ap.add_argument("--publish", action="store_true",
                     help="write results into BASELINE.json['published']")
+    ap.add_argument("--ingest", default="auto",
+                    choices=("auto", "device", "hostfold",
+                             "scatter", "sort", "segment"),
+                    help="sketch ingest path (auto = measured planner)")
     args = ap.parse_args()
+
+    global _INGEST
+    _INGEST = args.ingest
 
     which = sorted(CONFIGS) if args.all else [args.config or 1]
     results = {}
@@ -642,9 +655,20 @@ def _publish(results, failures, full: bool):
     doc["published"]["_meta"] = {
         "full_scale": full,
         "written_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "ingest": _INGEST,
         **_provenance_meta(),
         **({"failed_configs": failures} if failures else {}),
     }
+    try:
+        from redisson_tpu.ingest.planner import default_planner
+
+        table = default_planner().table()
+        if table:
+            doc["published"]["_meta"]["ingest_cost_table_ns_per_key"] = {
+                k: {p: round(v, 2) for p, v in costs.items()}
+                for k, costs in table.items()}
+    except Exception as exc:  # noqa: BLE001 — table dump must not block publish
+        print(f"# planner table dump failed: {exc!r}", file=sys.stderr)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=2)

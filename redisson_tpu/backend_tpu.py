@@ -20,6 +20,8 @@ import numpy as np
 
 from redisson_tpu import engine
 from redisson_tpu.executor import Op
+from redisson_tpu.ingest.pipeline import StagingPipeline
+from redisson_tpu.ingest.planner import IngestPlanner, default_planner
 from redisson_tpu.ops import bitset as bitset_ops, bloom as bloom_ops
 from redisson_tpu.store import ObjectType, SketchStore, WrongTypeError
 
@@ -333,6 +335,12 @@ class TpuBackend:
 
     GLOBAL_COALESCE = frozenset({"hll_add"})
 
+    #: accepted `ingest` config values — "auto" plans per batch; "device"
+    #: forces the device path with the configured hll_impl; the kernel
+    #: names force that device insert; "hostfold" forces the native fold.
+    INGEST_CHOICES = ("auto", "device", "hostfold", "scatter", "sort",
+                      "segment")
+
     def __init__(
         self,
         store: SketchStore,
@@ -341,8 +349,9 @@ class TpuBackend:
         ingest: str = "auto",
         bank_capacity: int = 256,
         hll_hash: str = "murmur3",
+        planner: Optional[IngestPlanner] = None,
     ):
-        if ingest not in ("auto", "device", "hostfold"):
+        if ingest not in self.INGEST_CHOICES:
             raise ValueError(f"unknown ingest policy: {ingest!r}")
         if hll_hash not in ("murmur3", "redis"):
             raise ValueError(f"unknown hll_hash family: {hll_hash!r}")
@@ -372,6 +381,10 @@ class TpuBackend:
         self.hll_impl = hll_impl
         self.seed = seed
         self.ingest = ingest
+        self.planner = planner or default_planner()
+        # Host staging (pad + device_put) of chunk N+1 overlaps device
+        # dispatch of chunk N for multi-chunk runs (ingest/pipeline).
+        self._pipeline = StagingPipeline(depth=2)
         self.completer = Completer()
         # HLL bank: lazy [S, m] int32 device array + shared row bookkeeping.
         self.bank = None
@@ -402,12 +415,47 @@ class TpuBackend:
         self.bank = engine.hll_bank_grow(self._ensure_bank(), new_cap)
         return new_cap
 
-    def _use_hostfold(self, nkeys: int) -> bool:
-        if self.family == "redis":
-            # The native fold kernel implements the murmur3 family only;
-            # 'auto' must never route redis-family inserts through it.
-            return False
-        return hostfold_policy(self.ingest, nkeys, self.store.device)
+    def _plan_ingest(self, nkeys: int) -> str:
+        """Resolve one run's HLL insert path: 'hostfold' or a device
+        insert impl ('scatter' | 'sort' | 'segment').
+
+        Forced config values short-circuit; 'auto' asks the planner,
+        whose measured device-kernel costs are offset by the link's
+        8 B/key transfer cost and compared against a hostfold candidate
+        priced from the same LinkProfile (native fold ns/key + the
+        amortized 16 KB sketch upload) — the old hostfold_policy gates
+        (native lib present, murmur3 family, batch big enough to
+        amortize per-run costs) decide whether hostfold competes at
+        all."""
+        if self.ingest == "hostfold":
+            return "hostfold"
+        if self.ingest in ("scatter", "sort", "segment"):
+            return self.ingest
+        if self.ingest == "device":
+            return self.hll_impl
+        from redisson_tpu import native as native_mod
+
+        extra = None
+        overhead = 0.0
+        if (self.family != "redis" and native_mod.available()
+                and nkeys >= HOSTFOLD_MIN_KEYS):
+            prof = link_profile(self.store.device)
+            overhead = prof.transfer_ns_per_byte * 8
+            extra = {"hostfold": prof.fold_ns_per_key
+                     + prof.transfer_ns_per_byte * 16384 / max(nkeys, 1)}
+        return self.planner.plan(
+            "hll", nkeys, extra_costs=extra, device_overhead=overhead).path
+
+    def _plan_bits(self, nkeys: int) -> str:
+        """Set-bits strategy for bloom/bitset device inserts ('scatter' |
+        'segment'). Forced 'segment' carries over from the config knob;
+        every other forced mode keeps the classic scatter (hostfold for
+        blooms is decided separately by the host-mirror policy)."""
+        if self.ingest == "segment":
+            return "segment"
+        if self.ingest != "auto":
+            return "scatter"
+        return self.planner.plan("bits", nkeys).path
 
     # -- dispatch -----------------------------------------------------------
 
@@ -510,13 +558,14 @@ class TpuBackend:
         device_ops = [op for op in ops if "device_packed" in op.payload]
         host_ops = packed_ops + int_ops + byte_ops
         if host_ops:
-            if self._use_hostfold(sum(op.nkeys or self._payload_nkeys(op)
-                                      for op in host_ops)):
+            path = self._plan_ingest(sum(op.nkeys or self._payload_nkeys(op)
+                                         for op in host_ops))
+            if path == "hostfold":
                 self._hll_add_hostfold(host_ops)
             else:
                 for group in (packed_ops, int_ops, byte_ops):
                     if group:
-                        self._hll_add_group(group)
+                        self._hll_add_group(group, path)
         if device_ops:
             self._hll_add_device(device_ops)
         leftover = [
@@ -612,32 +661,53 @@ class TpuBackend:
             return np.int32(self._rows[next(iter(targets))])
         return None
 
-    def _hll_add_group(self, ops: List[Op]) -> None:
+    def _hll_add_group(self, ops: List[Op], impl: str = "scatter") -> None:
         # Kernels are only *dispatched* here; the `changed` device scalars
         # resolve on the completer thread so the dispatcher is never
         # device-bound. Single-target runs use the scalar-row kernels (no
         # per-key row vector ships over the link); multi-target coalesced
         # runs carry a row vector — one SPMD-style call for many sketches.
+        # `impl` is the planner's device insert choice for this run; it
+        # reaches the scalar-row kernels (the multi-target row-vector
+        # kernels stay on the flat scatter, see engine._bank_add_row).
         parts = []
         if "packed" in ops[0].payload:
             # Concatenating copies 8 B/key on the dispatcher, so a LARGE
             # op's buffer ships to the device as-is through the scalar-row
             # kernel (zero host copies end-to-end, no 4 B/key row vector);
             # only small ops gather into shared buckets with a row vector.
+            # Large multi-chunk runs go through the staging pipeline: a
+            # worker thread pads + device_puts chunk N+1 while this thread
+            # dispatches chunk N (the bank carry keeps dispatch serial).
             small: List[Op] = []
+            chunks = []
             for op in ops:
                 arr = op.payload["packed"]
                 if arr.shape[0] < engine.MIN_BUCKET:
                     small.append(op)
                     continue
                 row = self._rows[op.target]
-                for s, e in engine.chunk_spans(arr.shape[0]):
-                    prows, count = engine.pad_rows(arr[s:e])
+                chunks.extend(
+                    (row, arr[s:e])
+                    for s, e in engine.chunk_spans(arr.shape[0]))
+            if chunks:
+                import jax
+
+                def stage(item):
+                    row, chunk = item
+                    prows, count = engine.pad_rows(chunk)
+                    return (row, jax.device_put(prows, self.store.device),
+                            np.int32(count))
+
+                def dispatch(_i, staged):
+                    row, prows, count = staged
                     self.bank, changed = engine.hll_bank_add_packed(
-                        self._ensure_bank(), prows, np.int32(count),
-                        np.int32(row), self.seed, self.family
+                        self._ensure_bank(), prows, count, np.int32(row),
+                        self.seed, self.family, impl
                     )
-                    parts.append(changed)
+                    return changed
+
+                parts.extend(self._pipeline.run(chunks, stage, dispatch))
             if small:
                 packed = np.concatenate(
                     [op.payload["packed"] for op in small])
@@ -664,7 +734,7 @@ class TpuBackend:
                 if one is not None:  # scalar row: no 4 B/key row transfer
                     self.bank, changed = engine.hll_bank_add_u64(
                         self._ensure_bank(), phi, plo, valid, one, self.seed,
-                        self.family
+                        self.family, impl
                     )
                 else:
                     prow, _ = engine.pad_ints(rowv[s:e])
@@ -686,7 +756,7 @@ class TpuBackend:
                 if one is not None:
                     self.bank, changed = engine.hll_bank_add_bytes(
                         self._ensure_bank(), pdata, plengths, valid, one,
-                        self.seed, self.family
+                        self.seed, self.family, impl
                     )
                 else:
                     prow, _ = engine.pad_ints(rowv[s:e])
@@ -945,8 +1015,11 @@ class TpuBackend:
             for op in ops:
                 op.future.set_result(0)
             return
-        v = engine.bitset_cardinality(obj.state)
-        self.completer.submit(_complete_all(ops, lambda: int(v)))
+        # Partials go D2H async; the 64-bit-exact combine happens at
+        # completion (an int32 total wraps negative past 2^31 set bits).
+        v = _start_d2h(engine.bitset_cardinality_partials(obj.state))
+        self.completer.submit(_complete_all(
+            ops, lambda: bitset_ops.combine_partials(v)))
 
     def _op_bitset_length(self, target: str, ops: List[Op]) -> None:
         self._check_not_hll(target, ObjectType.BITSET)
@@ -1206,6 +1279,14 @@ class TpuBackend:
         obj, m, k = self._bloom_meta(target)
         add_packed, contains_packed, add_bytes, contains_bytes = (
             self._bloom_kernels(obj))
+        if mutate and not obj.meta.get("blocked"):
+            # Classic-layout adds take the planner's set-bits strategy
+            # (scatter vs the ingest subsystem's segment-or); the blocked
+            # layout's cache-local scatter stays as-is.
+            impl = self._plan_bits(
+                sum(op.nkeys or self._payload_nkeys(op) for op in ops))
+            add_packed = functools.partial(add_packed, impl=impl)
+            add_bytes = functools.partial(add_bytes, impl=impl)
         outs, spans = [], []
 
         def emit(res, n):

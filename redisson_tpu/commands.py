@@ -114,6 +114,7 @@ OP_TABLE = {d.kind: d for d in [
     _d("lrem_index", "LUA", True, _ALL),
     _d("linsert", "LINSERT", True, _ALL),
     _d("linsert_at", "LUA", True, _ALL),
+    _d("lsplice", "LUA", True, _ALL),
     _d("lretain", "LUA", True, _ALL),
     _d("ltrim", "LTRIM", True, _ALL),
     _d("lpop", "LPOP", True, _ALL),

@@ -41,9 +41,12 @@ class TpuConfig:
     # hllPatLen) — choose "redis" when flushed sketches must stay
     # server-mergeable under later server-side PFADDs (mixed writers).
     hll_hash: str = "murmur3"
-    # HLL key ingest: "device" ships raw keys (8 B/key) and hashes on-chip;
-    # "hostfold" folds into a 16 KB sketch natively and ships that; "auto"
-    # probes the link once and picks (backend_tpu.LinkProfile).
+    # Ingest path. "auto" lets the planner (redisson_tpu.ingest.planner)
+    # pick per batch from a measured-at-first-use cost table; the rest
+    # force one path: "device" ships raw keys (8 B/key) and inserts with
+    # the configured hll_impl; "scatter" / "sort" / "segment" force that
+    # device insert kernel (segment = the Pallas segmented-scatter);
+    # "hostfold" folds into a 16 KB sketch natively and ships that.
     ingest: str = "auto"
     hash_seed: int = 0
     # Coalescing cap for one dispatcher run. Device kernels still chunk at

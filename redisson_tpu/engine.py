@@ -165,13 +165,25 @@ def _hll_add(regs, h1, valid, impl):
     p = regs.shape[0].bit_length() - 1
     bucket, rank = hll.bucket_rank(h1, p)
     rank = jnp.where(valid, rank, 0)
-    if impl == "scatter":
-        new = hll.insert_scatter(regs, bucket, rank)
-    else:
-        new = hll.insert_sorted(regs, jnp.where(valid, bucket, 0), rank)
+    new = _insert_impl(regs, bucket, rank, valid, impl)
     # changed: vs pre-batch state; regs is donated so compute before return.
     changed = jnp.any(new != regs)
     return new, changed
+
+
+def _insert_impl(regs, bucket, rank, valid, impl):
+    """One register-array insert, by strategy: 'scatter' (XLA combining
+    scatter), 'sort' (sort-compress + small scatter), 'segment' (the
+    ingest subsystem's Pallas segmented-scatter on TPU, its XLA
+    sort-compress fallback elsewhere). Padded lanes carry rank 0."""
+    if impl == "scatter":
+        return hll.insert_scatter(regs, bucket, rank)
+    bucket = jnp.where(valid, bucket, 0)
+    if impl == "segment":
+        from redisson_tpu.ingest import kernels as ingest_kernels
+
+        return ingest_kernels.segmented_hll_add(regs, bucket, rank)
+    return hll.insert_sorted(regs, bucket, rank)
 
 
 @jax.jit
@@ -247,18 +259,21 @@ def _bank_add(bank, h1, rows, valid):
     return new, changed_rows
 
 
-def _bank_add_row(bank, h1, row, valid):
-    """Single-target insert (scalar `row`): slice the row out, scatter-max
-    into the 16K row (the flat single-sketch kernel's cost profile), write
+def _bank_add_row(bank, h1, row, valid, impl: str = "scatter"):
+    """Single-target insert (scalar `row`): slice the row out, insert into
+    the 16K row (the flat single-sketch kernel's cost profile), write
     it back with a dynamic update — ~2.7x the throughput of routing a
     scalar row through the multi-target path (91M vs 34M inserts/s/chip
-    measured at 1M-key batches, S=256)."""
+    measured at 1M-key batches, S=256). `impl` picks the row insert
+    (see _insert_impl); the multi-target _bank_add stays on the flat
+    scatter — its row*m+bucket codes would overflow the segmented
+    kernel's int32 code space for large banks."""
     s, m = bank.shape
     p = m.bit_length() - 1
     bucket, rank = hll.bucket_rank(h1, p)
     rank = jnp.where(valid, rank, 0)
     old_row = jax.lax.dynamic_index_in_dim(bank, row, keepdims=False)
-    new_row = old_row.at[bucket].max(rank)
+    new_row = _insert_impl(old_row, bucket, rank, valid, impl)
     new = jax.lax.dynamic_update_index_in_dim(bank, new_row, row, axis=0)
     changed_rows = jnp.zeros((s,), bool).at[row].set(
         jnp.any(new_row != old_row))
@@ -266,15 +281,15 @@ def _bank_add_row(bank, h1, row, valid):
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family", "impl"))
 def hll_bank_add_packed(bank, packed, count, row, seed: int = 0,
-                        family: str = "m3"):
+                        family: str = "m3", impl: str = "scatter"):
     """Single-target PFADD into bank row `row` (a traced scalar — no per-key
     row vector ships over the link, preserving the 8 B/key transfer profile
     of the flat hll_add_packed path)."""
     valid = jnp.arange(packed.shape[0], dtype=jnp.int32) < count
     h1 = _hll_h1_u64(U64(packed[:, 1], packed[:, 0]), seed, family)
-    return _bank_add_row(bank, h1, row, valid)
+    return _bank_add_row(bank, h1, row, valid, impl)
 
 
 @functools.partial(
@@ -296,13 +311,13 @@ def hll_bank_add_u64_rows(bank, hi, lo, rows, valid, seed: int = 0,
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family", "impl"))
 def hll_bank_add_u64(bank, hi, lo, valid, row, seed: int = 0,
-                     family: str = "m3"):
+                     family: str = "m3", impl: str = "scatter"):
     """Single-target u64 PFADD (scalar row broadcast on device — no
     4 B/key row vector crosses the link)."""
     h1 = _hll_h1_u64(U64(hi, lo), seed, family)
-    return _bank_add_row(bank, h1, row, valid)
+    return _bank_add_row(bank, h1, row, valid, impl)
 
 
 @functools.partial(
@@ -314,12 +329,12 @@ def hll_bank_add_bytes_rows(bank, data, lengths, rows, valid, seed: int = 0,
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family"))
+    jax.jit, donate_argnums=(0,), static_argnames=("seed", "family", "impl"))
 def hll_bank_add_bytes(bank, data, lengths, valid, row, seed: int = 0,
-                       family: str = "m3"):
+                       family: str = "m3", impl: str = "scatter"):
     """Single-target byte-key PFADD (scalar row, see hll_bank_add_u64)."""
     h1 = _hll_h1_bytes(data, lengths, seed, family)
-    return _bank_add_row(bank, h1, row, valid)
+    return _bank_add_row(bank, h1, row, valid, impl)
 
 
 @jax.jit
@@ -460,10 +475,18 @@ def bitset_get(bits, idx, valid):
 
 
 @jax.jit
-def bitset_cardinality(bits):
+def bitset_cardinality_partials(bits):
+    """Device half of BITCOUNT: overflow-proof int32 partials (pallas
+    per-block partials on TPU, chunked XLA sums elsewhere)."""
     if pk.use_pallas():
-        return pk.popcount_cells(bits)
-    return bitset.cardinality(bits)
+        return pk.popcount_partials(bits)
+    return bitset.cardinality_partials(bits)
+
+
+def bitset_cardinality(bits) -> int:
+    """BITCOUNT, exact past 2^31 set bits: partials combine host-side
+    in python ints (int32 totals wrap negative there)."""
+    return bitset.combine_partials(bitset_cardinality_partials(bits))
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
@@ -497,14 +520,24 @@ def bitset_not_masked(bits, n):
 # ---------------------------------------------------------------------------
 
 
-def _bloom_add(bits, h1, h2, valid, k: int, m: int):
-    """Shared add core: k-index double hashing -> masked scatter-max ->
-    (new_bits, added_mask). Padded lanes write index 0 with value 0."""
+def _bloom_add(bits, h1, h2, valid, k: int, m: int, impl: str = "scatter"):
+    """Shared add core: k-index double hashing -> masked set ->
+    (new_bits, added_mask). Padded lanes write index 0 with value 0.
+    `impl='segment'` routes the set through the ingest subsystem's
+    segment-or (invalid lanes map to the one-past-end cell, which both
+    the kernel and its lax fallback drop)."""
     idx = bloom.indexes(h1, h2, k, m)
     idx = jnp.where(valid[:, None], idx, 0)
     old = bits[idx.reshape(-1)].reshape(idx.shape)
     vals = jnp.broadcast_to(valid[:, None], idx.shape)
-    new = bits.at[idx.reshape(-1)].max(vals.astype(jnp.uint8).reshape(-1))
+    if impl == "segment":
+        from redisson_tpu.ingest import kernels as ingest_kernels
+
+        flat = jnp.where(vals, idx, bits.shape[0]).reshape(-1)
+        new = ingest_kernels.segmented_bits_set(bits, flat)
+    else:
+        new = bits.at[idx.reshape(-1)].max(
+            vals.astype(jnp.uint8).reshape(-1))
     added = jnp.any(old == 0, axis=-1) & valid
     return new, added
 
@@ -524,12 +557,13 @@ def _packed_hashes(packed, count, seed):
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed", "impl")
 )
-def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0):
+def bloom_add_bytes(bits, data, lengths, valid, k: int, m: int, seed: int = 0,
+                    impl: str = "scatter"):
     """Bloom add of a padded byte-key batch -> (new_bits, added_mask)."""
     h1, h2 = hashing.murmur3_x64_128(data, lengths, seed)
-    return _bloom_add(bits, h1, h2, valid, k, m)
+    return _bloom_add(bits, h1, h2, valid, k, m, impl)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "seed"))
@@ -539,12 +573,13 @@ def bloom_contains_bytes(bits, data, lengths, valid, k: int, m: int, seed: int =
 
 
 @functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed")
+    jax.jit, donate_argnums=(0,), static_argnames=("k", "m", "seed", "impl")
 )
-def bloom_add_packed(bits, packed, count, k: int, m: int, seed: int = 0):
+def bloom_add_packed(bits, packed, count, k: int, m: int, seed: int = 0,
+                     impl: str = "scatter"):
     """Bloom add of uint64 keys in the zero-copy packed layout."""
     h1, h2, valid = _packed_hashes(packed, count, seed)
-    return _bloom_add(bits, h1, h2, valid, k, m)
+    return _bloom_add(bits, h1, h2, valid, k, m, impl)
 
 
 @functools.partial(jax.jit, static_argnames=("k", "m", "seed"))

@@ -1053,6 +1053,30 @@ class RedisBackend(RedisBloomMixin):
             return
         op.future.set_result(True)
 
+    def _op_lsplice(self, key: str, op: Op) -> None:
+        """addAll(index, values) in ONE Lua step (mirrors lretain): the
+        whole splice is atomic server-side and the TTL survives the
+        rebuild, unlike a client-side loop of linsert_at calls."""
+        p = op.payload
+        res = self._eval(
+            "local idx = tonumber(ARGV[1]) "
+            "local n = redis.call('llen', KEYS[1]) "
+            "if idx > n then return -1 end "
+            "local ttl = redis.call('pttl', KEYS[1]) "
+            "local tail = redis.call('lrange', KEYS[1], idx, -1) "
+            "if idx == 0 then redis.call('del', KEYS[1]) "
+            "else redis.call('ltrim', KEYS[1], 0, idx - 1) end "
+            "for i = 2, #ARGV do redis.call('rpush', KEYS[1], ARGV[i]) end "
+            "for i = 1, #tail do redis.call('rpush', KEYS[1], tail[i]) end "
+            "if ttl > 0 then redis.call('pexpire', KEYS[1], ttl) end "
+            "return 1",
+            [key], [p["index"], *p["values"]])
+        if res == -1:
+            op.future.set_exception(
+                IndexError(f"insert index {p['index']} beyond list size"))
+            return
+        op.future.set_result(True)
+
     def _op_lrem_index(self, key: str, op: Op) -> None:
         # The reference's removeAsync(index) trick: LSET to a sentinel, then
         # LREM the sentinel (RedissonList.java).

@@ -778,87 +778,152 @@ class RedisPatternTopic:
 
 class RedisMapCache:
     """Map with per-entry TTL over Redis: hash ``name`` + companion timeout
-    zset ``redisson__timeout__set__{name}`` scored by the expiry deadline —
+    zset ``redisson__timeout__set__{name}`` scored by the expiry deadline,
+    plus an idle zset ``redisson__idle__set__{name}`` scored by the idle
+    deadline with the idle durations in ``redisson__idle__ms__{name}`` —
     the reference's RMapCache design (`RedissonMapCache.java:75-87` custom
-    EVAL commands; sweeping analogue of `EvictionScheduler.java:47-115`).
+    EVAL commands; read-side idle refresh per
+    `RedissonMapCache.java:501,538-567`; sweeping analogue of
+    `EvictionScheduler.java:47-115`).
+
+    Every script takes KEYS = [hash, timeout zset, idle zset, idle-ms
+    hash]; an entry is dead when EITHER deadline has passed. Reads refresh
+    the idle deadline (that is what distinguishes maxIdle from ttl).
 
     Expired entries are dropped lazily on access and in bulk by
     :meth:`evict_expired` (call it from a scheduler for parity with the
     reference's client-driven sweeper).
     """
 
+    # The mini-Lua EVAL subset (interop/mini_lua.py) has no function
+    # definitions, so the shared is-dead check is spliced inline: it
+    # binds `dead` for `key` at time `now`.
+    _DEAD = """
+    local tscore = redis.call('zscore', KEYS[2], key)
+    local iscore = redis.call('zscore', KEYS[3], key)
+    local dead = ((tscore ~= false and tonumber(tscore) <= now) or
+                  (iscore ~= false and tonumber(iscore) <= now))
+    """
+
     PUT = """
+    local now = tonumber(ARGV[4])
+    local key = ARGV[1]
+    """ + _DEAD + """
     local old = redis.call('hget', KEYS[1], ARGV[1])
-    if (old ~= false) then
-        local score = redis.call('zscore', KEYS[2], ARGV[1])
-        if (score ~= false and tonumber(score) <= tonumber(ARGV[4])) then
-            old = false
-        end
+    if (old ~= false and dead) then
+        old = false
     end
     redis.call('hset', KEYS[1], ARGV[1], ARGV[2])
     if (tonumber(ARGV[3]) > 0) then
-        redis.call('zadd', KEYS[2], tonumber(ARGV[4]) + tonumber(ARGV[3]), ARGV[1])
+        redis.call('zadd', KEYS[2], now + tonumber(ARGV[3]), ARGV[1])
     else
         redis.call('zrem', KEYS[2], ARGV[1])
+    end
+    if (tonumber(ARGV[5]) > 0) then
+        redis.call('zadd', KEYS[3], now + tonumber(ARGV[5]), ARGV[1])
+        redis.call('hset', KEYS[4], ARGV[1], ARGV[5])
+    else
+        redis.call('zrem', KEYS[3], ARGV[1])
+        redis.call('hdel', KEYS[4], ARGV[1])
     end
     return old
     """
 
     PUT_IF_ABSENT = """
-    local score = redis.call('zscore', KEYS[2], ARGV[1])
-    local expired = (score ~= false and tonumber(score) <= tonumber(ARGV[4]))
+    local now = tonumber(ARGV[4])
+    local key = ARGV[1]
+    """ + _DEAD + """
     local old = redis.call('hget', KEYS[1], ARGV[1])
-    if (old ~= false and not expired) then
+    if (old ~= false and not dead) then
         return old
     end
     redis.call('hset', KEYS[1], ARGV[1], ARGV[2])
     if (tonumber(ARGV[3]) > 0) then
-        redis.call('zadd', KEYS[2], tonumber(ARGV[4]) + tonumber(ARGV[3]), ARGV[1])
+        redis.call('zadd', KEYS[2], now + tonumber(ARGV[3]), ARGV[1])
     else
         redis.call('zrem', KEYS[2], ARGV[1])
+    end
+    if (tonumber(ARGV[5]) > 0) then
+        redis.call('zadd', KEYS[3], now + tonumber(ARGV[5]), ARGV[1])
+        redis.call('hset', KEYS[4], ARGV[1], ARGV[5])
+    else
+        redis.call('zrem', KEYS[3], ARGV[1])
+        redis.call('hdel', KEYS[4], ARGV[1])
     end
     return nil
     """
 
     GET = """
-    local score = redis.call('zscore', KEYS[2], ARGV[1])
-    if (score ~= false and tonumber(score) <= tonumber(ARGV[2])) then
-        redis.call('hdel', KEYS[1], ARGV[1])
-        redis.call('zrem', KEYS[2], ARGV[1])
+    local now = tonumber(ARGV[2])
+    local key = ARGV[1]
+    """ + _DEAD + """
+    if (dead) then
+        redis.call('hdel', KEYS[1], key)
+        redis.call('zrem', KEYS[2], key)
+        redis.call('zrem', KEYS[3], key)
+        redis.call('hdel', KEYS[4], key)
         return nil
     end
-    return redis.call('hget', KEYS[1], ARGV[1])
+    local idle = redis.call('hget', KEYS[4], key)
+    if (idle ~= false) then
+        redis.call('zadd', KEYS[3], now + tonumber(idle), key)
+    end
+    return redis.call('hget', KEYS[1], key)
     """
 
     REMOVE = """
-    redis.call('zrem', KEYS[2], ARGV[1])
     local old = redis.call('hget', KEYS[1], ARGV[1])
     redis.call('hdel', KEYS[1], ARGV[1])
+    redis.call('zrem', KEYS[2], ARGV[1])
+    redis.call('zrem', KEYS[3], ARGV[1])
+    redis.call('hdel', KEYS[4], ARGV[1])
     return old
     """
 
     EVICT = """
-    local expired = redis.call('zrangebyscore', KEYS[2], '-inf', ARGV[1],
-                               'LIMIT', 0, ARGV[2])
+    local now = tonumber(ARGV[1])
     local n = 0
-    for i, key in ipairs(expired) do
-        redis.call('hdel', KEYS[1], key)
-        redis.call('zrem', KEYS[2], key)
-        n = n + 1
+    for z = 2, 3 do
+        local expired = redis.call('zrangebyscore', KEYS[z], '-inf', now,
+                                   'LIMIT', 0, ARGV[2])
+        for i, key in ipairs(expired) do
+            if (redis.call('hdel', KEYS[1], key) == 1) then
+                n = n + 1
+            end
+            redis.call('zrem', KEYS[2], key)
+            redis.call('zrem', KEYS[3], key)
+            redis.call('hdel', KEYS[4], key)
+        end
     end
     return n
     """
 
     SIZE = """
-    local total = redis.call('hlen', KEYS[1])
-    local expired = redis.call('zrangebyscore', KEYS[2], '-inf', ARGV[1])
-    local dead = 0
-    for i, key in ipairs(expired) do
-        if (redis.call('hexists', KEYS[1], key) == 1) then
-            dead = dead + 1
+    local now = tonumber(ARGV[1])
+    local fields = redis.call('hkeys', KEYS[1])
+    local live = 0
+    for i, key in ipairs(fields) do
+    """ + _DEAD + """
+        if (not dead) then
+            live = live + 1
         end
     end
-    return total - dead
+    return live
+    """
+
+    READ_ALL = """
+    local now = tonumber(ARGV[1])
+    local flat = redis.call('hgetall', KEYS[1])
+    local out = {}
+    for i = 1, #flat, 2 do
+        local key = flat[i]
+    """ + _DEAD + """
+        if (not dead) then
+            out[#out + 1] = flat[i]
+            out[#out + 1] = flat[i + 1]
+        end
+    end
+    return out
     """
 
     def __init__(self, name: str, scripts: ScriptRunner, codec):
@@ -870,60 +935,82 @@ class RedisMapCache:
     def timeout_set_name(self) -> str:
         return "redisson__timeout__set__{%s}" % self.name
 
+    @property
+    def idle_set_name(self) -> str:
+        return "redisson__idle__set__{%s}" % self.name
+
+    @property
+    def idle_ms_name(self) -> str:
+        return "redisson__idle__ms__{%s}" % self.name
+
+    @property
+    def _keys(self) -> list:
+        return [self.name, self.timeout_set_name,
+                self.idle_set_name, self.idle_ms_name]
+
     def _k(self, key) -> bytes:
         return self._codec.encode(key)
 
     def put(self, key, value, ttl_s: float = 0, max_idle_s: float = 0):
-        """Returns the previous live value or None. max_idle is folded into
-        ttl (min of the two) — a documented simplification of the
-        reference's separate idle zset."""
-        ttl_ms = int(ttl_s * 1000) if ttl_s else 0
-        if max_idle_s:
-            idle_ms = int(max_idle_s * 1000)
-            ttl_ms = min(ttl_ms, idle_ms) if ttl_ms else idle_ms
+        """Returns the previous live value or None. ttl and max_idle are
+        independent deadlines (separate zsets); reads refresh only the
+        idle one."""
         old = self._scripts.run(
-            self.PUT, [self.name, self.timeout_set_name],
-            [self._k(key), self._codec.encode(value), ttl_ms, _now_ms()])
+            self.PUT, self._keys,
+            [self._k(key), self._codec.encode(value),
+             int(ttl_s * 1000) if ttl_s else 0, _now_ms(),
+             int(max_idle_s * 1000) if max_idle_s else 0])
         return None if old is None else self._codec.decode(old)
 
     def put_if_absent(self, key, value, ttl_s: float = 0, max_idle_s: float = 0):
-        ttl_ms = int(ttl_s * 1000) if ttl_s else 0
-        if max_idle_s:
-            idle_ms = int(max_idle_s * 1000)
-            ttl_ms = min(ttl_ms, idle_ms) if ttl_ms else idle_ms
         old = self._scripts.run(
-            self.PUT_IF_ABSENT, [self.name, self.timeout_set_name],
-            [self._k(key), self._codec.encode(value), ttl_ms, _now_ms()])
+            self.PUT_IF_ABSENT, self._keys,
+            [self._k(key), self._codec.encode(value),
+             int(ttl_s * 1000) if ttl_s else 0, _now_ms(),
+             int(max_idle_s * 1000) if max_idle_s else 0])
         return None if old is None else self._codec.decode(old)
+
+    def fast_put(self, key, value, ttl_s: float = 0, max_idle_s: float = 0) -> bool:
+        """Reference fastPut: True iff the key was newly inserted (an
+        expired entry counts as absent), False on overwrite."""
+        return self.put(key, value, ttl_s, max_idle_s) is None
 
     def get(self, key):
         raw = self._scripts.run(
-            self.GET, [self.name, self.timeout_set_name],
-            [self._k(key), _now_ms()])
+            self.GET, self._keys, [self._k(key), _now_ms()])
         return None if raw is None else self._codec.decode(raw)
 
     def remove(self, key):
         old = self._scripts.run(
-            self.REMOVE, [self.name, self.timeout_set_name], [self._k(key)])
+            self.REMOVE, self._keys, [self._k(key)])
         return None if old is None else self._codec.decode(old)
 
     def contains_key(self, key) -> bool:
         return self.get(key) is not None
 
     def size(self) -> int:
-        return int(self._scripts.run(
-            self.SIZE, [self.name, self.timeout_set_name], [_now_ms()]))
+        return int(self._scripts.run(self.SIZE, self._keys, [_now_ms()]))
+
+    def read_all_map(self) -> dict:
+        """Reference readAllMap: every live entry, expired ones skipped
+        (without touching their idle clocks)."""
+        flat = self._scripts.run(self.READ_ALL, self._keys, [_now_ms()])
+        it = iter(flat or [])
+        return {
+            self._codec.decode(k): self._codec.decode(v)
+            for k, v in zip(it, it)
+        }
 
     def evict_expired(self, limit: int = 300) -> int:
         """One sweeper pass, <=limit entries (EvictionScheduler's batch cap,
         `EvictionScheduler.java:47-115`)."""
         return int(self._scripts.run(
-            self.EVICT, [self.name, self.timeout_set_name],
-            [_now_ms(), limit]))
+            self.EVICT, self._keys, [_now_ms(), limit]))
 
     def delete(self) -> bool:
         n = self._scripts.resp.execute(
-            "DEL", self.name, self.timeout_set_name)
+            "DEL", self.name, self.timeout_set_name,
+            self.idle_set_name, self.idle_ms_name)
         return bool(n)
 
     def clear(self) -> None:

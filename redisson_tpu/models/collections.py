@@ -261,20 +261,16 @@ class RList(RExpirable):
             self.name, "lretain", {"members": [self._e(x) for x in values]})
 
     def add_all_at(self, index: int, values: Iterable[Any]) -> bool:
-        """Reference addAll(index, values): splice at `index`; errors when
-        index exceeds the current size (RedissonListTest.java:715-719
-        expects an error on an empty list at index 2)."""
+        """Reference addAll(index, values): one atomic splice at `index`
+        (lsplice, mirroring lretain — the old linsert_at loop let other
+        writers interleave mid-splice); errors when index exceeds the
+        current size (RedissonListTest.java:715-719 expects an error on
+        an empty list at index 2)."""
         vals = [self._e(v) for v in values]
         if not vals:
             return False
-        size = self.size()
-        if index > size:
-            raise IndexError(
-                f"insert index {index} beyond list size {size}")
-        for off, v in enumerate(vals):
-            self._executor.execute_sync(
-                self.name, "linsert_at", {"index": index + off, "value": v})
-        return True
+        return self._executor.execute_sync(
+            self.name, "lsplice", {"index": index, "values": vals})
 
     def is_empty(self) -> bool:
         return self.size() == 0

@@ -56,9 +56,32 @@ def flip_bits(bits: jnp.ndarray, idx: jnp.ndarray):
     return flipped, old
 
 
-def cardinality(bits: jnp.ndarray) -> jnp.ndarray:
-    """BITCOUNT."""
-    return jnp.sum(bits.astype(jnp.int32))
+_CARD_CHUNK = 1 << 20
+
+
+def cardinality_partials(bits: jnp.ndarray) -> jnp.ndarray:
+    """Per-chunk int32 popcount partials (each <= 2^20, overflow-proof).
+
+    The full BITCOUNT is combined host-side (`combine_partials`) in
+    python ints: a single int32 `jnp.sum` wraps negative above 2^31 set
+    bits, and int64 accumulation on device needs jax_enable_x64."""
+    n = bits.shape[0]
+    pad = (-n) % _CARD_CHUNK
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), bits.dtype)])
+    return jnp.sum(bits.reshape(-1, _CARD_CHUNK).astype(jnp.int32), axis=1)
+
+
+def combine_partials(partials) -> int:
+    """64-bit exact host-side combine of int32 popcount partials."""
+    import numpy as np
+
+    return int(np.asarray(partials, dtype=np.int64).sum())
+
+
+def cardinality(bits: jnp.ndarray) -> int:
+    """BITCOUNT: chunked int32 partials on device, 64-bit host combine."""
+    return combine_partials(cardinality_partials_jit(bits))
 
 
 def length(bits: jnp.ndarray) -> jnp.ndarray:
@@ -103,5 +126,5 @@ def unpack(data: jnp.ndarray, nbits: int) -> jnp.ndarray:
     return cells.reshape(-1)[:nbits]
 
 
-cardinality_jit = jax.jit(cardinality)
+cardinality_partials_jit = jax.jit(cardinality_partials)
 length_jit = jax.jit(length)

@@ -17,9 +17,10 @@ pass regardless of bank size:
 
 All kernels run in interpreter mode off-TPU (CPU tests) and compiled on
 TPU; `engine` gates them on the backend platform. The HLL insert fold
-deliberately stays in XLA: the combining max-scatter
-(`hll.insert_scatter`) measured ~30 us per 1M-key batch on v5e, which a
-hand kernel is unlikely to beat.
+has two device paths: the XLA combining max-scatter
+(`hll.insert_scatter`, ~30 us per 1M-key batch on v5e) and the Pallas
+segmented-scatter in `redisson_tpu.ingest.kernels` (sort + VMEM-tiled
+segment-max), selected per batch by `redisson_tpu.ingest.planner`.
 """
 
 from __future__ import annotations
@@ -99,23 +100,22 @@ def _popcount_kernel(cells_ref, out_ref):
 
 
 @functools.partial(jax.jit, static_argnames=("block",))
-def popcount_cells(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
-    """Set-bit count over the unpacked 0/1 uint8 cell layout (BITCOUNT).
+def popcount_partials(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
+    """Per-block int32 set-bit partials over the unpacked cell layout.
 
-    Emits one int32 partial per block and reduces the [G] partials with
-    XLA. The final sum is int32: exact for bitsets under 2^31 set bits
-    (the unpacked layout at that size is already 2 GiB of HBM, past the
-    practical single-chip bitset ceiling; the reference caps Bloom/BitSet
-    addressing at 2^32 bits, `RedissonBloomFilter.java:52`).
+    Each partial counts <= `block` 0/1 cells so int32 cannot overflow;
+    callers needing the total past 2^31 set bits combine the [G, 1]
+    partials host-side in 64 bits (`ops/bitset.combine_partials` — the
+    engine's BITCOUNT path does exactly that).
     """
     n = cells.shape[0]
     if n == 0:
-        return jnp.int32(0)
+        return jnp.zeros((1, 1), jnp.int32)
     pad = (-n) % block
     if pad:
         cells = jnp.concatenate([cells, jnp.zeros((pad,), cells.dtype)])
     grid_n = cells.shape[0] // block
-    partials = pl.pallas_call(
+    return pl.pallas_call(
         _popcount_kernel,
         out_shape=jax.ShapeDtypeStruct((grid_n, 1), jnp.int32),
         grid=(grid_n,),
@@ -125,7 +125,13 @@ def popcount_cells(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
         out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
         interpret=_interpret(),
     )(cells)
-    return jnp.sum(partials)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def popcount_cells(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
+    """BITCOUNT as one device scalar — int32, exact under 2^31 set bits
+    (use `popcount_partials` + a host combine beyond that)."""
+    return jnp.sum(popcount_partials(cells, block))
 
 
 # ---------------------------------------------------------------------------

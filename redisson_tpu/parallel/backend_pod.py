@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import List
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +28,7 @@ from redisson_tpu.backend_tpu import (
 )
 from redisson_tpu.store import ObjectType, WrongTypeError
 from redisson_tpu.executor import Op
+from redisson_tpu.ingest.pipeline import StagingPipeline
 from redisson_tpu.ops import bloom as bloom_ops
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.parallel import sharded, sharded_bits
@@ -75,8 +77,12 @@ class PodBackend:
         # SHARES this allocator so its _check_not_hll guards (bitset/bloom
         # ops colliding with a bank HLL name) see pod-tier rows too.
         self.store = SketchStore(device=self.mesh.devices.flat[0])
-        self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed)
+        self._delegate = TpuBackend(self.store, hll_impl=cfg.hll_impl, seed=cfg.hash_seed,
+                                    ingest=getattr(cfg, "ingest", "auto"))
         self._delegate._alloc = self._alloc
+        # Host->mesh staging: pad + transfer of chunk N+1 overlaps the
+        # SPMD dispatch of chunk N (redisson_tpu.ingest.pipeline).
+        self._pipeline = StagingPipeline(depth=2)
 
     @property
     def _rows(self) -> dict:
@@ -292,7 +298,7 @@ class PodBackend:
         # RTT per chunk — the same serialization the single-chip backend
         # shed in r3, VERDICT r2 weak #1). bank_insert returns PER-ROW
         # change flags, so each op gets its own target's PFADD bool.
-        parts = []
+        chunks = []
         for pre_hashed, (his, los, rows) in groups.items():
             if not his:
                 continue
@@ -300,14 +306,30 @@ class PodBackend:
             lo = np.concatenate(los)
             row = np.concatenate(rows)
             for s, e in engine.chunk_spans(hi.shape[0]):
-                phi, valid = engine.pad_ints(hi[s:e])
-                plo, _ = engine.pad_ints(lo[s:e])
-                prow, _ = engine.pad_ints(row[s:e])
-                self.bank, changed = sharded.bank_insert(
-                    self.bank, phi, plo, prow, valid, self.mesh, self.seed,
-                    pre_hashed
-                )
-                parts.append(changed)
+                chunks.append((pre_hashed, hi[s:e], lo[s:e], row[s:e]))
+
+        # Replicated placement matching bank_insert's P() in_specs, so the
+        # staged transfer IS the array the SPMD step consumes.
+        repl = jax.sharding.NamedSharding(self.mesh, jax.sharding.PartitionSpec())
+
+        def stage(chunk):
+            pre_hashed, hi, lo, row = chunk
+            phi, valid = engine.pad_ints(hi)
+            plo, _ = engine.pad_ints(lo)
+            prow, _ = engine.pad_ints(row)
+            return pre_hashed, jax.device_put((phi, plo, prow, valid), repl)
+
+        def dispatch(_i, staged):
+            pre_hashed, (phi, plo, prow, valid) = staged
+            self.bank, changed = sharded.bank_insert(
+                self.bank, phi, plo, prow, valid, self.mesh, self.seed,
+                pre_hashed
+            )
+            return changed
+
+        # Staged double-buffer: pad + H2D of chunk N+1 overlaps the device
+        # dispatch of chunk N; dispatches stay serial (bank carries state).
+        parts = self._pipeline.run(chunks, stage, dispatch)
         op_rows = []
         for op in ops:
             self._row_versions[op.target] = self._row_versions.get(op.target, 0) + 1
@@ -483,8 +505,11 @@ class PodBackend:
             for op in ops:
                 op.future.set_result(0)
             return
-        v = _start_d2h(sharded_bits.cardinality(obj.state))
-        self.completer.submit(_complete_all(ops, lambda: int(v)))
+        # int32 partials on device; the 64-bit-exact combine runs host-side
+        # at completion (>2^31 set bits would wrap a plain int32 sum).
+        v = _start_d2h(sharded_bits.cardinality_partials(obj.state))
+        self.completer.submit(_complete_all(
+            ops, lambda: sharded_bits.combine_partials(v)))
 
     def _op_bitset_length(self, target: str, ops: List[Op]) -> None:
         self._bits_check(target, ObjectType.BITSET)
@@ -668,7 +693,8 @@ class PodBackend:
 
     def _op_bloom_count(self, target: str, ops: List[Op]) -> None:
         obj, m, k = self._bloom_obj(target)
-        bc = int(_start_d2h(sharded_bits.cardinality(obj.state)))
+        bc = sharded_bits.combine_partials(
+            _start_d2h(sharded_bits.cardinality_partials(obj.state)))
         est = int(round(float(bloom_ops.count_estimate(bc, m, k))))
         for op in ops:
             op.future.set_result(est)

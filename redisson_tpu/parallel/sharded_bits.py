@@ -4,8 +4,9 @@ The last SURVEY §5 parallelism capability (VERDICT r4 missing #1): the
 reference executes BITOP/bloom ops wherever the data lives and fans in with
 SlotCallback (`RedissonBitSet.java:81-118`,
 `command/CommandAsyncService.java:128-164`); the TPU-native redesign shards
-the bit axis itself so a 2^33-bit filter is first-class even though no
-single chip could hold it:
+the bit axis itself so a 2^32-bit filter — the check_size cap, and the
+ceiling of the uint32 index math — is first-class even though no single
+chip could hold it:
 
   * bits live unpacked (one uint8 cell per bit, same layout as the
     single-chip tier, ops/bitset.py) as an [n] array with
@@ -36,7 +37,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+
+try:  # jax >= 0.5 re-exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from redisson_tpu.ops import bloom
@@ -139,10 +144,35 @@ def get_bits(bits, idx, valid, mesh: Mesh):
 # -- whole-array ops (GSPMD partitions these from the sharding) -------------
 
 
+_CARD_CHUNK = 1 << 20
+
+
 @jax.jit
-def cardinality(bits):
-    """BITCOUNT: local popcount per shard + one psum (inserted by GSPMD)."""
-    return jnp.sum(bits.astype(jnp.int32))
+def cardinality_partials(bits):
+    """Per-chunk int32 popcount partials (each <= 2^20, overflow-proof).
+
+    GSPMD keeps the chunk sums local to their shards; the cross-shard
+    combine happens host-side in `cardinality` with python ints, so the
+    total is exact well past 2^31 set bits (a straight int32 `jnp.sum`
+    wraps negative there — review r5 / ADVICE)."""
+    n = bits.shape[0]
+    pad = (-n) % _CARD_CHUNK
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), bits.dtype)])
+    return jnp.sum(
+        bits.reshape(-1, _CARD_CHUNK).astype(jnp.int32), axis=1)
+
+
+def combine_partials(partials) -> int:
+    """64-bit exact host-side combine of int32 popcount partials."""
+    import numpy as np
+
+    return int(np.asarray(partials, dtype=np.int64).sum())
+
+
+def cardinality(bits) -> int:
+    """BITCOUNT: chunked int32 partials on device, 64-bit combine on host."""
+    return combine_partials(cardinality_partials(bits))
 
 
 @jax.jit

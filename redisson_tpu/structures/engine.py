@@ -827,6 +827,29 @@ class StructureBackend(ExtendedOps):
         self._serve_waiters(key)
         op.future.set_result(True)
 
+    def _op_lsplice(self, key: str, op: Op) -> None:
+        """addAll(index, values) as ONE op, mirroring lretain: the old
+        model-level loop of linsert_at let concurrent writers interleave
+        between elements. Same bound rule as linsert_at (error past the
+        current size, RedissonListTest.java:715-719)."""
+        kv = self._create(key, T.LIST, deque)
+        i = op.payload["index"]
+        vals = op.payload["values"]
+        if i > len(kv.value):
+            self._drop_if_empty(key, kv)
+            raise IndexError(
+                f"insert index {i} beyond list size {len(kv.value)}")
+        if not vals:
+            self._drop_if_empty(key, kv)
+            op.future.set_result(False)
+            return
+        items = list(kv.value)
+        items[i:i] = vals
+        kv.value.clear()
+        kv.value.extend(items)
+        self._serve_waiters(key)
+        op.future.set_result(True)
+
     def _op_linsert(self, key: str, op: Op) -> None:
         """LINSERT BEFORE|AFTER pivot value -> new size | -1 if no pivot."""
         kv = self._entry(key, T.LIST)

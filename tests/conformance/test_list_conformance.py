@@ -194,6 +194,27 @@ def test_add_all_index(client):
     assert l.read_all() == [1, 2, 7, 8, 9, 3, 4, 5]
 
 
+def test_add_all_index_head_and_tail(client):
+    # lsplice edge indexes: 0 (head rebuild) and size (pure append).
+    l = client.get_list("list")
+    l.add_all([3, 4])
+    assert l.add_all_at(0, [1, 2]) is True
+    assert l.add_all_at(4, [5, 6]) is True
+    assert l.read_all() == [1, 2, 3, 4, 5, 6]
+
+
+def test_add_all_index_keeps_ttl(client):
+    # The splice is one atomic op and must not reset the key's expiry
+    # (the old client-side loop went through linsert_at's del+rpush
+    # rebuild, which drops the TTL at index 0 on the wire backend).
+    l = client.get_list("list")
+    l.add_all([1, 2, 3])
+    assert l.expire(60) is True
+    assert l.add_all_at(0, [0]) is True
+    assert l.read_all() == [0, 1, 2, 3]
+    assert l.remain_time_to_live() > 0
+
+
 def test_add_all(client):
     # RedissonListTest.java:772-786 testAddAll
     l = client.get_list("list")

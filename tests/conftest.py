@@ -27,3 +27,10 @@ jax.config.update("jax_platforms", "cpu")
 assert all(d.platform == "cpu" for d in jax.devices()), (
     "a backend initialized before conftest could force CPU"
 )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests (big allocations / long runs) excluded from the "
+        "tier-1 `-m 'not slow'` pass")
