@@ -23,6 +23,7 @@ from redisson_tpu.executor import Op
 from redisson_tpu.ingest.pipeline import StagingPipeline
 from redisson_tpu.ingest.planner import IngestPlanner, default_planner
 from redisson_tpu.ops import bitset as bitset_ops, bloom as bloom_ops
+from redisson_tpu.ops import bloom_math
 from redisson_tpu.store import ObjectType, SketchStore, WrongTypeError
 
 
@@ -266,6 +267,7 @@ class LinkProfile:
 
         def roundtrip(buf):
             t0 = time.perf_counter()
+            # graftlint: allow-sync(link probe times the blocking roundtrip on purpose) allow-int-reduce(probe buffer is 8 MB of uint8 so the sum is far below 2^31)
             float(jnp.sum(jax.device_put(buf, device).astype(jnp.int32)))
             return time.perf_counter() - t0
 
@@ -334,6 +336,10 @@ class TpuBackend:
     vector, like the pod tier's bank_insert)."""
 
     GLOBAL_COALESCE = frozenset({"hll_add"})
+
+    #: device index math (ops/bloom._mod_u64) is only exact for m <= 2^31 or
+    #: power-of-two m — models fail bloom sizing fast when this tier backs them
+    BLOOM_STRICT_MOD = True
 
     #: accepted `ingest` config values — "auto" plans per batch; "device"
     #: forces the device path with the configured hll_impl; the kernel
@@ -936,7 +942,8 @@ class TpuBackend:
         outs = []
         spans = []
         for s, e in engine.chunk_spans(idx.shape[0]):
-            pidx, valid = engine.pad_ints(idx[s:e].astype(np.int32))
+            # uint32, not int32: positions past 2^31 wrap int32 negative
+            pidx, valid = engine.pad_ints(idx[s:e].astype(np.uint32))
             new, old = kernel(obj.state, pidx, valid)
             self.store.swap(target, new)
             outs.append(old)  # device handles; materialized off-thread
@@ -998,7 +1005,7 @@ class TpuBackend:
                 pos += n
             return
         nbits = obj.state.shape[0]
-        clipped = np.clip(idx, 0, nbits - 1).astype(np.int32)
+        clipped = np.clip(idx, 0, nbits - 1).astype(np.uint32)
         outs, spans = [], []
         for s, e in engine.chunk_spans(clipped.shape[0]):
             pidx, valid = engine.pad_ints(clipped[s:e])
@@ -1028,8 +1035,12 @@ class TpuBackend:
             for op in ops:
                 op.future.set_result(0)
             return
-        v = engine.bitset_length(obj.state)
-        self.completer.submit(_complete_all(ops, lambda: int(v)))
+        # Same async shape as BITCOUNT: int32 local offsets go D2H, the
+        # absolute position is assembled in 64-bit host ints at completion
+        # (positions past 2^31 bits wrap an int32 device scalar).
+        v = _start_d2h(engine.bitset_length_partials(obj.state))
+        self.completer.submit(_complete_all(
+            ops, lambda: bitset_ops.combine_length(v)))
 
     def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
         """STRLEN * 8 — the WRITTEN byte extent, exactly what redis
@@ -1205,6 +1216,7 @@ class TpuBackend:
         if obj.version == 0:
             bits = np.zeros(nbytes, np.uint8)
         else:
+            # graftlint: allow-sync(mirror seeding is a one-time snapshot read; callers tolerate the blocking pack)
             bits = np.asarray(engine.bitset_pack(obj.state))[:nbytes].copy()
         mir = {"bits": bits, "synced_dev": obj.version,
                "host_v": 0, "absorbed_v": 0}
@@ -1401,8 +1413,11 @@ class TpuBackend:
             bc = native_mod.popcount(mir["bits"])
         else:
             self._bloom_device_sync(target)
+            # graftlint: allow-sync(mirror-miss fallback: count() is a synchronous API and must block on the fresh BITCOUNT)
             bc = int(engine.bitset_cardinality(obj.state))
-        est = float(bloom_ops.count_estimate(bc, m, k))
+        # bc is a host int here — the pure-math estimate matches the wire
+        # tier (interop/bloom_redis) bit-for-bit and avoids a device call.
+        est = bloom_math.count_estimate(bc, m, k)
         for op in ops:
             op.future.set_result(int(round(est)))
 

@@ -502,8 +502,18 @@ def bitset_bitop(stack, op: str):
 
 
 @jax.jit
-def bitset_length(bits):
-    return bitset.length(bits)
+def bitset_length_partials(bits):
+    """Device half of lengthAsync: per-chunk int32 'highest set bit + 1'
+    local offsets — absolute positions (which wrap int32 past 2^31 bits)
+    are only formed host-side by `bitset.combine_length`."""
+    return bitset.length_partials(bits)
+
+
+def bitset_length(bits) -> int:
+    """Index of highest set bit + 1, exact past 2^31 bits. Blocks; the
+    backend dispatch path stages `bitset_length_partials` asynchronously
+    instead and combines in the completer."""
+    return bitset.combine_length(bitset_length_partials(bits))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -629,6 +639,7 @@ def blocked_bloom_contains_count_packed(bits, packed, count, k: int, m: int,
                                         seed: int = 0):
     h1, h2, valid = _packed_hashes(packed, count, seed)
     res = _blocked_contains(bits, h1, h2, valid, k, m)
+    # graftlint: allow-int-reduce(summing a 0/1 mask over one batch; batches cap at MAX_BUCKET 2^21 << 2^31)
     return jnp.sum(res.astype(jnp.int32))
 
 
@@ -653,4 +664,5 @@ def bloom_contains_count_packed(bits, packed, count, k: int, m: int, seed: int =
     reference's sense (BITCOUNT-style): only a 4-byte scalar leaves the
     device, which is what makes the FPR@1B probe feasible on a slow link."""
     h1, h2, valid = _packed_hashes(packed, count, seed)
+    # graftlint: allow-int-reduce(summing a 0/1 mask over one batch; batches cap at MAX_BUCKET 2^21 << 2^31)
     return jnp.sum(_bloom_contains(bits, h1, h2, valid, k, m).astype(jnp.int32))

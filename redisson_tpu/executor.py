@@ -79,6 +79,12 @@ class CommandExecutor:
         )
         self._thread.start()
 
+    @property
+    def backend(self):
+        """The backend behind this executor — models use it for tier
+        capability introspection (e.g. BLOOM_STRICT_MOD)."""
+        return self._backend
+
     # -- submission ---------------------------------------------------------
 
     def execute_async(self, target: str, kind: str, payload: Any, nkeys: int = 0) -> Future:

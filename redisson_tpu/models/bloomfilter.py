@@ -16,6 +16,7 @@ from typing import Iterable
 import numpy as np
 
 from redisson_tpu.models.object import RObject, pack_u64
+from redisson_tpu.ops import bloom_math
 
 
 class RBloomFilter(RObject):
@@ -31,6 +32,35 @@ class RBloomFilter(RObject):
         """
         if not 0 < false_probability < 1:
             raise ValueError("false_probability must be in (0, 1)")
+        if int(expected_insertions) <= 0:
+            raise ValueError("expected_insertions must be positive")
+        # Enforce the TPU mod-arithmetic precondition (ops/bloom.py::_mod_u64
+        # needs m <= 2^31 or m a power of two) HERE, synchronously, with the
+        # derived geometry in the message — not as a deferred backend error
+        # after the executor round-trip. Only device tiers declare the
+        # precondition (BLOOM_STRICT_MOD); the wire tier's host-side index
+        # math takes any size up to the 2^32 cap.
+        m = bloom_math.optimal_num_of_bits(
+            int(expected_insertions), float(false_probability))
+        if blocked:
+            m = ((m + 511) // 512) * 512  # ops/bloom.BLOCK_BITS rounding
+        strict = bool(getattr(
+            getattr(self._executor, "backend", None), "BLOOM_STRICT_MOD", False))
+        if strict and m > (1 << 31) and (m & (m - 1)) != 0:
+            raise ValueError(
+                f"derived bloom size m={m} bits (from expected_insertions="
+                f"{int(expected_insertions)}, false_probability="
+                f"{false_probability}) exceeds 2^31 and is not a power of "
+                "two — the TPU index math (ops/bloom._mod_u64) is only "
+                "exact for m <= 2^31 or power-of-two m up to 2^32. Lower "
+                "expected_insertions, raise false_probability, or pick "
+                "parameters whose derived m is a power of two."
+            )
+        if m > bloom_math.MAX_SIZE:
+            raise ValueError(
+                f"derived bloom size m={m} exceeds the 2^32-bit cap; lower "
+                "expected_insertions or raise false_probability"
+            )
         return self._executor.execute_sync(
             self.name,
             "bloom_init",

@@ -25,7 +25,10 @@ def make(nbits: int) -> jnp.ndarray:
 
 
 def get_bits(bits: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
-    """GETBIT batch: [K] int32 indices -> [K] uint8 in {0,1}."""
+    """GETBIT batch: [K] uint32 indices -> [K] uint8 in {0,1}.
+
+    Indices are uint32 (not int32): bit positions range over the full
+    advertised 2^32 capacity, and int32 wraps negative past 2^31."""
     return bits[idx]
 
 
@@ -42,9 +45,19 @@ def clear_bits(bits: jnp.ndarray, idx: jnp.ndarray):
 
 
 def set_range(bits: jnp.ndarray, start, end, value: bool) -> jnp.ndarray:
-    """Set [start, end) to value — one fused select, not one op per bit."""
-    pos = jnp.arange(bits.shape[0], dtype=jnp.int32)
-    in_range = (pos >= start) & (pos < end)
+    """Set [start, end) to value — one fused select, not one op per bit.
+
+    Positions compare as uint32 so ranges past 2^31 bits stay exact
+    (int32 positions wrap negative there). Python-int bounds are clamped
+    to the array length host-side, which also keeps `end == 2^32`
+    (one past the last representable uint32 position) correct."""
+    n = bits.shape[0]
+    pos = jnp.arange(n, dtype=jnp.uint32)
+    if isinstance(start, int):
+        start = min(start, n)
+    in_range = pos >= jnp.uint32(start)
+    if not (isinstance(end, int) and end >= n):
+        in_range &= pos < jnp.uint32(end)
     return jnp.where(in_range, jnp.uint8(1 if value else 0), bits)
 
 
@@ -84,17 +97,75 @@ def cardinality(bits: jnp.ndarray) -> int:
     return combine_partials(cardinality_partials_jit(bits))
 
 
-def length(bits: jnp.ndarray) -> jnp.ndarray:
-    """Index of highest set bit + 1 (0 if empty) — reference lengthAsync."""
-    pos = jnp.arange(bits.shape[0], dtype=jnp.int32)
-    return jnp.max(jnp.where(bits != 0, pos + 1, 0))
+def length_partials(bits: jnp.ndarray) -> jnp.ndarray:
+    """Per-chunk 'highest set bit + 1' as int32 *local* offsets.
+
+    Each chunk is 2^20 cells so the local offset fits int32 with room to
+    spare; the absolute position (which wraps int32 past 2^31 bits) only
+    ever exists host-side in `combine_length` as a python int."""
+    n = bits.shape[0]
+    pad = (-n) % _CARD_CHUNK
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), bits.dtype)])
+    chunks = bits.reshape(-1, _CARD_CHUNK)
+    pos = jnp.arange(_CARD_CHUNK, dtype=jnp.int32)
+    return jnp.max(jnp.where(chunks != 0, pos[None, :] + 1, 0), axis=1)
 
 
-def bitpos(bits: jnp.ndarray, value: int) -> jnp.ndarray:
-    """First index holding `value` (0/1); -1 if none. Redis BITPOS."""
-    match = bits == jnp.uint8(value)
-    idx = jnp.argmax(match)
-    return jnp.where(jnp.any(match), idx.astype(jnp.int32), -1)
+def combine_length(partials) -> int:
+    """64-bit exact host combine: last chunk with a set bit wins."""
+    import numpy as np
+
+    p = np.asarray(partials)
+    nz = np.flatnonzero(p)
+    if nz.size == 0:
+        return 0
+    g = int(nz[-1])
+    return g * _CARD_CHUNK + int(p[g])
+
+
+def length(bits: jnp.ndarray) -> int:
+    """Index of highest set bit + 1 (0 if empty) — reference lengthAsync.
+
+    Returns a python int (exact past 2^31 bits); blocks on the device.
+    Async callers dispatch `length_partials_jit` and run
+    `combine_length` after the d2h completes."""
+    return combine_length(length_partials_jit(bits))
+
+
+def bitpos_partials(bits: jnp.ndarray, value: int) -> jnp.ndarray:
+    """Per-chunk first index holding `value` as int32 local offsets; -1
+    where the chunk has no match. Padding cells are filled with the
+    *complement* of `value` so the pad can never produce a false hit
+    (matters when scanning for 0)."""
+    n = bits.shape[0]
+    pad = (-n) % _CARD_CHUNK
+    if pad:
+        fill = jnp.uint8(0 if value else 1)
+        bits = jnp.concatenate([bits, jnp.full((pad,), fill, bits.dtype)])
+    chunks = bits.reshape(-1, _CARD_CHUNK)
+    match = chunks == jnp.uint8(value)
+    idx = jnp.argmax(match, axis=1).astype(jnp.int32)
+    return jnp.where(jnp.any(match, axis=1), idx, -1)
+
+
+def combine_bitpos(partials) -> int:
+    """64-bit exact host combine: first chunk with a hit wins."""
+    import numpy as np
+
+    p = np.asarray(partials)
+    hit = np.flatnonzero(p >= 0)
+    if hit.size == 0:
+        return -1
+    g = int(hit[0])
+    return g * _CARD_CHUNK + int(p[g])
+
+
+def bitpos(bits: jnp.ndarray, value: int) -> int:
+    """First index holding `value` (0/1); -1 if none. Redis BITPOS.
+
+    Returns a python int so positions past 2^31 don't wrap int32."""
+    return combine_bitpos(bitpos_partials_jit(bits, value))
 
 
 def bitop_and(a, b):
@@ -127,4 +198,5 @@ def unpack(data: jnp.ndarray, nbits: int) -> jnp.ndarray:
 
 
 cardinality_partials_jit = jax.jit(cardinality_partials)
-length_jit = jax.jit(length)
+length_partials_jit = jax.jit(length_partials)
+bitpos_partials_jit = jax.jit(bitpos_partials, static_argnames=("value",))

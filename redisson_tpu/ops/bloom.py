@@ -48,7 +48,11 @@ def check_size(m: int) -> None:
     if m > MAX_SIZE:
         raise ValueError(f"bloom size {m} exceeds cap {MAX_SIZE}")
     if m > (1 << 31) and (m & (m - 1)) != 0:
-        raise ValueError("sizes above 2^31 must be powers of two on the TPU path")
+        raise ValueError(
+            f"bloom size m={m} is above 2^31 and not a power of two — the "
+            "TPU path's exact mod (_mod_u64) requires m <= 2^31 or "
+            "power-of-two m up to 2^32"
+        )
 
 
 def _mod_u64(x: U64, m: int) -> jnp.ndarray:
@@ -61,6 +65,7 @@ def _mod_u64(x: U64, m: int) -> jnp.ndarray:
     r = jnp.zeros_like(x.lo)
     mm = jnp.uint32(m)
     for i in range(63, -1, -1):
+        # graftlint: allow-u64(single-bit extraction within one lane; exact, no cross-lane carry involved)
         bit = (x.hi >> (i - 32)) & 1 if i >= 32 else (x.lo >> i) & 1
         r = (r << 1) | bit
         r = jnp.where(r >= mm, r - mm, r)

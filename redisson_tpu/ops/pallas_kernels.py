@@ -96,6 +96,7 @@ def _popcount_kernel(cells_ref, out_ref):
     # Per-block partial sums; each block holds <= `block` cells of value
     # 0/1, so an int32 partial cannot overflow for any practical block.
     # Scalars land in SMEM — Mosaic rejects scalar stores to VMEM.
+    # graftlint: allow-int-reduce(per-block partial over <= `block` 0/1 cells; the 64-bit combine is host-side)
     out_ref[0, 0] = jnp.sum(cells_ref[:].astype(jnp.int32))
 
 
@@ -131,6 +132,7 @@ def popcount_partials(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
 def popcount_cells(cells: jnp.ndarray, block: int = 1 << 18) -> jnp.ndarray:
     """BITCOUNT as one device scalar — int32, exact under 2^31 set bits
     (use `popcount_partials` + a host combine beyond that)."""
+    # graftlint: allow-int-reduce(documented int32 cap: exact under 2^31 set bits per this docstring)
     return jnp.sum(popcount_partials(cells, block))
 
 

@@ -30,6 +30,7 @@ from redisson_tpu.store import ObjectType, WrongTypeError
 from redisson_tpu.executor import Op
 from redisson_tpu.ingest.pipeline import StagingPipeline
 from redisson_tpu.ops import bloom as bloom_ops
+from redisson_tpu.ops import bloom_math
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.parallel import sharded, sharded_bits
 from redisson_tpu.parallel.mesh import build_mesh
@@ -57,6 +58,7 @@ class _PodBits:
 
 class PodBackend:
     GLOBAL_COALESCE = frozenset({"hll_add"})
+    BLOOM_STRICT_MOD = True  # same _mod_u64 precondition as the 1-chip tier
 
     def __init__(self, cfg):
         self.mesh = build_mesh(cfg.num_shards)
@@ -695,7 +697,9 @@ class PodBackend:
         obj, m, k = self._bloom_obj(target)
         bc = sharded_bits.combine_partials(
             _start_d2h(sharded_bits.cardinality_partials(obj.state)))
-        est = int(round(float(bloom_ops.count_estimate(bc, m, k))))
+        # bc is a host int (64-bit combine above) — pure-math estimate,
+        # same formula the wire tier uses, no device round-trip.
+        est = int(round(bloom_math.count_estimate(bc, m, k)))
         for op in ops:
             op.future.set_result(est)
 

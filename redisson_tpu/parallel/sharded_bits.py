@@ -295,6 +295,7 @@ def bloom_contains_packed(bits, packed, count, k: int, m: int, seed: int,
 def bloom_contains_count_packed(bits, packed, count, k: int, m: int,
                                 seed: int, mesh: Mesh,
                                 layout: str = "classic"):
+    # graftlint: allow-int-reduce(summing a 0/1 mask over one batch; batches cap at MAX_BUCKET 2^21 << 2^31)
     return jnp.sum(bloom_contains_packed(
         bits, packed, count, k, m, seed, mesh, layout).astype(jnp.int32))
 

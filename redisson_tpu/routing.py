@@ -25,6 +25,7 @@ class RoutingBackend:
         self.sketch = sketch_backend
         self.structures = structures or StructureBackend()
         self.GLOBAL_COALESCE = frozenset(getattr(sketch_backend, "GLOBAL_COALESCE", ()))
+        self.BLOOM_STRICT_MOD = bool(getattr(sketch_backend, "BLOOM_STRICT_MOD", False))
         self.pubsub = self.structures.pubsub
 
     # sketch kinds = everything the sketch backend implements, minus the

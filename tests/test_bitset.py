@@ -62,3 +62,43 @@ def test_pack_unpack_redis_layout():
     # Roundtrip.
     back = bitset.unpack(np.frombuffer(packed, np.uint8), 16)
     assert np.array_equal(np.asarray(back), np.asarray(bits2))
+
+
+def test_combine_length_past_2_31():
+    """The 64-bit host combine must report positions beyond int32 range.
+
+    A real 2^31-bit array is too big for CI, so fabricate the per-chunk
+    partials the device kernel would emit: zero everywhere except one
+    high chunk. The combined position must come back as an exact python
+    int past 2^31 (the old single-int32 path wrapped negative here).
+    """
+    chunk = bitset._CARD_CHUNK
+    g = (1 << 31) // chunk + 3  # chunk index whose base offset is > 2^31
+    partials = np.zeros((g + 1,), np.int32)
+    partials[g] = 7  # highest set bit at local offset 6 -> length 7
+    got = bitset.combine_length(partials)
+    assert got == g * chunk + 7
+    assert got > (1 << 31)
+    assert bitset.combine_length(np.zeros((4,), np.int32)) == 0
+
+
+def test_combine_bitpos_past_2_31():
+    chunk = bitset._CARD_CHUNK
+    g = (1 << 31) // chunk + 3
+    partials = np.full((g + 1,), -1, np.int32)
+    partials[g] = 5  # first match lives in the high chunk
+    got = bitset.combine_bitpos(partials)
+    assert got == g * chunk + 5
+    assert got > (1 << 31)
+    # earliest chunk wins when several match
+    partials[2] = 11
+    assert bitset.combine_bitpos(partials) == 2 * chunk + 11
+    assert bitset.combine_bitpos(np.full((4,), -1, np.int32)) == -1
+
+
+def test_bitpos_zero_ignores_chunk_padding():
+    """bitpos(.., 0) must not report a hit inside the pad region appended
+    to fill the last chunk (pad is filled with the non-matching value)."""
+    bits = jnp.ones((10,), jnp.uint8)
+    assert bitset.bitpos(bits, 0) == -1
+    assert bitset.bitpos(bits, 1) == 0

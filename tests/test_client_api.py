@@ -152,6 +152,25 @@ class TestBloomFilter:
         with pytest.raises(RuntimeError, match="not initialized"):
             bf.add("x")
 
+    def test_try_init_rejects_nonpositive_insertions(self, client):
+        bf = client.get_bloom_filter("bf:zero")
+        with pytest.raises(ValueError, match="positive"):
+            bf.try_init(0, 0.01)
+        with pytest.raises(ValueError, match="positive"):
+            bf.try_init(-5, 0.01)
+
+    def test_try_init_rejects_unrepresentable_geometry(self, client):
+        # (300M, 0.01) derives m = 2_875_517_513 bits: past 2^31 and not a
+        # power of two, so ops/bloom._mod_u64 index math would be inexact.
+        # Must fail fast at sizing time, before any allocation.
+        bf = client.get_bloom_filter("bf:huge")
+        with pytest.raises(ValueError, match="power of two"):
+            bf.try_init(300_000_000, 0.01)
+        with pytest.raises(ValueError, match="power of two"):
+            bf.try_init(300_000_000, 0.01, blocked=True)
+        # the failed attempts must not have created the object
+        assert bf.try_init(1000, 0.01) is True
+
 
 class TestBatch:
     def test_pipelined_hll_and_merge(self, client):

@@ -1,0 +1,409 @@
+"""graftlint as a tier-1 gate + unit coverage for its rules.
+
+Three layers:
+
+1. Gate tests — the committed tree must be clean under both tiers (Tier A
+   AST rules over ``redisson_tpu/`` and the Tier B jaxpr audit of ``ops/``),
+   with an empty baseline. A regression that introduces an unchunked int32
+   reduction, a hidden host sync, or an x64 leak fails CI here.
+2. Rule unit tests — each rule is exercised on small seeded sources via
+   ``FileLinter(source=...)`` so detection (and non-detection of the blessed
+   idioms) is pinned independently of the repo's current contents.
+3. Plumbing — suppression comments, baseline roundtrip, and the module CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tools.graftlint import run_lint
+from tools.graftlint.astlint import FileLinter
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint.findings import RULES, SUPPRESS_ALIASES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENGINE_DIR = os.path.join(REPO, "redisson_tpu")
+
+
+def lint_src(src, filename="scratch.py", explicit=True):
+    """Lint an in-memory source string with full rule coverage."""
+    return FileLinter(filename, repo_root=None, explicit=explicit,
+                      source=textwrap.dedent(src)).run()
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# 1. gate: the committed tree is clean
+# ---------------------------------------------------------------------------
+
+def test_repo_tier_a_clean():
+    dicts = run_lint([ENGINE_DIR], jaxpr=False)
+    assert dicts == [], (
+        "graftlint Tier A findings in redisson_tpu/ — fix or suppress with "
+        "a reasoned `# graftlint: allow-<rule>(why)` comment:\n"
+        + "\n".join(f"{d['file']}:{d['line']} {d['rule']} {d['message']}"
+                    for d in dicts)
+    )
+
+
+def test_jaxpr_audit_clean():
+    from tools.graftlint.jaxpr_audit import run_audits
+
+    findings = run_audits()
+    assert findings == [], (
+        "jaxpr audit findings:\n"
+        + "\n".join(f"{f.file} {f.rule} {f.message}" for f in findings)
+    )
+
+
+def test_jaxpr_registry_covers_public_ops():
+    """Every public function in the audited ops modules is either traced by
+    the registry or explicitly declared host-side in HOST_SIDE."""
+    import importlib
+    import inspect
+
+    from tools.graftlint.jaxpr_audit import HOST_SIDE, build_registry
+
+    audited = {}  # module short name -> set of audited fn names
+    for name, _thunk, _allow in build_registry():
+        mod, _, fn = name.partition(".")
+        audited.setdefault(mod, set()).add(fn.split("(")[0])
+    # pallas wrappers in the registry are named "pallas.*"
+    audited["pallas_kernels"] = audited.pop("pallas", set())
+
+    missing = []
+    for short in ["bitset", "bloom", "hll", "hashing", "u64"]:
+        mod = importlib.import_module(f"redisson_tpu.ops.{short}")
+        for fname, fn in vars(mod).items():
+            if fname.startswith("_") or not inspect.isfunction(fn):
+                continue
+            if getattr(fn, "__module__", None) != mod.__name__:
+                continue
+            if fname.endswith("_jit"):  # jit alias of an audited base fn
+                continue
+            if fname in HOST_SIDE.get(short, set()):
+                continue
+            if fname not in audited.get(short, set()):
+                missing.append(f"{short}.{fname}")
+    assert not missing, (
+        "public ops with no jaxpr-audit registry entry (add one in "
+        f"tools/graftlint/jaxpr_audit.py or list in HOST_SIDE): {missing}"
+    )
+
+
+def test_baseline_is_empty():
+    path = os.path.join(REPO, "tools", "graftlint", "baseline.json")
+    assert baseline_mod.load(path) == set(), (
+        "the committed baseline must stay empty — fix findings instead of "
+        "grandfathering them"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2. rule unit tests on seeded sources
+# ---------------------------------------------------------------------------
+
+def test_g001_unchunked_int_reduction():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def total(bits):
+            return jnp.sum(bits.astype(jnp.int32))
+    """)
+    assert [f.rule for f in findings] == ["G001"]
+    assert findings[0].line == 5
+
+
+def test_g001_chunk_partials_idiom_ok():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def partials(chunks):
+            return jnp.sum(chunks.astype(jnp.int32), axis=1)
+
+        def total(x):
+            return jnp.sum(x.astype(jnp.float32))
+    """)
+    assert findings == []  # axis= reduction and float reduction both fine
+
+
+def test_g002_host_sync_on_device_value():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def count(bits):
+            return int(jnp.sum(bits, axis=0)[0])
+    """)
+    assert "G002" in rules_of(findings)
+
+
+def test_g002_scoped_to_dispatch_paths():
+    src = """
+        import jax.numpy as jnp
+
+        def count(bits):
+            return int(jnp.max(bits, axis=0))
+    """
+    # engine.py is in the sync-sensitive scope; models/ is not.
+    hot = FileLinter(os.path.join(REPO, "redisson_tpu", "engine.py"),
+                     repo_root=REPO, source=textwrap.dedent(src)).run()
+    cold = FileLinter(os.path.join(REPO, "redisson_tpu", "models", "foo.py"),
+                      repo_root=REPO, source=textwrap.dedent(src)).run()
+    assert "G002" in rules_of(hot)
+    assert "G002" not in rules_of(cold)
+
+
+def test_g003_python_scalar_missing_static():
+    findings = lint_src("""
+        import jax
+
+        @jax.jit
+        def scale(x, n: int):
+            return x * n
+    """)
+    assert "G003" in rules_of(findings)
+
+
+def test_g003_static_argnames_ok():
+    findings = lint_src("""
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def scale(x, n: int):
+            return x * n
+    """)
+    assert findings == []
+
+
+def test_g003_jit_constructed_per_call():
+    findings = lint_src("""
+        import jax
+
+        def hot_loop(xs):
+            f = jax.jit(lambda x: x + 1)
+            return [f(x) for x in xs]
+    """)
+    assert "G003" in rules_of(findings)
+
+
+def test_g004_raw_lane_arithmetic():
+    findings = lint_src("""
+        def widen(x):
+            return (x.hi << 32) | x.lo
+    """)
+    assert "G004" in rules_of(findings)
+
+
+def test_g004_big_literal_in_jax_module():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def mask(x):
+            return x & 0x1FFFFFFFF
+    """)
+    assert "G004" in rules_of(findings)
+
+
+def test_g004_allowed_inside_u64_module():
+    findings = FileLinter(
+        "redisson_tpu/ops/u64.py",
+        source="def shl(x):\n    return x.hi << 1\n").run()
+    assert "G004" not in rules_of(findings)
+
+
+def test_g005_pallas_call_contract():
+    findings = lint_src("""
+        from jax.experimental import pallas as pl
+
+        def run(x):
+            return pl.pallas_call(kernel, out_shape=shape)(x)
+    """)
+    assert "G005" in rules_of(findings)  # interpret= missing
+
+
+def test_g005_blockspec_index_map_arity():
+    findings = lint_src("""
+        from jax.experimental import pallas as pl
+
+        def run(x, shape):
+            grid = (4, 4)
+            return pl.pallas_call(
+                kernel,
+                grid=grid,
+                in_specs=[pl.BlockSpec((128, 128), lambda i: (i, 0))],
+                out_shape=shape,
+                interpret=False,
+            )(x)
+    """)
+    assert "G005" in rules_of(findings)  # lambda i: ... under a 2-d grid
+
+
+# ---------------------------------------------------------------------------
+# 3. suppressions, baseline, CLI
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def total(bits):
+            # graftlint: allow-int-reduce(bounded by construction in this test)
+            return jnp.sum(bits.astype(jnp.int32))
+    """)
+    assert findings == []
+
+
+def test_suppression_without_reason_is_ignored():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def total(bits):
+            # graftlint: allow-int-reduce()
+            return jnp.sum(bits.astype(jnp.int32))
+    """)
+    assert "G001" in rules_of(findings)
+
+
+def test_suppression_wrong_rule_does_not_mask():
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def total(bits):
+            # graftlint: allow-sync(wrong rule for this line)
+            return jnp.sum(bits.astype(jnp.int32))
+    """)
+    assert "G001" in rules_of(findings)
+
+
+def test_every_rule_has_a_suppression_alias():
+    for rid, (alias, _desc) in RULES.items():
+        assert SUPPRESS_ALIASES[alias] == rid
+        assert SUPPRESS_ALIASES[rid.lower()] == rid
+
+
+def test_baseline_roundtrip_filters_findings(tmp_path):
+    scratch = tmp_path / "seeded.py"
+    scratch.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def total(bits):\n"
+        "    return jnp.sum(bits.astype(jnp.int32))\n"
+    )
+    dicts = run_lint([str(scratch)], jaxpr=False, repo_root=str(tmp_path))
+    assert [d["rule"] for d in dicts] == ["G001"]
+
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), dicts)
+    grandfathered = baseline_mod.load(str(bl))
+    assert {d["fingerprint"] for d in dicts} == grandfathered
+
+    # a baselined finding no longer gates; a new one still does
+    scratch.write_text(
+        scratch.read_text()
+        + "\ndef sync(bits):\n    return int(jnp.max(bits, axis=0))\n"
+    )
+    dicts2 = run_lint([str(scratch)], jaxpr=False, repo_root=str(tmp_path))
+    fresh = [d for d in dicts2 if d["fingerprint"] not in grandfathered]
+    assert [d["rule"] for d in fresh] == ["G002"]
+
+
+def test_fingerprint_survives_line_moves(tmp_path):
+    a = tmp_path / "mod.py"
+    a.write_text("import jax.numpy as jnp\n\n"
+                 "def f(b):\n    return jnp.sum(b.astype(jnp.int32))\n")
+    d1 = run_lint([str(a)], jaxpr=False, repo_root=str(tmp_path))
+    a.write_text("import jax.numpy as jnp\n\n\n\n# padding\n\n"
+                 "def f(b):\n    return jnp.sum(b.astype(jnp.int32))\n")
+    d2 = run_lint([str(a)], jaxpr=False, repo_root=str(tmp_path))
+    assert d1[0]["fingerprint"] == d2[0]["fingerprint"]
+    assert d1[0]["line"] != d2[0]["line"]
+
+
+@pytest.mark.slow
+def test_cli_module_clean_json():
+    """`python -m tools.graftlint redisson_tpu/ --json` exits 0 with no
+    findings — the exact CI gate invocation (both tiers)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "redisson_tpu", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["baselined"] == []
+
+
+def test_cli_seeded_violations_gate(tmp_path):
+    scratch = tmp_path / "viol.py"
+    scratch.write_text(
+        "import jax.numpy as jnp\n\n"
+        "def bad_total(bits):\n"
+        "    return jnp.sum(bits.astype(jnp.int32))\n\n"
+        "def bad_sync(bits):\n"
+        "    return int(jnp.max(bits, axis=0))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(scratch),
+         "--json", "--no-jaxpr"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    got = {(d["rule"], d["line"]) for d in payload["findings"]}
+    assert got == {("G001", 4), ("G002", 7)}
+
+
+# ---------------------------------------------------------------------------
+# 4. Tier B checker unit tests (on synthetic jaxprs, not the repo registry)
+# ---------------------------------------------------------------------------
+
+def test_j001_flags_x64_leak():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftlint.jaxpr_audit import _check_one
+
+    with jax.experimental.enable_x64():
+        closed = jax.make_jaxpr(lambda x: x.astype(jnp.int64) + 1)(
+            jnp.zeros((4,), jnp.int32))
+    findings = _check_one("synthetic", closed, {})
+    assert "J001" in {f.rule for f in findings}
+
+
+def test_j002_flags_narrowing_after_reduction():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftlint.jaxpr_audit import _check_one
+
+    def narrow(x):
+        return jnp.sum(x.astype(jnp.uint32).reshape(2, 8),
+                       axis=1).astype(jnp.uint8)
+
+    closed = jax.make_jaxpr(narrow)(jnp.zeros((16,), jnp.uint8))
+    findings = _check_one("synthetic", closed, {})
+    assert "J002" in {f.rule for f in findings}
+    # a registered allow_narrow bound silences exactly that dtype
+    assert _check_one("synthetic", closed,
+                      {"uint8": "sum of 8 values <= 255"}) == []
+
+
+def test_j002_widening_is_fine():
+    import jax
+    import jax.numpy as jnp
+
+    from tools.graftlint.jaxpr_audit import _check_one
+
+    def widen(x):
+        return jnp.sum(x.astype(jnp.int32).reshape(2, 8), axis=1)
+
+    closed = jax.make_jaxpr(widen)(jnp.zeros((16,), jnp.uint8))
+    assert _check_one("synthetic", closed, {}) == []

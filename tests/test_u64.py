@@ -77,6 +77,87 @@ def test_mul32():
     assert got == [x * y for x, y in zip(a, b)]
 
 
+# Crafted boundary words: all-zero lanes, single-lane-only values, the
+# 2^32 lane seam, the sign-bit position, and all-ones.
+EDGE_VALS = [
+    0,
+    1,
+    0xFFFFFFFF,          # lo lane saturated, hi zero
+    0x100000000,         # exactly 2^32: hi=1, lo=0
+    0x100000001,
+    0x7FFFFFFFFFFFFFFF,
+    0x8000000000000000,  # bit 63 only (hi nonzero, lo zero)
+    0xFFFFFFFF00000000,  # hi saturated, lo zero
+    (1 << 64) - 1,
+]
+
+
+@pytest.mark.parametrize("n", [0, 31, 32, 63])
+def test_shift_edges_on_boundary_words(n):
+    """Shift-count boundaries (0 / lane-1 / lane seam / 63) against python
+    ints on words chosen to stress the hi/lo spill paths."""
+    x = _pack(EDGE_VALS)
+    assert _unpack(u.shl(x, n)) == [(v << n) & MASK64 for v in EDGE_VALS]
+    assert _unpack(u.shr(x, n)) == [v >> n for v in EDGE_VALS]
+    rot = [((v << n) | (v >> (64 - n))) & MASK64 if n else v
+           for v in EDGE_VALS]
+    assert _unpack(u.rotl(x, n)) == rot
+
+
+def test_mul_wraparound_at_2_64():
+    """Products straddling 2^64 must wrap exactly (mod-2^64 semantics)."""
+    cases = [
+        (0xFFFFFFFFFFFFFFFF, 0xFFFFFFFFFFFFFFFF),  # (2^64-1)^2
+        (0xFFFFFFFFFFFFFFFF, 2),                   # 2^65 - 2
+        (0x8000000000000000, 2),                   # exactly 2^64 -> 0
+        (0x100000000, 0x100000000),                # 2^64 -> 0 via lane cross
+        (0xFFFFFFFF, 0xFFFFFFFF),                  # stays under 2^64
+        (0xDEADBEEFCAFEBABE, 0x123456789ABCDEF1),
+    ]
+    a = _pack([c[0] for c in cases])
+    b = _pack([c[1] for c in cases])
+    assert _unpack(u.mul(a, b)) == [(x * y) & MASK64 for x, y in cases]
+
+
+def test_add_carry_across_lane_seam():
+    cases = [
+        (0xFFFFFFFF, 1),                            # carry out of lo
+        (0xFFFFFFFFFFFFFFFF, 1),                    # wrap to 0
+        (0xFFFFFFFF00000000, 0x100000000),          # hi-lane wrap
+        (0x7FFFFFFFFFFFFFFF, 0x7FFFFFFFFFFFFFFF),
+    ]
+    a = _pack([c[0] for c in cases])
+    b = _pack([c[1] for c in cases])
+    assert _unpack(u.add(a, b)) == [(x + y) & MASK64 for x, y in cases]
+
+
+def test_ctz_clz_zero_lanes():
+    """Per-lane zero patterns: ctz/clz must handle hi=0, lo=0, and both
+    zero (-> 64) without the per-lane 32-count leaking through wrong."""
+    vals = [
+        0,                   # both lanes zero -> 64
+        1,                   # lo nonzero
+        0x80000000,          # lo's top bit
+        0x100000000,         # lo zero, hi nonzero -> ctz 32
+        0x8000000000000000,  # hi's top bit -> ctz 63, clz 0
+        0xFFFFFFFF,          # hi zero -> clz 32
+    ]
+    x = _pack(vals)
+    want_ctz = [64 if v == 0 else (v & -v).bit_length() - 1 for v in vals]
+    want_clz = [64 - v.bit_length() for v in vals]
+    assert list(np.asarray(u.ctz(x))) == want_ctz
+    assert list(np.asarray(u.clz(x))) == want_clz
+
+
+def test_compare_across_lanes():
+    """lt/eq must order by hi lane first — lo-lane magnitude is a decoy."""
+    a_vals = [0x100000000, 0x1FFFFFFFF, 0xFFFFFFFF, 5]
+    b_vals = [0xFFFFFFFF, 0x200000000, 0x100000000, 5]
+    a, b = _pack(a_vals), _pack(b_vals)
+    assert list(np.asarray(u.lt(a, b))) == [x < y for x, y in zip(a_vals, b_vals)]
+    assert list(np.asarray(u.eq(a, b))) == [x == y for x, y in zip(a_vals, b_vals)]
+
+
 def test_const_and_compare():
     assert _unpack(u.const(0xDEADBEEFCAFEBABE)) == [0xDEADBEEFCAFEBABE]
     a = _pack([5, 10, 10])

@@ -1,0 +1,1 @@
+# Repo tooling (graftlint, parity generators). Import path: tools.<name>.

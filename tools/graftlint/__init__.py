@@ -1,0 +1,14 @@
+"""graftlint: two-tier static analysis for the redisson_tpu engine.
+
+Tier A (`astlint`) is an AST pass over the source with rules G001-G005
+for the engine's real failure modes (int32 reduction overflow, implicit
+host syncs, jit recompilation hazards, u64 lane discipline, Pallas
+contracts). Tier B (`jaxpr_audit`) traces the public ops and audits the
+jaxprs for 64-bit leaks and reduction-crossing narrowing.
+
+CLI: ``python -m tools.graftlint`` (see cli.py). Programmatic use:
+``run_lint(paths)`` returns finding dicts.
+"""
+
+from .cli import collect as run_lint  # noqa: F401
+from .findings import RULES, Finding  # noqa: F401

@@ -1,0 +1,646 @@
+"""Tier A: AST rules G001-G005.
+
+All rules are heuristic pattern matches tuned to this codebase's real
+failure modes (see findings.RULES). Scope notes:
+
+* G002 (sync) only applies to dispatch-path files under
+  ``redisson_tpu/`` (engine.py, backend_tpu.py, parallel/, ingest/) —
+  unless the file was passed to the CLI explicitly, in which case every
+  rule applies (so scratch files get full coverage).
+* G004 is disabled inside ``ops/u64.py`` (that module IS the lane
+  discipline) and G004's big-literal check exempts arguments of u64
+  helper calls and module-level named-constant assignments.
+* G005 only fires in files that import ``jax.experimental.pallas``.
+
+Suppression: ``# graftlint: allow-<name>(reason)`` on the flagged line,
+anywhere within the flagged expression's line span, or on a standalone
+comment line directly above. ``<name>`` is a rule id (g001) or alias
+(int-reduce). The reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .findings import Finding, SUPPRESS_ALIASES
+
+INT_DTYPES = {"int8", "int16", "int32", "uint8", "uint16", "uint32"}
+DTYPES_64 = {"int64", "uint64", "float64"}
+REDUCERS = {"sum", "cumsum", "dot"}
+SYNC_CASTS = {"int", "bool", "float"}
+MASK32 = 0xFFFFFFFF
+
+#: alias targets treated as producing device values (G002) — anything
+#: under jax or this package's device-side modules...
+_DEVICE_PREFIXES = ("jax", "redisson_tpu")
+#: ...except the pure-host modules (python ints/floats in, out).
+_HOST_MODULES = ("redisson_tpu.ops.bloom_math", "redisson_tpu.ops.crc16")
+#: module paths whose u64 helpers make big literals legitimate call args
+_U64_MODULE = "redisson_tpu.ops.u64"
+_PALLAS_MODULE = "jax.experimental.pallas"
+
+_ITEM_RE = re.compile(r"allow-([A-Za-z0-9_-]+)\(([^)]*)\)")
+
+
+def _rel(path: str, repo_root: str | None) -> str:
+    p = os.path.abspath(path)
+    if repo_root:
+        root = os.path.abspath(repo_root)
+        if p.startswith(root + os.sep):
+            return os.path.relpath(p, root).replace(os.sep, "/")
+    return p.replace(os.sep, "/")
+
+
+class FileLinter:
+    def __init__(self, path: str, repo_root: str | None = None,
+                 explicit: bool = False, source: str | None = None):
+        self.path = path
+        self.relpath = _rel(path, repo_root)
+        self.explicit = explicit
+        if source is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.alias_modules: dict[str, str] = {}  # local name -> full module path
+        self.allows: dict[int, set[str]] = {}  # 1-based line -> rule ids
+        self.module_defs: dict[str, ast.FunctionDef] = {}
+
+    # -- entry -------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self.findings.append(Finding(
+                "G000", self.relpath, e.lineno or 1,
+                f"syntax error: {e.msg}", "fix the syntax error"))
+            return self.findings
+        self._collect_imports(tree)
+        self._collect_allows()
+        for name, node in (
+            (n.name, n) for n in tree.body if isinstance(n, ast.FunctionDef)
+        ):
+            self.module_defs[name] = node
+        self._g002_on = self.explicit or self._in_sync_scope()
+        self._g004_on = not self.relpath.endswith("ops/u64.py")
+        self._pallas_file = any(
+            full == _PALLAS_MODULE for full in self.alias_modules.values()
+        )
+        for stmt in tree.body:
+            self._rec(stmt, in_func=False, in_loop=False,
+                      const_exempt=False, fn_node=None, module_level=True)
+        if self._pallas_file:
+            self._check_pallas_dtypes(tree)
+        # dedupe identical (rule, line) hits (e.g. two lane shifts on one line)
+        seen, out = set(), []
+        for f in self.findings:
+            key = (f.rule, f.file, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        self.findings = out
+        return self.findings
+
+    # -- setup -------------------------------------------------------------
+
+    def _collect_imports(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    full = a.name if a.asname else a.name.split(".")[0]
+                    self.alias_modules[alias] = full
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    alias = a.asname or a.name
+                    self.alias_modules[alias] = f"{node.module}.{a.name}"
+
+    def _collect_allows(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            for name, reason in _ITEM_RE.findall(line):
+                rule = SUPPRESS_ALIASES.get(name.lower())
+                if rule and reason.strip():
+                    self.allows.setdefault(i, set()).add(rule)
+
+    def _in_sync_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        return (
+            sub in ("engine.py", "backend_tpu.py")
+            or sub.startswith("parallel/")
+            or sub.startswith("ingest/")
+        )
+
+    # -- alias helpers -----------------------------------------------------
+
+    def _full(self, name: str) -> str:
+        return self.alias_modules.get(name, "")
+
+    def _is_alias(self, node: ast.AST, full: str) -> bool:
+        return isinstance(node, ast.Name) and self._full(node.id) == full
+
+    def _is_jnp(self, node: ast.AST) -> bool:
+        return self._is_alias(node, "jax.numpy")
+
+    def _is_np(self, node: ast.AST) -> bool:
+        return self._is_alias(node, "numpy")
+
+    def _is_jax_attr(self, node: ast.AST, attr: str) -> bool:
+        return (isinstance(node, ast.Attribute) and node.attr == attr
+                and self._is_alias(node.value, "jax"))
+
+    def _is_device_root(self, node: ast.AST) -> bool:
+        """Is `node` a Name whose import target lives in device space?"""
+        if not isinstance(node, ast.Name):
+            return False
+        full = self._full(node.id)
+        if not full or not full.startswith(_DEVICE_PREFIXES):
+            return False
+        return not full.startswith(_HOST_MODULES)
+
+    def _contains_device_call(self, node: ast.AST) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                f = n.func
+                root = f
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if self._is_device_root(root):
+                    return True
+        return False
+
+    def _is_int_dtype(self, node: ast.AST | None) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Attribute) and node.attr in INT_DTYPES:
+            return True
+        if isinstance(node, ast.Constant) and node.value in INT_DTYPES:
+            return True
+        if isinstance(node, ast.Name) and node.id in INT_DTYPES:
+            return True
+        return False
+
+    # -- reporting ---------------------------------------------------------
+
+    def _allowed(self, rule: str, node: ast.AST) -> bool:
+        lo = getattr(node, "lineno", 1)
+        hi = getattr(node, "end_lineno", None) or lo
+        for ln in range(lo, hi + 1):
+            if rule in self.allows.get(ln, ()):
+                return True
+        prev = lo - 1
+        if prev >= 1 and prev <= len(self.lines):
+            if self.lines[prev - 1].lstrip().startswith("#"):
+                if rule in self.allows.get(prev, ()):
+                    return True
+        return False
+
+    def _emit(self, rule: str, node: ast.AST, message: str, hint: str) -> None:
+        if self._allowed(rule, node):
+            return
+        self.findings.append(
+            Finding(rule, self.relpath, getattr(node, "lineno", 1), message, hint)
+        )
+
+    # -- traversal ---------------------------------------------------------
+
+    def _rec(self, node, in_func, in_loop, const_exempt, fn_node,
+             module_level=False):
+        if isinstance(node, ast.Call):
+            self._check_g001(node)
+            if self._g002_on:
+                self._check_g002(node)
+            self._check_jit_construction(node, in_func, in_loop)
+            if self._pallas_file:
+                self._check_pallas_call(node, fn_node)
+            # big literals are fine as u64-helper arguments
+            f = node.func
+            root = f
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            arg_exempt = const_exempt or (
+                isinstance(root, ast.Name)
+                and (self._full(root.id) == _U64_MODULE
+                     or self._full(root.id).startswith(_U64_MODULE + "."))
+            )
+            self._rec(f, in_func, in_loop, const_exempt, fn_node)
+            for a in node.args:
+                self._rec(a, in_func, in_loop, arg_exempt, fn_node)
+            for kw in node.keywords:
+                self._rec(kw.value, in_func, in_loop, arg_exempt, fn_node)
+            return
+        if isinstance(node, ast.BinOp) and self._g004_on:
+            self._check_g004_binop(node)
+        elif isinstance(node, ast.Constant) and self._g004_on:
+            self._check_g004_const(node, const_exempt)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._check_g003_def(node)
+            for d in node.decorator_list:
+                self._rec(d, in_func, in_loop, const_exempt, fn_node)
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                self._rec(d, in_func, in_loop, const_exempt, fn_node)
+            for stmt in node.body:
+                self._rec(stmt, True, False, const_exempt, node)
+            return
+        elif isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            header = ([node.iter, node.target] if hasattr(node, "iter")
+                      else [node.test])
+            for h in header:
+                self._rec(h, in_func, in_loop, const_exempt, fn_node)
+            for stmt in node.body + node.orelse:
+                self._rec(stmt, in_func, True, const_exempt, fn_node)
+            return
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)) and module_level:
+            if isinstance(node, ast.Assign):
+                self._check_g003_module_jit_assign(node)
+            value = node.value
+            if value is not None:
+                # module-level named constants are the sanctioned home for
+                # big literals -> exempt from the G004 literal check
+                self._rec(value, in_func, in_loop, True, fn_node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._rec(child, in_func, in_loop, const_exempt, fn_node,
+                      module_level=module_level and isinstance(node, ast.Module))
+
+    # -- G001: unchunked integer reductions --------------------------------
+
+    def _check_g001(self, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr in REDUCERS):
+            return
+        base = f.value
+        if isinstance(base, ast.Name):
+            if self._is_jnp(base):
+                style = "jnp"
+            elif base.id in self.alias_modules:
+                return  # some other module (np.sum on host data, etc.)
+            else:
+                style = "method"
+        else:
+            style = "method"  # expr.sum()
+        # an explicit axis means a partial (positional-axis) reduction —
+        # the chunk-partials idiom itself looks like this
+        if any(kw.arg == "axis" for kw in call.keywords):
+            return
+        if style == "jnp" and len(call.args) >= 2:
+            return
+        if style == "method" and len(call.args) >= 1:
+            return
+        evidence = list(call.args)
+        if style == "method":
+            evidence.append(base)
+        if not self._int_evidence(evidence):
+            return
+        self._emit(
+            "G001", call,
+            f"full `{f.attr}` reduction over integer device data — int32 "
+            "accumulation wraps past 2^31",
+            "emit per-chunk partials (each bounded) and combine host-side in "
+            "64-bit, like ops/bitset.cardinality_partials + combine_partials; "
+            "if the total is provably bounded, add "
+            "`# graftlint: allow-int-reduce(reason)`",
+        )
+
+    def _int_evidence(self, roots: list[ast.AST]) -> bool:
+        for root in roots:
+            for n in ast.walk(root):
+                if isinstance(n, ast.keyword) and n.arg == "dtype":
+                    if self._is_int_dtype(n.value):
+                        return True
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "astype" and n.args and self._is_int_dtype(n.args[0]):
+                        return True
+                    if f.attr == "arange" and self._is_jnp(f.value):
+                        dt = next((kw.value for kw in n.keywords
+                                   if kw.arg == "dtype"), None)
+                        if dt is None or self._is_int_dtype(dt):
+                            return True
+                    if "partial" in f.attr and f.attr != "partial":
+                        return True
+                elif isinstance(f, ast.Name):
+                    if "partial" in f.id and f.id != "partial":
+                        return True
+        return False
+
+    # -- G002: implicit host syncs ------------------------------------------
+
+    def _check_g002(self, call: ast.Call) -> None:
+        f = call.func
+        label = None
+        target = None
+        if (isinstance(f, ast.Name) and f.id in SYNC_CASTS
+                and len(call.args) == 1 and f.id not in self.alias_modules):
+            label, target = f.id, call.args[0]
+        elif isinstance(f, ast.Attribute):
+            if f.attr == "item" and not call.args:
+                label, target = ".item", f.value
+            elif (f.attr in ("asarray", "array") and self._is_np(f.value)
+                    and call.args):
+                label, target = f"np.{f.attr}", call.args[0]
+        if target is None or not self._contains_device_call(target):
+            return
+        self._emit(
+            "G002", call,
+            f"`{label}(...)` on a device value — blocking device->host sync "
+            "in a dispatch path",
+            "stage the transfer (copy_to_host_async + Completer, see "
+            "backend_tpu._start_d2h) or keep the value on device; if the "
+            "sync is deliberate, add `# graftlint: allow-sync(reason)`",
+        )
+
+    # -- G003: recompilation hazards ----------------------------------------
+
+    def _jit_decorator_statics(self, dec: ast.AST):
+        """Return (is_jit, static_names, static_nums) for a decorator node."""
+        if self._is_jax_attr(dec, "jit"):
+            return True, set(), set()
+        if not isinstance(dec, ast.Call):
+            return False, set(), set()
+        f = dec.func
+        kws = None
+        if self._is_jax_attr(f, "jit"):
+            kws = dec.keywords
+        elif (isinstance(f, ast.Attribute) and f.attr == "partial"
+                and self._is_alias(f.value, "functools")
+                and dec.args and self._is_jax_attr(dec.args[0], "jit")):
+            kws = dec.keywords
+        if kws is None:
+            return False, set(), set()
+        return (True,) + self._parse_statics(kws)
+
+    @staticmethod
+    def _parse_statics(keywords):
+        names: set[str] = set()
+        nums: set[int] = set()
+        for kw in keywords:
+            if kw.arg == "static_argnames":
+                v = kw.value
+                items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for it in items:
+                    if isinstance(it, ast.Constant) and isinstance(it.value, str):
+                        names.add(it.value)
+            elif kw.arg == "static_argnums":
+                v = kw.value
+                items = v.elts if isinstance(v, (ast.Tuple, ast.List)) else [v]
+                for it in items:
+                    if isinstance(it, ast.Constant) and isinstance(it.value, int):
+                        nums.add(it.value)
+        return names, nums
+
+    @staticmethod
+    def _scalar_params(fn: ast.FunctionDef):
+        """Params whose annotation/default marks them as python scalars."""
+        out = []
+        params = list(fn.args.posonlyargs) + list(fn.args.args)
+        defaults = list(fn.args.defaults)
+        # align defaults with the tail of params
+        pad = [None] * (len(params) - len(defaults))
+        paired = list(zip(params, pad + defaults))
+        paired += [
+            (a, d) for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults)
+        ]
+        for pos, (arg, default) in enumerate(paired):
+            ann = arg.annotation
+            scalar = (
+                isinstance(ann, ast.Name) and ann.id in ("int", "str", "bool")
+            ) or (
+                isinstance(default, ast.Constant)
+                and isinstance(default.value, (int, str, bool))
+                and default.value is not None
+            )
+            if scalar:
+                out.append((pos, arg.arg, arg))
+        return out
+
+    def _check_g003_def(self, fn: ast.FunctionDef) -> None:
+        for dec in fn.decorator_list:
+            is_jit, names, nums = self._jit_decorator_statics(dec)
+            if is_jit:
+                self._report_nonstatic(fn, fn, names, nums)
+                return
+
+    def _check_g003_module_jit_assign(self, node: ast.Assign) -> None:
+        v = node.value
+        if not isinstance(v, ast.Call):
+            return
+        f = v.func
+        if not (self._is_jax_attr(f, "jit")
+                or (isinstance(f, ast.Attribute) and f.attr == "partial"
+                    and self._is_alias(f.value, "functools")
+                    and v.args and self._is_jax_attr(v.args[0], "jit"))):
+            return
+        # resolve jax.jit(local_fn, ...) to the module-level def
+        fn_args = v.args[1:] if not self._is_jax_attr(f, "jit") else v.args
+        if not fn_args or not isinstance(fn_args[0], ast.Name):
+            return
+        fn = self.module_defs.get(fn_args[0].id)
+        if fn is None:
+            return
+        names, nums = self._parse_statics(v.keywords)
+        self._report_nonstatic(node, fn, names, nums)
+
+    def _report_nonstatic(self, site, fn, names, nums) -> None:
+        for pos, pname, arg in self._scalar_params(fn):
+            if pname in names or pos in nums:
+                continue
+            self._emit(
+                "G003", site,
+                f"jit of `{fn.name}`: python-scalar param `{pname}` is "
+                "traced — every distinct value triggers a recompile",
+                f"add '{pname}' to static_argnames (or pass it as a device "
+                "array if it genuinely varies per call)",
+            )
+
+    def _check_jit_construction(self, call: ast.Call, in_func, in_loop) -> None:
+        if not (in_func or in_loop):
+            return
+        f = call.func
+        hazard = self._is_jax_attr(f, "jit") or (
+            isinstance(f, ast.Attribute) and f.attr == "partial"
+            and self._is_alias(f.value, "functools")
+            and call.args and self._is_jax_attr(call.args[0], "jit")
+        )
+        if hazard:
+            self._emit(
+                "G003", call,
+                "jax.jit constructed inside a function/loop — a fresh "
+                "compiled callable (and compile) per invocation",
+                "hoist the jitted callable to module level or cache it",
+            )
+
+    # -- G004: u64 lane discipline ------------------------------------------
+
+    def _check_g004_binop(self, node: ast.BinOp) -> None:
+        if not isinstance(node.op, (ast.LShift, ast.RShift, ast.Mult)):
+            return
+        opname = {ast.LShift: "<<", ast.RShift: ">>", ast.Mult: "*"}[type(node.op)]
+
+        def lane(side):
+            return isinstance(side, ast.Attribute) and side.attr in ("hi", "lo")
+
+        if lane(node.left) or lane(node.right):
+            self._emit(
+                "G004", node,
+                f"raw `{opname}` on a u64 lane (.hi/.lo) outside ops/u64.py "
+                "— cross-lane carries/shift spill are not handled",
+                "use the ops.u64 helpers (u.shl/u.shr/u.mul/u.mul32); for "
+                "exact intra-lane math add `# graftlint: allow-u64(reason)`",
+            )
+
+    def _check_g004_const(self, node: ast.Constant, exempt: bool) -> None:
+        if exempt or not isinstance(node.value, int) or isinstance(node.value, bool):
+            return
+        if node.value <= MASK32:
+            return
+        # only meaningful in device-code modules
+        if not any(full.startswith("jax") for full in self.alias_modules.values()):
+            return
+        self._emit(
+            "G004", node,
+            f"integer literal {node.value:#x} exceeds 2^32 in a jax module — "
+            "it cannot live in a single uint32 lane",
+            "split it via ops.u64 (u.const(...)) or hoist it to a named "
+            "module-level constant",
+        )
+
+    # -- G005: Pallas contracts ----------------------------------------------
+
+    def _check_pallas_call(self, call: ast.Call, fn_node) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "pallas_call"
+                and self._is_alias(f.value, _PALLAS_MODULE)):
+            return
+        kws = {kw.arg: kw.value for kw in call.keywords}
+        if "out_shape" not in kws and len(call.args) < 2:
+            self._emit(
+                "G005", call,
+                "pallas_call without an explicit out_shape",
+                "pass out_shape=jax.ShapeDtypeStruct(...)",
+            )
+        if "interpret" not in kws:
+            self._emit(
+                "G005", call,
+                "pallas_call without interpret= — kernels must run in "
+                "interpreter mode off-TPU (CPU tests)",
+                "pass interpret=_interpret() (see ops/pallas_kernels)",
+            )
+        grid_len, nsp = self._resolve_grid(call, kws, fn_node)
+        spec_roots = [kws.get("in_specs"), kws.get("out_specs")]
+        gs = self._resolve_value(kws.get("grid_spec"), fn_node)
+        if isinstance(gs, ast.Call):
+            gs_kws = {kw.arg: kw.value for kw in gs.keywords}
+            spec_roots += [gs_kws.get("in_specs"), gs_kws.get("out_specs")]
+        if grid_len is None:
+            return
+        expected = grid_len + nsp
+        for root in spec_roots:
+            if root is None:
+                continue
+            for n in ast.walk(root):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "BlockSpec"):
+                    continue
+                imap = next((kw.value for kw in n.keywords
+                             if kw.arg == "index_map"), None)
+                if imap is None and len(n.args) >= 2:
+                    imap = n.args[1]
+                if isinstance(imap, ast.Lambda):
+                    arity = len(imap.args.args)
+                    if arity != expected:
+                        self._emit(
+                            "G005", imap,
+                            f"BlockSpec index_map takes {arity} arg(s) but the "
+                            f"grid supplies {expected} (grid dims {grid_len} + "
+                            f"{nsp} scalar-prefetch)",
+                            "make the lambda arity match grid rank plus "
+                            "num_scalar_prefetch",
+                        )
+
+    def _resolve_value(self, node, fn_node):
+        """Follow a Name to its single local assignment, if trivially findable."""
+        if isinstance(node, ast.Name) and fn_node is not None:
+            for stmt in ast.walk(fn_node):
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == node.id):
+                    return stmt.value
+            return None
+        return node
+
+    def _resolve_grid(self, call, kws, fn_node):
+        """Return (grid_len | None, num_scalar_prefetch)."""
+        nsp = 0
+        grid = self._resolve_value(kws.get("grid"), fn_node)
+        gs = self._resolve_value(kws.get("grid_spec"), fn_node)
+        if isinstance(gs, ast.Call):
+            gs_kws = {kw.arg: kw.value for kw in gs.keywords}
+            n = gs_kws.get("num_scalar_prefetch")
+            if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                nsp = n.value
+            grid = self._resolve_value(gs_kws.get("grid"), fn_node)
+        if isinstance(grid, ast.Tuple):
+            return len(grid.elts), nsp
+        if grid is not None and not isinstance(grid, ast.Tuple):
+            return None, nsp  # unresolvable expression — don't guess
+        return None, nsp
+
+    def _check_pallas_dtypes(self, tree: ast.AST) -> None:
+        for n in ast.walk(tree):
+            if (isinstance(n, ast.Attribute) and n.attr in DTYPES_64
+                    and isinstance(n.value, ast.Name)
+                    and self._full(n.value.id) in ("jax.numpy", "numpy")):
+                self._emit(
+                    "G005", n,
+                    f"64-bit dtype `{n.attr}` referenced in a Pallas kernel "
+                    "module — TPU kernels are 32-bit-lane only",
+                    "express 64-bit quantities as uint32 (hi, lo) lanes "
+                    "(ops/u64)",
+                )
+
+
+# ---------------------------------------------------------------------------
+# directory driver
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths, repo_root=None):
+    """Lint every .py under `paths`. Files named directly get full rule
+    coverage; directory walks apply per-rule path scoping."""
+    findings: list[Finding] = []
+    linters: list[FileLinter] = []
+    for p in paths:
+        explicit = os.path.isfile(p)
+        for fpath in iter_py_files(p):
+            lt = FileLinter(fpath, repo_root=repo_root, explicit=explicit)
+            findings.extend(lt.run())
+            linters.append(lt)
+    return findings, linters

@@ -1,0 +1,45 @@
+"""Baseline (grandfathering) support.
+
+The committed baseline (tools/graftlint/baseline.json) lists fingerprints
+of findings that are accepted for now; matching findings are reported as
+"baselined" and don't affect the exit code. The repo ships an EMPTY
+baseline — the gate is zero new findings — but the mechanism lets a
+future large refactor land incrementally via `--update-baseline`.
+
+Fingerprints hash (rule, file, normalized source line) so edits elsewhere
+in the file don't invalidate entries; moving or editing the flagged line
+does, on purpose.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def load(path: str) -> set[str]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"] for e in data.get("findings", [])}
+
+
+def write(path: str, finding_dicts: list[dict]) -> None:
+    data = {
+        "version": 1,
+        "findings": [
+            {
+                "fingerprint": d["fingerprint"],
+                "rule": d["rule"],
+                "file": d["file"],
+                "note": d["message"],
+            }
+            for d in sorted(
+                finding_dicts, key=lambda d: (d["file"], d["rule"], d["line"])
+            )
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")

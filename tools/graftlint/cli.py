@@ -1,0 +1,88 @@
+"""graftlint CLI.
+
+    python -m tools.graftlint [paths ...] [--json] [--no-jaxpr]
+                              [--baseline FILE] [--update-baseline]
+
+Exit codes: 0 clean (or baselined-only), 1 findings, 2 internal error.
+Default target is the repo's ``redisson_tpu/`` tree with the committed
+baseline; Tier B (jaxpr audit) runs unless ``--no-jaxpr``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .astlint import lint_paths
+from .findings import RULES
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def collect(paths, jaxpr=True, repo_root=REPO_ROOT):
+    """Run both tiers; returns finding dicts (with fingerprints)."""
+    findings, linters = lint_paths(paths, repo_root=repo_root)
+    sources = {lt.relpath: lt.lines for lt in linters}
+    if jaxpr:
+        from .jaxpr_audit import run_audits
+
+        findings += run_audits()
+    out = []
+    for f in findings:
+        lines = sources.get(f.file, [])
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        out.append(f.to_dict(text))
+    out.sort(key=lambda d: (d["file"], d["line"], d["rule"]))
+    return out
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="AST + jaxpr static analysis for the redisson_tpu engine",
+    )
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO_ROOT, "redisson_tpu")],
+                    help="files/dirs to lint (default: redisson_tpu/)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--no-jaxpr", action="store_true",
+                    help="skip Tier B (jaxpr audit of ops/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered fingerprints")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write current findings to the baseline and exit 0")
+    args = ap.parse_args(argv)
+
+    try:
+        dicts = collect(args.paths, jaxpr=not args.no_jaxpr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"graftlint: internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        baseline_mod.write(args.baseline, dicts)
+        print(f"baseline updated: {len(dicts)} finding(s) -> {args.baseline}")
+        return 0
+
+    grandfathered = baseline_mod.load(args.baseline)
+    fresh = [d for d in dicts if d["fingerprint"] not in grandfathered]
+    baselined = [d for d in dicts if d["fingerprint"] in grandfathered]
+
+    if args.as_json:
+        print(json.dumps(
+            {"findings": fresh, "baselined": baselined}, indent=2))
+    else:
+        for d in fresh:
+            loc = f"{d['file']}:{d['line']}" if d["line"] else d["file"]
+            print(f"{loc}: {d['rule']} [{RULES[d['rule']][0] if d['rule'] in RULES else '?'}] {d['message']}")
+            if d["hint"]:
+                print(f"    hint: {d['hint']}")
+        print(f"{len(fresh)} finding(s), {len(baselined)} baselined")
+    return 1 if fresh else 0

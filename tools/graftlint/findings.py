@@ -1,0 +1,77 @@
+"""Finding model + rule registry for graftlint.
+
+Rule ids are stable (baseline fingerprints embed them). Tier A (AST) rules
+are G0xx; tier B (jaxpr) rules are J0xx. Each rule has a short alias usable
+in suppression comments: `# graftlint: allow-<alias>(reason)` — a reason is
+mandatory, an empty `allow-sync()` does not suppress.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str  # repo-relative (or absolute for out-of-repo scratch files)
+    line: int
+    message: str
+    hint: str = ""
+
+    def fingerprint(self, line_text: str = "") -> str:
+        """Baseline identity: rule + file + normalized source line (NOT the
+        line number, so unrelated edits above a grandfathered finding don't
+        invalidate the baseline)."""
+        blob = f"{self.rule}|{self.file}|{' '.join(line_text.split())}"
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def to_dict(self, line_text: str = "") -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "fingerprint": self.fingerprint(line_text),
+        }
+
+
+#: rule id -> (alias, one-line description)
+RULES = {
+    "G001": (
+        "int-reduce",
+        "unchunked int32/uint32 device reduction (jnp.sum/cumsum/dot on "
+        "integer data without the chunk-partials idiom)",
+    ),
+    "G002": (
+        "sync",
+        "implicit device->host sync (int()/bool()/float()/.item()/"
+        "np.asarray on a device value) in a dispatch path",
+    ),
+    "G003": (
+        "recompile",
+        "jit recompilation hazard (python-scalar params missing from "
+        "static_argnames, or jax.jit constructed per call)",
+    ),
+    "G004": (
+        "u64",
+        "u64 lane discipline: raw <</>>/* on uint32 (hi, lo) lanes or a "
+        ">2^32 literal outside ops/u64.py",
+    ),
+    "G005": (
+        "pallas",
+        "Pallas contract: pallas_call without interpret=/out_shape=, "
+        "BlockSpec index_map arity mismatch, or 64-bit dtype in a kernel",
+    ),
+    "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
+    "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
+    "J000": ("trace", "op failed to trace during the jaxpr audit"),
+}
+
+#: suppression-comment name -> rule id (both the id and the alias work)
+SUPPRESS_ALIASES = {}
+for _rid, (_alias, _) in RULES.items():
+    SUPPRESS_ALIASES[_rid.lower()] = _rid
+    SUPPRESS_ALIASES[_alias] = _rid
