@@ -184,7 +184,7 @@ def bench_end_to_end(n, reps):
             t0 = time.perf_counter()
             futs = [h.add_ints_async(b) for b in batches[1:]]
             for f in futs:
-                f.result()
+                f.result(timeout=120)
             dt = time.perf_counter() - t0
             rate = max(rate, (reps - 1) * n / dt)
         err = abs(h.count() - reps * n) / (reps * n)
@@ -277,7 +277,7 @@ def bench_device_ingest(jax, dev, n, reps):
             t0 = time.perf_counter()
             futs = [h.add_device_async(b) for b in batches[1:]]
             for f in futs:
-                f.result()
+                f.result(timeout=120)
             dt = time.perf_counter() - t0
             rate = max(rate, (reps - 1) * n / dt)
         err = abs(h.count() - reps * n) / (reps * n)

@@ -579,6 +579,102 @@ def config5(full: bool):
 CONFIGS = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
 
 
+def serve_smoke():
+    """Offered-load sweep through the QoS serving layer over a simulated
+    fixed-rate backend (no device): at each multiple of capacity, submit
+    paced ops for ~a second and report the shed rate plus p50/p99 *queueing*
+    delay (enqueue -> dispatch, measured at the backend off `op.enqueued_at`).
+    The expected shape: sheds appear only above 1x while admitted-op
+    queueing delay stays bounded by the configured budget — that bound is
+    what admission control buys."""
+    import threading
+
+    from redisson_tpu.config import ServeConfig
+    from redisson_tpu.executor import CommandExecutor
+    from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+    from redisson_tpu.serve import (AdaptiveBatchPolicy, CostModel,
+                                    RejectedError, ServingLayer)
+
+    cap_keys = 2_000_000  # simulated backend service rate, keys/s
+    op_keys = 1000
+    budget_s = 0.05
+
+    class SimBackend:
+        """Serves keys at a fixed rate; records per-op queueing delay."""
+
+        def __init__(self):
+            self.delays = []
+
+        def run(self, kind, target, ops):
+            now = time.monotonic()
+            self.delays.extend(now - op.enqueued_at for op in ops)
+            time.sleep(sum(max(1, op.nkeys) for op in ops) / cap_keys)
+            for op in ops:
+                op.future.set_result(op.nkeys)
+
+    print(f"# serve-smoke: simulated backend {cap_keys/1e6:.1f}M keys/s, "
+          f"{op_keys}-key ops, queue-delay budget {budget_s*1e3:.0f}ms",
+          file=sys.stderr)
+    print(f"{'load':>6} {'submitted':>9} {'shed%':>7} "
+          f"{'qd_p50_ms':>9} {'qd_p99_ms':>9}")
+    ok = True
+    for mult in (0.5, 1.0, 2.0, 4.0):
+        registry = MetricsRegistry()
+        cfg = ServeConfig(max_queue_ops=64, max_queue_delay_s=budget_s,
+                          default_timeout_ms=0, retry_attempts=0,
+                          max_linger_s=0.0005, min_batch_keys=op_keys)
+        backend = SimBackend()
+        policy = AdaptiveBatchPolicy(
+            CostModel(), max_linger_s=cfg.max_linger_s,
+            target_batch_service_s=cfg.target_batch_service_s,
+            min_batch_keys=cfg.min_batch_keys)
+        ex = CommandExecutor(backend, metrics=ExecutorMetrics(registry),
+                             policy=policy)
+        serve = ServingLayer(ex, cfg, registry=registry)
+        shed = [0]
+        other = [0]
+        lock = threading.Lock()
+
+        def on_done(f):
+            exc = f.exception()
+            if isinstance(exc, RejectedError):
+                with lock:
+                    shed[0] += 1
+            elif exc is not None:
+                with lock:
+                    other[0] += 1
+
+        offered_ops = cap_keys * mult / op_keys
+        interval = 1.0 / offered_ops
+        nsub = 0
+        next_t = time.monotonic()
+        t_end = next_t + 1.0
+        while time.monotonic() < t_end:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.002))
+                continue
+            next_t += interval
+            serve.execute_async("smoke", "hll_add", None,
+                                nkeys=op_keys).add_done_callback(on_done)
+            nsub += 1
+        serve.shutdown(timeout=10.0)
+        delays = np.array(backend.delays) if backend.delays else np.zeros(1)
+        p50, p99 = np.percentile(delays, [50, 99])
+        shed_pct = 100.0 * shed[0] / max(1, nsub)
+        print(f"{mult:>5.1f}x {nsub:>9} {shed_pct:>6.1f}% "
+              f"{p50*1e3:>9.2f} {p99*1e3:>9.2f}")
+        if other[0]:
+            print(f"#   {other[0]} op(s) failed with non-shed errors",
+                  file=sys.stderr)
+            ok = False
+        if p99 > 4 * budget_s:  # generous CI slack over the 50ms budget
+            print(f"#   p99 queueing delay {p99*1e3:.1f}ms blew the budget",
+                  file=sys.stderr)
+            ok = False
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -594,7 +690,13 @@ def main():
     ap.add_argument("--lint-smoke", action="store_true",
                     help="graftlint Tier A over the engine AND this bench "
                          "harness, then exit (nonzero on findings)")
+    ap.add_argument("--serve-smoke", action="store_true",
+                    help="QoS serving-layer offered-load sweep (p50/p99 "
+                         "queueing delay + shed rate), then exit")
     args = ap.parse_args()
+
+    if args.serve_smoke:
+        sys.exit(0 if serve_smoke() else 1)
 
     if args.lint_smoke:
         from tools.graftlint import run_lint

@@ -94,14 +94,10 @@ class RedissonTPU:
         self._routing = RoutingBackend(sketch)
         self._backend = self._routing
         self._widths = tuple(tcfg.key_width_buckets)
-        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+        from redisson_tpu.observability import MetricsRegistry
 
         self.metrics = MetricsRegistry()
-        self._executor = CommandExecutor(
-            self._routing, max_batch_keys=tcfg.max_batch_keys,
-            metrics=ExecutorMetrics(self.metrics),
-        )
-        self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        self._build_executor(self._routing, max_batch_keys=tcfg.max_batch_keys)
         self._pubsub = self._routing.pubsub
         self._watchdog = LockWatchdog(self._executor)
         self._eviction = EvictionScheduler(self._executor)
@@ -120,6 +116,45 @@ class RedissonTPU:
                 # threads when the first dial fails.
                 self.shutdown()
                 raise
+
+    def _build_executor(self, backend, max_batch_keys=None):
+        """Build the executor waist and, when `Config.serve` is set, the QoS
+        serving layer in front of it (shared by device and redis modes).
+
+        Sets `self._executor` (the raw waist — internal maintenance traffic:
+        lock watchdog renewals, eviction sweeps, durability flushes, which
+        must never be shed or deadline-expired) and `self._dispatch` (what
+        model getters bind to — the ServingLayer when configured, else the
+        raw executor)."""
+        from redisson_tpu.observability import ExecutorMetrics
+
+        scfg = self.config.serve
+        policy = None
+        if scfg is not None:
+            from redisson_tpu.serve import AdaptiveBatchPolicy, CostModel
+
+            policy = AdaptiveBatchPolicy(
+                CostModel(),
+                max_linger_s=scfg.max_linger_s,
+                target_batch_service_s=scfg.target_batch_service_s,
+                min_batch_keys=scfg.min_batch_keys,
+            )
+        kwargs = {}
+        if max_batch_keys is not None:
+            kwargs["max_batch_keys"] = max_batch_keys
+        self._executor = CommandExecutor(
+            backend, metrics=ExecutorMetrics(self.metrics), policy=policy,
+            **kwargs)
+        self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        if scfg is not None:
+            from redisson_tpu.serve import ServingLayer
+
+            self.serve = ServingLayer(self._executor, scfg,
+                                      registry=self.metrics)
+            self._dispatch = self.serve
+        else:
+            self.serve = None
+            self._dispatch = self._executor
 
     def _make_resp_pool(self):
         """Connection pool to the configured redis endpoint — shared by
@@ -212,7 +247,7 @@ class RedissonTPU:
 
     def _init_redis_mode(self):
         from redisson_tpu.interop.backend_redis import RedisBackend
-        from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+        from redisson_tpu.observability import MetricsRegistry
 
         self._resp = self._make_resp_pool()
         try:
@@ -233,9 +268,7 @@ class RedissonTPU:
         self._store = None
         self._widths = (16, 32, 64, 128, 256)
         self.metrics = MetricsRegistry()
-        self._executor = CommandExecutor(
-            self._backend, metrics=ExecutorMetrics(self.metrics))
-        self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        self._build_executor(self._backend)
         # Observability for the blocking-pop silent-loss window (reply
         # window expires exactly as the server pops, or a mid-reply drop
         # forces a re-drive — r2 advisor finding): per-backend-instance so
@@ -415,33 +448,36 @@ class RedissonTPU:
     # -- sketch objects (the TPU tier) --------------------------------------
 
     def get_hyper_log_log(self, name: str, codec=None) -> RHyperLogLog:
-        return RHyperLogLog(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RHyperLogLog(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_bit_set(self, name: str) -> RBitSet:
-        return RBitSet(name, self._executor, self._codec, self._widths)
+        return RBitSet(name, self._dispatch, self._codec, self._widths)
 
     def get_bloom_filter(self, name: str, codec=None) -> RBloomFilter:
-        return RBloomFilter(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RBloomFilter(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
-    def create_batch(self) -> RBatch:
-        return RBatch(self._executor, self._codec, self._widths)
+    def create_batch(self, **submit_kwargs) -> RBatch:
+        """submit_kwargs (serving-layer mode: tenant= / timeout_s= /
+        deadline=) budget the whole pipeline as one admission unit."""
+        return RBatch(self._dispatch, self._codec, self._widths,
+                      **submit_kwargs)
 
     # -- structure objects (the long-tail tier) -----------------------------
 
     def get_bucket(self, name: str, codec=None) -> RBucket:
-        return RBucket(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RBucket(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_buckets(self, codec=None) -> RBuckets:
-        return RBuckets(self._executor, self._resolve_codec(codec))
+        return RBuckets(self._dispatch, self._resolve_codec(codec))
 
     def get_atomic_long(self, name: str) -> RAtomicLong:
-        return RAtomicLong(name, self._executor, self._codec, self._widths)
+        return RAtomicLong(name, self._dispatch, self._codec, self._widths)
 
     def get_atomic_double(self, name: str) -> RAtomicDouble:
-        return RAtomicDouble(name, self._executor, self._codec, self._widths)
+        return RAtomicDouble(name, self._dispatch, self._codec, self._widths)
 
     def get_map(self, name: str, codec=None) -> RMap:
-        return RMap(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RMap(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_map_cache(self, name: str, codec=None) -> RMapCache:
         if self._mode == "redis":
@@ -451,64 +487,64 @@ class RedissonTPU:
             self._eviction.schedule(name, cache.evict_expired)
             return cache
         return RMapCache(
-            name, self._executor, self._resolve_codec(codec), self._widths,
+            name, self._dispatch, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,
         )
 
     def get_set(self, name: str, codec=None) -> RSet:
-        return RSet(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RSet(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_set_cache(self, name: str, codec=None) -> RSetCache:
         return RSetCache(
-            name, self._executor, self._resolve_codec(codec), self._widths,
+            name, self._dispatch, self._resolve_codec(codec), self._widths,
             eviction_scheduler=self._eviction,
         )
 
     def get_list(self, name: str, codec=None) -> RList:
-        return RList(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RList(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_queue(self, name: str, codec=None) -> RQueue:
-        return RQueue(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RQueue(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_deque(self, name: str, codec=None) -> RDeque:
-        return RDeque(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RDeque(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_blocking_queue(self, name: str, codec=None) -> RBlockingQueue:
-        return RBlockingQueue(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RBlockingQueue(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_blocking_deque(self, name: str, codec=None) -> RBlockingDeque:
-        return RBlockingDeque(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RBlockingDeque(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_sorted_set(self, name: str, codec=None, key: Optional[Callable] = None) -> RSortedSet:
         return RSortedSet(
-            name, self._executor, self._resolve_codec(codec), self._widths, key=key,
+            name, self._dispatch, self._resolve_codec(codec), self._widths, key=key,
             guard_lock=self.get_lock(name + "__sortedset_guard"),
         )
 
     def get_scored_sorted_set(self, name: str, codec=None) -> RScoredSortedSet:
-        return RScoredSortedSet(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RScoredSortedSet(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_lex_sorted_set(self, name: str) -> RLexSortedSet:
-        return RLexSortedSet(name, self._executor, self._codec, self._widths)
+        return RLexSortedSet(name, self._dispatch, self._codec, self._widths)
 
     def get_set_multimap(self, name: str, codec=None) -> RSetMultimap:
-        return RSetMultimap(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RSetMultimap(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_list_multimap(self, name: str, codec=None) -> RListMultimap:
-        return RListMultimap(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RListMultimap(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_set_multimap_cache(self, name: str, codec=None):
         from redisson_tpu.models.multimap import RSetMultimapCache
 
-        return RSetMultimapCache(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RSetMultimapCache(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_list_multimap_cache(self, name: str, codec=None):
         from redisson_tpu.models.multimap import RListMultimapCache
 
-        return RListMultimapCache(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RListMultimapCache(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_geo(self, name: str, codec=None) -> RGeo:
-        return RGeo(name, self._executor, self._resolve_codec(codec), self._widths)
+        return RGeo(name, self._dispatch, self._resolve_codec(codec), self._widths)
 
     def get_topic(self, name: str, codec=None) -> RTopic:
         if self._mode == "redis":
@@ -516,7 +552,7 @@ class RedissonTPU:
 
             _, pubsub, _ = self._redis_coordination()
             return RedisTopic(name, self._resp, pubsub, self._resolve_codec(codec))
-        return RTopic(name, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
+        return RTopic(name, self._dispatch, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     def get_pattern_topic(self, pattern: str, codec=None) -> RPatternTopic:
         if self._mode == "redis":
@@ -524,7 +560,7 @@ class RedissonTPU:
 
             _, pubsub, _ = self._redis_coordination()
             return RedisPatternTopic(pattern, self._resp, pubsub, self._resolve_codec(codec))
-        return RPatternTopic(pattern, self._executor, self._resolve_codec(codec), self._require_pubsub("topics"))
+        return RPatternTopic(pattern, self._dispatch, self._resolve_codec(codec), self._require_pubsub("topics"))
 
     # -- coordination -------------------------------------------------------
 
@@ -541,7 +577,7 @@ class RedissonTPU:
 
             scripts, pubsub, watchdog = self._redis_coordination()
             return RedisLock(name, scripts, pubsub, self.id, watchdog)
-        return RLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
+        return RLock(name, self._dispatch, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_fair_lock(self, name: str) -> RFairLock:
         if self._mode == "redis":
@@ -549,7 +585,7 @@ class RedissonTPU:
 
             scripts, pubsub, watchdog = self._redis_coordination()
             return RedisFairLock(name, scripts, pubsub, self.id, watchdog)
-        return RFairLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
+        return RFairLock(name, self._dispatch, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_read_write_lock(self, name: str) -> RReadWriteLock:
         if self._mode == "redis":
@@ -557,7 +593,7 @@ class RedissonTPU:
 
             scripts, pubsub, watchdog = self._redis_coordination()
             return RedisReadWriteLock(name, scripts, pubsub, self.id, watchdog)
-        return RReadWriteLock(name, self._executor, self._require_pubsub("locks"), self.id, self._watchdog)
+        return RReadWriteLock(name, self._dispatch, self._require_pubsub("locks"), self.id, self._watchdog)
 
     def get_multi_lock(self, *locks: RLock) -> RMultiLock:
         return RMultiLock(*locks)
@@ -568,7 +604,7 @@ class RedissonTPU:
 
             scripts, pubsub, _ = self._redis_coordination()
             return RedisSemaphore(name, scripts, pubsub)
-        return RSemaphore(name, self._executor, self._require_pubsub("semaphores"))
+        return RSemaphore(name, self._dispatch, self._require_pubsub("semaphores"))
 
     def get_count_down_latch(self, name: str) -> RCountDownLatch:
         if self._mode == "redis":
@@ -576,7 +612,7 @@ class RedissonTPU:
 
             scripts, pubsub, _ = self._redis_coordination()
             return RedisCountDownLatch(name, scripts, pubsub)
-        return RCountDownLatch(name, self._executor, self._require_pubsub("latches"))
+        return RCountDownLatch(name, self._dispatch, self._require_pubsub("latches"))
 
     def get_script(self):
         """Atomic scripting: python functions over the structure engine in
@@ -588,7 +624,7 @@ class RedissonTPU:
             return RedisScript(self._resp, self._codec)
         from redisson_tpu.models.script import RScript
 
-        return RScript(self._executor)
+        return RScript(self._dispatch)
 
     # -- bucket batch helpers (RedissonClient.java:174-192) -----------------
 
@@ -667,18 +703,18 @@ class RedissonTPU:
     # -- keys facade (RKeys analogue) ---------------------------------------
 
     def get_keys(self) -> RKeys:
-        return RKeys(self._executor, self._routing)
+        return RKeys(self._dispatch, self._routing)
 
     def keys(self, pattern: str = "*"):
-        return self._executor.execute_sync("", "keys", {"pattern": pattern})
+        return self._dispatch.execute_sync("", "keys", {"pattern": pattern})
 
     def flushall(self):
         # Routed through the executor so it serializes with in-flight ops on
         # the dispatcher thread (no mid-kernel store mutation).
-        self._executor.execute_sync("", "flushall", None)
+        self._dispatch.execute_sync("", "flushall", None)
 
     def delete(self, name: str) -> bool:
-        return self._executor.execute_sync(name, "delete", None)
+        return self._dispatch.execute_sync(name, "delete", None)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -732,7 +768,12 @@ class RedissonTPU:
             self._eviction.shutdown()
         if self._watchdog is not None:
             self._watchdog.shutdown()
-        self._executor.shutdown()
+        if getattr(self, "serve", None) is not None:
+            # Closes the retry timer first (pending retries resolve through
+            # the executor's drain-then-reject), then the executor itself.
+            self.serve.shutdown()
+        else:
+            self._executor.shutdown()
         sketch = getattr(getattr(self, "_routing", None), "sketch", None)
         completer = getattr(sketch, "completer", None)
         if completer is not None:

@@ -116,11 +116,48 @@ class RedisConfig:
 
 
 @dataclass
+class ServeConfig:
+    """QoS serving layer (redisson_tpu/serve/) in front of the executor.
+
+    Orthogonal to the backend mode (like flush_interval_s): any compute
+    tier can sit behind admission control. Maps the reference's L2 knobs —
+    `retryAttempts`, `retryInterval`, `timeout` (BaseConfig.java:27-86) —
+    plus the admission/batching knobs the reference lacks (see PARITY.md).
+    """
+
+    # -- admission ----------------------------------------------------------
+    # Per-tenant token-bucket rate in keys/sec (0 = unlimited). A tenant is
+    # whatever string the caller passes ("" = the default tenant);
+    # tenant_rates/tenant_bursts override per name.
+    default_tenant_rate: float = 0.0
+    default_tenant_burst: float = 0.0  # 0 = one second's worth of rate
+    tenant_rates: Dict[str, float] = field(default_factory=dict)
+    tenant_bursts: Dict[str, float] = field(default_factory=dict)
+    # Bounded global queue: shed on depth high-watermark, or once the cost
+    # model estimates queueing delay past the budget (0 = depth-only).
+    max_queue_ops: int = 10000
+    max_queue_delay_s: float = 0.0
+    # -- adaptive batching --------------------------------------------------
+    max_linger_s: float = 0.002  # hold a batch open at most this long
+    target_batch_service_s: float = 0.005  # size batches to this service time
+    min_batch_keys: int = 4096
+    # -- deadlines / retry / breaker (reference BaseConfig analogues) -------
+    default_timeout_ms: int = 3000  # BaseConfig.timeout; 0 = no deadline
+    retry_attempts: int = 3  # BaseConfig.retryAttempts (retries, not tries)
+    retry_interval_ms: int = 50  # BaseConfig.retryInterval (base backoff)
+    breaker_failure_threshold: int = 5
+    breaker_reset_timeout_ms: int = 1000
+    breaker_half_open_probes: int = 1
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
     pod: Optional[PodConfig] = None
     redis: Optional[RedisConfig] = None
+    # QoS serving layer (None = raw executor, the seed behavior).
+    serve: Optional[ServeConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -157,6 +194,10 @@ class Config:
         self.redis = self.redis or RedisConfig()
         return self.redis
 
+    def use_serve(self) -> "ServeConfig":
+        self.serve = self.serve or ServeConfig()
+        return self.serve
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -186,6 +227,7 @@ class Config:
             "tpu": TpuConfig,
             "pod": PodConfig,
             "redis": RedisConfig,
+            "serve": ServeConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

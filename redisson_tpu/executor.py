@@ -14,6 +14,16 @@ Dispatch model (the TPU analogue of the reference's pipelining):
   * a single dispatcher thread (the "event loop") drains queues, coalescing
     consecutive same-kind key-batch ops on one object into a single padded
     device call (`CommandBatchService`-style batching, but implicit);
+  * batching decisions are delegated to a policy object: the default
+    `GreedyBatchPolicy` reproduces the seed behavior (drain until the key
+    cap, never wait); the serving layer installs
+    `serve.policy.AdaptiveBatchPolicy`, which sizes batches from an online
+    cost model and holds a batch open up to min(deadline slack, max_linger)
+    so small-op tenants are not starved by bulk ingest;
+  * ops may carry an absolute `deadline`; expired ops complete with
+    `DeadlineExceeded` *before* device dispatch (they never reach
+    `backend.run`), so a caller's latency budget bounds queueing, not just
+    service;
   * results complete `concurrent.futures.Future`s in submission order per
     object; `execute_sync` blocks on the future like the reference's sync
     facade blocks on its latch (`CommandAsyncService.java:86-105`).
@@ -33,7 +43,9 @@ import time
 from collections import deque
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from redisson_tpu.serve.errors import DeadlineExceeded
 
 # Op kinds that may coalesce with the previous op of the same kind+target.
 COALESCABLE = {"hll_add", "bloom_add", "bitset_set", "bitset_clear", "bitset_get", "bloom_contains"}
@@ -51,6 +63,29 @@ class Op:
     future: Future = field(default_factory=Future)
     index: int = field(default_factory=lambda: next(_op_counter))
     nkeys: int = 0  # number of key lanes this op contributed (for slicing)
+    tenant: str = ""  # admission identity ("" = the default tenant)
+    deadline: Optional[float] = None  # absolute executor-clock time, or None
+    enqueued_at: float = 0.0  # executor-clock time of enqueue (QoS delay)
+
+
+class GreedyBatchPolicy:
+    """The seed dispatch behavior as a policy object: drain whatever is
+    queued up to the key cap, never hold a batch open. The serving layer
+    swaps in `serve.policy.AdaptiveBatchPolicy`; everything else runs this.
+    """
+
+    def batch_key_limit(self, kind: str, default_cap: int) -> int:
+        return default_cap
+
+    def linger_s(self, kind: str, keys: int, cap: int,
+                 run: Sequence[Op], now: float) -> float:
+        return 0.0
+
+    def observe(self, kind: str, nkeys: int, seconds: float) -> None:
+        pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"policy": "greedy"}
 
 
 class CommandExecutor:
@@ -61,10 +96,13 @@ class CommandExecutor:
     same-kind ops; others receive singletons.
     """
 
-    def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None):
+    def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None,
+                 policy=None, clock: Callable[[], float] = None):
         self._backend = backend
         self._max_batch_keys = max_batch_keys
         self._metrics = metrics  # ExecutorMetrics or None (zero-cost when off)
+        self._policy = policy or GreedyBatchPolicy()
+        self._clock = clock or time.monotonic
         # Kinds the backend coalesces across *different* targets (e.g. the
         # pod backend's bank insert, where the device call carries a per-key
         # target row). Per-target FIFO is preserved: only queue heads join.
@@ -85,30 +123,58 @@ class CommandExecutor:
         capability introspection (e.g. BLOOM_STRICT_MOD)."""
         return self._backend
 
+    @property
+    def policy(self):
+        """The live batch policy (greedy unless the serving layer installed
+        an adaptive one)."""
+        return self._policy
+
     # -- submission ---------------------------------------------------------
 
-    def execute_async(self, target: str, kind: str, payload: Any, nkeys: int = 0) -> Future:
-        op = Op(target=target, kind=kind, payload=payload, nkeys=nkeys)
+    def execute_async(self, target: str, kind: str, payload: Any,
+                      nkeys: int = 0, tenant: str = "",
+                      deadline: Optional[float] = None) -> Future:
+        op = Op(target=target, kind=kind, payload=payload, nkeys=nkeys,
+                tenant=tenant, deadline=deadline)
         with self._cv:
-            if self._shutdown:
-                # Drain-then-reject: ops already queued at shutdown() still
-                # run, but a submission racing shutdown gets a *failed
-                # future* — raising here would surface as an unhandled
-                # exception in whatever background thread submitted (the
-                # reference's shutdown latch rejects the same way,
-                # `MasterSlaveConnectionManager.java:651-662`).
-                op.future.set_exception(RuntimeError("executor is shut down"))
-                return op.future
-            q = self._queues.get(target)
-            if q is None:
-                q = self._queues[target] = deque()
-            if not q:
-                self._ready.append(target)
-            q.append(op)
+            self._enqueue_locked(op)
             self._cv.notify()
         return op.future
 
+    def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]],
+                     tenant: str = "",
+                     deadline: Optional[float] = None) -> List[Future]:
+        """Enqueue a pre-staged op list under ONE lock acquisition (the
+        RBatch dispatch path): per-target FIFO order follows list order, and
+        the whole batch shares one tenant + deadline budget."""
+        ops = [Op(target=t, kind=k, payload=p, nkeys=n, tenant=tenant,
+                  deadline=deadline) for (t, k, p, n) in staged]
+        with self._cv:
+            for op in ops:
+                self._enqueue_locked(op)
+            self._cv.notify()
+        return [op.future for op in ops]
+
+    def _enqueue_locked(self, op: Op) -> None:
+        if self._shutdown:
+            # Drain-then-reject: ops already queued at shutdown() still
+            # run, but a submission racing shutdown gets a *failed
+            # future* — raising here would surface as an unhandled
+            # exception in whatever background thread submitted (the
+            # reference's shutdown latch rejects the same way,
+            # `MasterSlaveConnectionManager.java:651-662`).
+            op.future.set_exception(RuntimeError("executor is shut down"))
+            return
+        q = self._queues.get(op.target)
+        if q is None:
+            q = self._queues[op.target] = deque()
+        if not q:
+            self._ready.append(op.target)
+        op.enqueued_at = self._clock()
+        q.append(op)
+
     def execute_sync(self, target: str, kind: str, payload: Any, nkeys: int = 0):
+        # graftlint: allow-g006(sync facade: blocks exactly like the reference's CommandSyncExecutor latch; serve-mode callers get deadline-bounded waits via the serving layer)
         return self.execute_async(target, kind, payload, nkeys).result()
 
     def queue_depth(self) -> int:
@@ -119,73 +185,170 @@ class CommandExecutor:
     # -- dispatcher ---------------------------------------------------------
 
     def _loop(self):
-        while True:
-            with self._cv:
-                while not self._ready and not self._shutdown:
-                    self._cv.wait()
-                if self._shutdown and not self._ready:
-                    return
-                target = self._ready.popleft()
-                q = self._queues[target]
-                run = [q.popleft()]
-                kind = run[0].kind
-                if kind in COALESCABLE:
-                    keys = run[0].nkeys
-                    while (
-                        q
-                        and q[0].kind == kind
-                        and keys + q[0].nkeys <= self._max_batch_keys
-                    ):
-                        op = q.popleft()
-                        keys += op.nkeys
-                        run.append(op)
-                if kind in self._global_kinds:
-                    keys = sum(op.nkeys for op in run)
-                    for other in list(self._ready):
-                        if keys >= self._max_batch_keys:
-                            break
-                        oq = self._queues[other]
-                        while (
-                            oq
-                            and oq[0].kind == kind
-                            and keys + oq[0].nkeys <= self._max_batch_keys
-                        ):
-                            op = oq.popleft()
-                            keys += op.nkeys
-                            run.append(op)
-                        if not oq:
-                            self._ready.remove(other)
-                            del self._queues[other]
-                if q:
-                    self._ready.append(target)
-                else:
-                    del self._queues[target]
-            m = self._metrics
-            t0 = time.monotonic() if m else 0.0
-            try:
-                self._backend.run(kind, target, run)
-                if m:
-                    m.record_batch(kind, len(run),
-                                   sum(op.nkeys for op in run),
-                                   time.monotonic() - t0)
-            except Exception as exc:  # complete, never kill the loop
-                if m:
-                    m.record_error(kind)
-                for op in run:
-                    if not op.future.done():
-                        op.future.set_exception(exc)
+        try:
+            while True:
+                with self._cv:
+                    while not self._ready and not self._shutdown:
+                        self._cv.wait()
+                    if not self._ready:  # shutdown with an empty keyspace
+                        return
+                    kind, target, run = self._collect_run_locked()
+                self._dispatch(kind, target, run)
+        finally:
+            # The dispatcher is the only thread that resolves queued ops; if
+            # it exits for ANY reason (clean shutdown drain or an unexpected
+            # BaseException), sweep whatever is still queued so no waiter
+            # blocks forever on a future nobody will complete.
+            self._cancel_remaining()
 
-    def shutdown(self, wait: bool = True):
+    def _collect_run_locked(self) -> Tuple[str, str, List[Op]]:
+        """Pop the next run: per-target coalesce + policy linger + the
+        cross-target steal for global kinds. Caller holds the lock."""
+        target = self._ready.popleft()
+        q = self._queues[target]
+        run = [q.popleft()]
+        kind = run[0].kind
+        cap = min(self._max_batch_keys,
+                  max(run[0].nkeys,
+                      int(self._policy.batch_key_limit(kind, self._max_batch_keys))))
+        keys = run[0].nkeys
+        if kind in COALESCABLE:
+            keys = self._drain_same_kind(q, kind, run, keys, cap)
+            # Adaptive linger: the policy may hold the batch open for late
+            # arrivals (deadline-slack-bounded). cv.wait releases the lock,
+            # so submitters keep appending; every wake re-drains. Greedy
+            # returns 0.0 and this loop never waits.
+            while not self._shutdown and keys < cap:
+                wait_s = self._policy.linger_s(kind, keys, cap, run, self._clock())
+                if wait_s <= 0.0:
+                    break
+                self._cv.wait(wait_s)
+                keys = self._drain_same_kind(q, kind, run, keys, cap)
+        if kind in self._global_kinds:
+            keys = sum(op.nkeys for op in run)
+            # Steal queue heads of the same kind from other targets. Mutate
+            # _ready/_queues only AFTER the scan — removing entries while
+            # walking a snapshot of the round-robin is how targets get
+            # dropped (satellite regression: test_serve.py interleave test).
+            emptied: List[str] = []
+            for other in list(self._ready):
+                if keys >= cap:
+                    break
+                if other == target:
+                    # A linger-time submitter can re-add `target` itself to
+                    # the round-robin; its queue is the tail logic's problem.
+                    continue
+                oq = self._queues[other]
+                while (
+                    oq
+                    and oq[0].kind == kind
+                    and keys + oq[0].nkeys <= cap
+                ):
+                    op = oq.popleft()
+                    keys += op.nkeys
+                    run.append(op)
+                if not oq:
+                    emptied.append(other)
+            for other in emptied:
+                self._ready.remove(other)
+                del self._queues[other]
+        # The linger wait releases the lock, so a submitter who found the
+        # drained queue empty has re-added `target` to the round-robin —
+        # dedupe, or the next pop dispatches a deleted/empty queue.
+        in_ready = target in self._ready
+        if q:
+            if not in_ready:
+                self._ready.append(target)
+        else:
+            if in_ready:
+                self._ready.remove(target)
+            del self._queues[target]
+        return kind, target, run
+
+    @staticmethod
+    def _drain_same_kind(q: deque, kind: str, run: List[Op], keys: int,
+                         cap: int) -> int:
+        while q and q[0].kind == kind and keys + q[0].nkeys <= cap:
+            op = q.popleft()
+            keys += op.nkeys
+            run.append(op)
+        return keys
+
+    def _dispatch(self, kind: str, target: str, run: List[Op]) -> None:
+        m = self._metrics
+        now = self._clock()
+        # Deadline propagation: expired ops complete with DeadlineExceeded
+        # and NEVER reach backend.run — by this point the op has already
+        # missed its budget, so burning device time on it only delays the
+        # ops behind it (the reference's response-timeout fires the same
+        # way, before a retry re-sends).
+        live: List[Op] = []
+        n_expired = 0
+        for op in run:
+            if op.deadline is not None and op.deadline <= now:
+                n_expired += 1
+                if not op.future.done():
+                    op.future.set_exception(DeadlineExceeded(
+                        f"op {kind}@{op.target or target}: deadline passed "
+                        f"{now - op.deadline:.6f}s before dispatch"))
+            else:
+                live.append(op)
+        if n_expired and m:
+            m.record_expired(kind, n_expired)
+        if not live:
+            return
+        nkeys = sum(op.nkeys for op in live)
+        t0 = self._clock()
+        try:
+            self._backend.run(kind, target, live)
+            dt = self._clock() - t0
+            self._policy.observe(kind, nkeys, dt)
+            if m:
+                m.record_batch(
+                    kind, len(live), nkeys, dt,
+                    queue_delay_s=t0 - min(op.enqueued_at for op in live),
+                    cap=self._max_batch_keys)
+        except Exception as exc:  # complete, never kill the loop
+            if m:
+                m.record_error(kind)
+            for op in live:
+                if not op.future.done():
+                    op.future.set_exception(exc)
+
+    def _cancel_remaining(self) -> None:
+        """Drain every queue and cancel the stranded ops' futures, so
+        `result()` raises CancelledError instead of hanging forever after
+        the dispatcher is gone (shutdown satellite fix)."""
+        with self._cv:
+            pending = [op for q in self._queues.values() for op in q]
+            self._queues.clear()
+            self._ready.clear()
+        cancelled = 0
+        for op in pending:
+            if op.future.cancel():
+                op.future.set_running_or_notify_cancel()
+                cancelled += 1
+        if cancelled and self._metrics:
+            self._metrics.record_cancelled(cancelled)
+
+    def shutdown(self, wait: bool = True, timeout: float = 30.0):
         with self._cv:
             self._shutdown = True
             self._cv.notify_all()
         if wait:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # Dispatcher wedged inside backend.run past the join budget:
+                # the in-flight run belongs to the backend, but everything
+                # still queued behind it would hang its waiters forever —
+                # cancel those now. (A clean drain leaves the queues empty
+                # and this is a no-op.)
+                self._cancel_remaining()
 
     # -- batch facade -------------------------------------------------------
 
-    def batch(self) -> "BatchCollector":
-        return BatchCollector(self)
+    def batch(self, **submit_kwargs) -> "BatchCollector":
+        return BatchCollector(self, **submit_kwargs)
 
 
 class BatchCollector:
@@ -196,10 +359,14 @@ class BatchCollector:
     results by global index (`:163-174`). Here the executor's queues are the
     pipelines; we hold ops back until execute() so the collect phase does no
     I/O, then submit in index order and gather results in the same order.
+
+    `submit_kwargs` (tenant / deadline / timeout, serving-layer mode) apply
+    to the WHOLE batch at dispatch time: one admission decision, one budget.
     """
 
-    def __init__(self, executor: CommandExecutor):
+    def __init__(self, executor, **submit_kwargs):
         self._executor = executor
+        self._submit_kwargs = submit_kwargs
         self._staged: List[tuple] = []
         self._futures: List["StagedFuture"] = []
         self._executed = False
@@ -219,9 +386,9 @@ class BatchCollector:
         self._executed = True
         for f in self._futures:
             f._dispatched = True
-        inner = [
-            self._executor.execute_async(t, k, p, n) for (t, k, p, n) in self._staged
-        ]
+        # One submission for the whole pipeline: the executor (or serving
+        # layer) admits and deadline-stamps the batch as a unit.
+        inner = self._executor.execute_many(self._staged, **self._submit_kwargs)
         for staged, src in zip(self._futures, inner):
             src.add_done_callback(staged._resolve_from)
         return inner
@@ -236,7 +403,9 @@ class BatchCollector:
         inner = self._dispatch()
         for f in inner:
             # Propagate the first failure like the reference's batch promise.
+            # graftlint: allow-g006(RBatch.execute is the blocking facade — the dispatcher resolves these in submission order, and serve-mode batches carry a deadline that bounds the wait)
             f.result()
+        # graftlint: allow-g006(same blocking-facade contract as the loop above; inner futures are already resolved here)
         return [f.outermost().result() for f in self._futures]
 
     def execute_async(self) -> List[Future]:
@@ -277,6 +446,7 @@ class StagedFuture(Future):
         if exc is not None:
             self.set_exception(exc)
         else:
+            # graftlint: allow-g006(done-callback context: src is already resolved, result() cannot block)
             self.set_result(src.result())
 
     def _note_mapped(self, fut: Future) -> None:

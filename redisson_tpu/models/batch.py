@@ -43,8 +43,11 @@ class _StagingExecutor:
 
 
 class RBatch:
-    def __init__(self, executor, codec, key_width_buckets):
-        self._collector = executor.batch()
+    def __init__(self, executor, codec, key_width_buckets, **submit_kwargs):
+        # submit_kwargs (tenant / timeout_s / deadline, serving-layer mode)
+        # bind at dispatch: ONE admission decision and one deadline budget
+        # for the whole pipeline, not one per staged op.
+        self._collector = executor.batch(**submit_kwargs)
         self._staging = _StagingExecutor(self._collector)
         self._codec = codec
         self._widths = key_width_buckets

@@ -272,7 +272,8 @@ class ExecutorMetrics:
         self.registry = registry or MetricsRegistry()
 
     def record_batch(self, kind: str, nops: int, nkeys: int,
-                     latency_s: float) -> None:
+                     latency_s: float, queue_delay_s: Optional[float] = None,
+                     cap: int = 0) -> None:
         r = self.registry
         r.inc(f"executor.ops.{kind}", nops)
         r.inc("executor.ops_total", nops)
@@ -281,7 +282,22 @@ class ExecutorMetrics:
         r.observe("executor.batch_ops", nops)
         r.observe("executor.batch_keys", nkeys)
         r.observe(f"executor.latency_s.{kind}", latency_s)
+        if queue_delay_s is not None:
+            # Oldest-op wait from enqueue to dispatch: THE serving-latency
+            # number admission control exists to bound.
+            r.observe("executor.queue_delay_s", max(0.0, queue_delay_s))
+        if cap > 0:
+            r.observe("executor.batch_occupancy", nkeys / cap)
 
     def record_error(self, kind: str) -> None:
         self.registry.inc(f"executor.errors.{kind}")
         self.registry.inc("executor.errors_total")
+
+    def record_expired(self, kind: str, nops: int) -> None:
+        """Ops whose deadline passed before device dispatch."""
+        self.registry.inc(f"executor.expired.{kind}", nops)
+        self.registry.inc("executor.expired_total", nops)
+
+    def record_cancelled(self, nops: int) -> None:
+        """Ops still queued when the dispatcher exited (shutdown sweep)."""
+        self.registry.inc("executor.cancelled_total", nops)

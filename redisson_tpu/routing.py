@@ -56,6 +56,7 @@ class RoutingBackend:
         the store, e.g. the pod bank rows) and return its result."""
         probe = Op(target=target, kind=kind, payload=None)
         self.sketch.run(kind, target, [probe])
+        # graftlint: allow-block(same-thread: run() above completes the probe future before returning)
         return probe.future.result()
 
     def _both_delete(self, target: str, ops: List[Op]) -> None:
@@ -95,6 +96,7 @@ class RoutingBackend:
                 probe = Op(target=target, kind="rename", payload=op.payload)
                 self.sketch.run("rename", target, [probe])
                 try:
+                    # graftlint: allow-block(same-thread: sketch.run above completes the probe future before returning)
                     op.future.set_result(probe.future.result())
                 except Exception as exc:  # noqa: BLE001
                     op.future.set_exception(exc)

@@ -1,0 +1,35 @@
+"""redisson_tpu.serve — the QoS serving layer in front of the executor.
+
+What makes the engine a *service* instead of a library: per-tenant
+admission control with load shedding, deadline-aware adaptive batching,
+bounded retry, and per-kind circuit breakers. See ISSUE/README "Serving &
+QoS" for the contract; `ServingLayer` is the entry point (built by
+`RedissonClient` when `Config.serve` is set).
+
+Import-order note: `redisson_tpu.executor` imports `serve.errors`, so
+nothing imported at THIS module's load time may import the executor
+(scheduler pulls BatchCollector lazily inside `batch()`).
+"""
+
+from redisson_tpu.serve.admission import AdmissionController, TokenBucket
+from redisson_tpu.serve.breaker import BreakerBoard, CircuitBreaker
+from redisson_tpu.serve.errors import (CircuitOpenError, DeadlineExceeded,
+                                       RejectedError, RetryableError,
+                                       ServeError)
+from redisson_tpu.serve.policy import AdaptiveBatchPolicy, CostModel
+from redisson_tpu.serve.scheduler import ServingLayer
+
+__all__ = [
+    "AdmissionController",
+    "TokenBucket",
+    "BreakerBoard",
+    "CircuitBreaker",
+    "ServeError",
+    "RejectedError",
+    "DeadlineExceeded",
+    "CircuitOpenError",
+    "RetryableError",
+    "CostModel",
+    "AdaptiveBatchPolicy",
+    "ServingLayer",
+]

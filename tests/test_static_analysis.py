@@ -230,6 +230,55 @@ def test_g005_pallas_call_contract():
     assert "G005" in rules_of(findings)  # interpret= missing
 
 
+def test_g006_unbounded_future_result():
+    findings = lint_src("""
+        def wait_all(futures):
+            return [f.result() for f in futures]
+    """)
+    assert "G006" in rules_of(findings)
+
+
+def test_g006_timeout_bounded_result_ok():
+    findings = lint_src("""
+        def wait_all(futures):
+            return [f.result(timeout=30) for f in futures]
+    """)
+    assert "G006" not in rules_of(findings)
+
+
+def test_g006_scoped_to_dispatch_and_serve_paths():
+    src = """
+        def wait(f):
+            return f.result()
+    """
+    hot = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "serve", "scheduler.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    hot2 = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "executor.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    cold = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "models", "foo.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    assert "G006" in rules_of(hot)
+    assert "G006" in rules_of(hot2)
+    assert "G006" not in rules_of(cold)
+
+
+def test_g006_suppression_with_reason():
+    findings = lint_src("""
+        def wait(f):
+            # graftlint: allow-g006(done-callback: f is already resolved)
+            return f.result()
+    """)
+    assert "G006" not in rules_of(findings)
+
+
+def test_serve_package_lints_clean():
+    dicts = run_lint([os.path.join(ENGINE_DIR, "serve")], jaxpr=False)
+    assert dicts == [], dicts
+
+
 def test_g005_blockspec_index_map_arity():
     findings = lint_src("""
         from jax.experimental import pallas as pl

@@ -11,6 +11,10 @@ failure modes (see findings.RULES). Scope notes:
   discipline) and G004's big-literal check exempts arguments of u64
   helper calls and module-level named-constant assignments.
 * G005 only fires in files that import ``jax.experimental.pallas``.
+* G006 (block) only applies to the dispatch/serve paths under
+  ``redisson_tpu/`` (executor.py, routing.py, serve/) — unless the file
+  was passed explicitly. The models' sync facades are the *documented*
+  blocking API and stay out of scope.
 
 Suppression: ``# graftlint: allow-<name>(reason)`` on the flagged line,
 anywhere within the flagged expression's line span, or on a standalone
@@ -86,6 +90,7 @@ class FileLinter:
         ):
             self.module_defs[name] = node
         self._g002_on = self.explicit or self._in_sync_scope()
+        self._g006_on = self.explicit or self._in_block_scope()
         self._g004_on = not self.relpath.endswith("ops/u64.py")
         self._pallas_file = any(
             full == _PALLAS_MODULE for full in self.alias_modules.values()
@@ -137,6 +142,16 @@ class FileLinter:
             sub in ("engine.py", "backend_tpu.py")
             or sub.startswith("parallel/")
             or sub.startswith("ingest/")
+        )
+
+    def _in_block_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        return (
+            sub in ("executor.py", "routing.py")
+            or sub.startswith("serve/")
         )
 
     # -- alias helpers -----------------------------------------------------
@@ -218,6 +233,8 @@ class FileLinter:
             self._check_g001(node)
             if self._g002_on:
                 self._check_g002(node)
+            if self._g006_on:
+                self._check_g006(node)
             self._check_jit_construction(node, in_func, in_loop)
             if self._pallas_file:
                 self._check_pallas_call(node, fn_node)
@@ -361,6 +378,24 @@ class FileLinter:
             "stage the transfer (copy_to_host_async + Completer, see "
             "backend_tpu._start_d2h) or keep the value on device; if the "
             "sync is deliberate, add `# graftlint: allow-sync(reason)`",
+        )
+
+    # -- G006: unbounded blocking -------------------------------------------
+
+    def _check_g006(self, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "result"):
+            return
+        if call.args or any(kw.arg == "timeout" for kw in call.keywords):
+            return
+        self._emit(
+            "G006", call,
+            "`result()` with no timeout — an unbounded block in a "
+            "dispatch/serve path hangs its thread if the future is never "
+            "resolved",
+            "pass a timeout, or bound the wait with a serve deadline; if the "
+            "future is provably already resolved (done-callback context) or "
+            "blocking IS the contract, add `# graftlint: allow-block(reason)`",
         )
 
     # -- G003: recompilation hazards ----------------------------------------

@@ -65,6 +65,11 @@ RULES = {
         "Pallas contract: pallas_call without interpret=/out_shape=, "
         "BlockSpec index_map arity mismatch, or 64-bit dtype in a kernel",
     ),
+    "G006": (
+        "block",
+        "unbounded blocking: Future.result() with no timeout in a "
+        "dispatch/serve path (executor.py, routing.py, serve/)",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
