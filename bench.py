@@ -390,6 +390,53 @@ def bench_roofline(jax, dev, n, kernel_rate, segment_rate=0.0, quick=False):
     }
 
 
+def bench_read_cache(n, reps=20):
+    """Epoch-stamped read cache (PR 4): hll count() roundtrip latency with
+    the cache cold (each read preceded by a write, so the epoch moved and
+    the count pays the full device sync) vs warm (repeated reads at one
+    epoch, served host-side). The before/after sync_us_per_roundtrip pair
+    is the cost the cache removes — the client-side-caching analogue of
+    Redisson's RLocalCachedMap."""
+    from redisson_tpu.client import RedissonTPU
+
+    client = RedissonTPU.create()
+    try:
+        h = client.get_hyper_log_log("bench:cache")
+        rng = np.random.default_rng(5)
+        h.add_ints(rng.integers(0, 2**63, size=n, dtype=np.uint64))
+        h.count()  # compile + warm
+
+        miss_us, hit_us = [], []
+        for i in range(reps):
+            h.add_ints(np.array([i], dtype=np.uint64))  # bump the epoch
+            t0 = time.perf_counter()
+            h.count()  # miss: full device roundtrip
+            miss_us.append((time.perf_counter() - t0) * 1e6)
+            t0 = time.perf_counter()
+            h.count()  # hit: same epoch, memoized
+            hit_us.append((time.perf_counter() - t0) * 1e6)
+        before = float(np.median(miss_us))
+        after = float(np.median(hit_us))
+        out = {
+            "sync_us_per_roundtrip_before": round(before, 1),
+            "sync_us_per_roundtrip_after": round(after, 1),
+            "speedup": round(before / after, 1) if after else 0.0,
+        }
+        cache = getattr(
+            getattr(client._routing, "sketch", None), "read_cache", None)
+        if cache is not None:
+            out["hit_ratio"] = round(cache.stats()["hit_ratio"], 3)
+        print(
+            f"# hll_count_cached: {before:.0f} us uncached -> {after:.0f} us "
+            f"cached per roundtrip ({out['speedup']}x; hit ratio "
+            f"{out.get('hit_ratio', 'n/a')})",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        client.shutdown()
+
+
 def bench_pfmerge(jax, dev, sketches=1000):
     """PFMERGE+count across 1K sketches (BASELINE: <50 ms)."""
     from redisson_tpu import engine
@@ -502,6 +549,11 @@ def main():
             bench_device_ingest(jax, dev, n, reps), 1)
     except Exception as exc:  # noqa: BLE001
         print(f"# device ingest bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["hll_count_cached"] = bench_read_cache(
+            1 << 12 if quick else 1 << 18, reps=5 if quick else 20)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# read-cache bench failed: {exc!r}", file=sys.stderr)
     try:
         result["pfmerge_1000_ms"] = round(
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)

@@ -675,6 +675,127 @@ def serve_smoke():
     return ok
 
 
+def pipeline_smoke():
+    """In-flight window sweep over a device-latency sim backend: verifies
+    results are bit-identical at every depth, reports wall time + overlap
+    ratio, then measures the epoch read cache's hit rate through a real
+    local-mode client. Exit contract (the CPU-only CI acceptance for PR 4):
+    overlap ratio > 0 at window >= 2 AND identical results to window 1."""
+    import queue as queue_mod
+    import threading
+
+    from redisson_tpu.executor import CommandExecutor
+
+    device_s = 0.004
+    host_s = 0.002  # pad + device_put staging cost, paid on the dispatcher
+    n_ops = 120
+    n_targets = 8
+
+    class SimBackend:
+        """Commits state at stage time (dispatch-time state, like the TPU
+        tier), resolves futures on a worker after simulated device time.
+        run() charges a host staging cost on the dispatcher thread — the
+        component the pipeline hides behind device compute."""
+
+        DISPATCH_TIME_STATE = True
+
+        def __init__(self):
+            self.state = {}
+            self._q = queue_mod.Queue()
+            self._t = threading.Thread(target=self._drain, daemon=True)
+            self._t.start()
+
+        def run(self, kind, target, ops):
+            time.sleep(host_s)  # simulated pad + H2D transfer
+            staged = []
+            for op in ops:
+                vals = self.state.setdefault(op.target, [])
+                if op.kind == "set":
+                    vals.append(op.payload)
+                    staged.append((op, len(vals)))
+                else:
+                    staged.append((op, list(vals)))
+            self._q.put(staged)
+
+        def _drain(self):
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                time.sleep(device_s)  # simulated device compute + D2H
+                for op, val in item:
+                    if not op.future.done():
+                        op.future.set_result(val)
+
+        def close(self):
+            self._q.put(None)
+            self._t.join(timeout=5)
+
+    rng = np.random.default_rng(11)
+    schedule = [(f"t{int(rng.integers(n_targets))}",
+                 "set" if rng.random() < 0.7 else "get",
+                 int(rng.integers(1000)))
+                for _ in range(n_ops)]
+
+    def play(window):
+        backend = SimBackend()
+        ex = CommandExecutor(backend, inflight_runs=window)
+        t0 = time.perf_counter()
+        futs = [ex.execute_async(t, k, p, nkeys=1) for t, k, p in schedule]
+        results = [f.result(timeout=60) for f in futs]
+        dt = time.perf_counter() - t0
+        stats = ex.pipeline_stats()
+        ex.shutdown()
+        backend.close()
+        return results, dt, stats
+
+    print(f"# pipeline-smoke: {n_ops} ops over {n_targets} targets, "
+          f"{device_s * 1e3:.0f}ms simulated device time per run",
+          file=sys.stderr)
+    print(f"{'window':>6} {'wall_s':>8} {'overlap%':>9} {'runs':>6} "
+          f"{'identical':>9}")
+    base_results = None
+    ok = True
+    for window in (1, 2, 4):
+        results, dt, stats = play(window)
+        identical = base_results is None or results == base_results
+        if base_results is None:
+            base_results = results
+        print(f"{window:>6} {dt:>8.3f} {100 * stats['overlap_ratio']:>8.1f}% "
+              f"{stats['runs_completed']:>6} {str(identical):>9}")
+        if not identical:
+            print(f"#   window={window} results diverged from serial",
+                  file=sys.stderr)
+            ok = False
+        if window >= 2 and stats["overlap_ratio"] <= 0.0:
+            print(f"#   window={window}: no overlap observed", file=sys.stderr)
+            ok = False
+
+    # Epoch read cache through the real client (local-mode sketch engine).
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    c = RedissonTPU.create(Config())
+    try:
+        h = c.get_hyper_log_log("psmoke:hll")
+        h.add_all(list(range(10_000)))
+        reads = 20
+        t0 = time.perf_counter()
+        for _ in range(reads):
+            h.count()
+        read_dt = time.perf_counter() - t0
+        stats = c._routing.sketch.read_cache.stats()
+        print(f"# read-cache: {reads} counts in {read_dt * 1e3:.1f}ms, "
+              f"hit ratio {stats['hit_ratio']:.2f} "
+              f"({stats['hits']} hits / {stats['misses']} misses)")
+        if stats["hits"] < reads - 2:
+            print("#   read cache barely hit", file=sys.stderr)
+            ok = False
+    finally:
+        c.shutdown()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -693,10 +814,17 @@ def main():
     ap.add_argument("--serve-smoke", action="store_true",
                     help="QoS serving-layer offered-load sweep (p50/p99 "
                          "queueing delay + shed rate), then exit")
+    ap.add_argument("--pipeline-smoke", action="store_true",
+                    help="in-flight window sweep {1,2,4}: overlap ratio, "
+                         "result identity vs serial, read-cache hit rate, "
+                         "then exit")
     args = ap.parse_args()
 
     if args.serve_smoke:
         sys.exit(0 if serve_smoke() else 1)
+
+    if args.pipeline_smoke:
+        sys.exit(0 if pipeline_smoke() else 1)
 
     if args.lint_smoke:
         from tools.graftlint import run_lint

@@ -13,6 +13,7 @@ from __future__ import annotations
 import functools
 import queue
 import threading
+from collections import OrderedDict
 from typing import Callable, List, Optional
 
 import jax.numpy as jnp
@@ -182,6 +183,89 @@ def backend_names(store: SketchStore, extra_names, pattern: str = "*"):
     return list(out)
 
 
+class EpochReadCache:
+    """Epoch-stamped memo for device-read results — the analogue of the
+    reference's client-side caching (RLocalCachedMap invalidation topic):
+    every target carries a monotonically increasing write epoch, and a read
+    result (`hll_count`, BITCOUNT, bloom contains/count) is valid exactly
+    while its target's epoch is unchanged. Repeated reads between writes
+    skip the device entirely; any write path bumps the epoch, which is the
+    whole invalidation protocol — no topic, no TTL.
+
+    Thread contract: lookups happen on the dispatcher thread; `put` happens
+    on the completer thread when the miss's materialization lands (stamped
+    with the epoch captured at dispatch, so a racing write can never make a
+    stale value servable). A small lock covers both.
+    """
+
+    _MISS = object()
+
+    def __init__(self, max_entries: int = 1024):
+        self.max_entries = max(0, int(max_entries))
+        self._lock = threading.Lock()
+        self._data: "OrderedDict" = OrderedDict()  # (target, kind, extra) -> (epoch, value)
+        self.hits = 0
+        self.misses = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def get(self, target: str, kind: str, epoch: int, extra=None):
+        """Cached value for (target, kind, extra) at `epoch`, else _MISS
+        (use `is_hit`). Counts hit/miss stats."""
+        if self.max_entries == 0:
+            return self._MISS
+        key = (target, kind, extra)
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is not None and ent[0] == epoch:
+                self._data.move_to_end(key)
+                self.hits += 1
+                return ent[1]
+            self.misses += 1
+            return self._MISS
+
+    def is_hit(self, value) -> bool:
+        return value is not self._MISS
+
+    def put(self, target: str, kind: str, epoch: int, value, extra=None) -> None:
+        if self.max_entries == 0:
+            return
+        key = (target, kind, extra)
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is not None and ent[0] > epoch:
+                return  # a fresher write already stamped this slot
+            self._data[key] = (epoch, value)
+            self._data.move_to_end(key)
+            while len(self._data) > self.max_entries:
+                self._data.popitem(last=False)
+
+    def invalidate(self, target: str) -> None:
+        """Drop every entry for a target (delete/rename — the epoch alone
+        would keep them correct, this just frees the slots)."""
+        with self._lock:
+            stale = [k for k in self._data if k[0] == target]
+            for k in stale:
+                del self._data[k]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_ratio": (self.hits / total) if total else 0.0,
+                "entries": len(self._data),
+                "max_entries": self.max_entries,
+            }
+
+
 class RowAllocator:
     """name -> bank-row bookkeeping shared by the single-chip and pod
     backends: free-list reuse, elastic grow-on-full, per-name mutation
@@ -337,6 +421,13 @@ class TpuBackend:
 
     GLOBAL_COALESCE = frozenset({"hll_add"})
 
+    #: run() commits all observable state (store swaps, bank mutation, row
+    #: versions) on the dispatcher thread before returning — only result
+    #: materialization trails on the completer. The executor's pipeline may
+    #: therefore release per-target gates at stage time and keep multiple
+    #: runs in flight without breaking read-your-writes.
+    DISPATCH_TIME_STATE = True
+
     #: device index math (ops/bloom._mod_u64) is only exact for m <= 2^31 or
     #: power-of-two m — models fail bloom sizing fast when this tier backs them
     BLOOM_STRICT_MOD = True
@@ -356,6 +447,7 @@ class TpuBackend:
         bank_capacity: int = 256,
         hll_hash: str = "murmur3",
         planner: Optional[IngestPlanner] = None,
+        read_cache_entries: int = 1024,
     ):
         if ingest not in self.INGEST_CHOICES:
             raise ValueError(f"unknown ingest policy: {ingest!r}")
@@ -398,6 +490,12 @@ class TpuBackend:
         # name -> packed host replica of a bloom filter (see the Bloom host
         # mirror section).
         self._bloom_mirrors: dict = {}
+        # Epoch-stamped read memoization (client-side-caching analogue).
+        # Epochs live here, not on store objects: they must also cover bank
+        # rows (no store object) and host-mirror writes (store version
+        # unchanged), so one counter per name is the single truth.
+        self._epochs: dict = {}
+        self.read_cache = EpochReadCache(read_cache_entries)
 
     # row-map views (tests and the durability duck type read these)
     @property
@@ -526,6 +624,19 @@ class TpuBackend:
 
     def _bump(self, name: str) -> None:
         self._alloc.bump(name)
+        self._touch(name)
+
+    # -- read-cache epochs ---------------------------------------------------
+
+    def _epoch(self, name: str) -> int:
+        return self._epochs.get(name, 0)
+
+    def _touch(self, name: str) -> None:
+        """A write made `name`'s device/mirror state diverge from any cached
+        read: bump its epoch. Every mutation path funnels through here (HLL
+        via _bump; store swaps, mirror writes, import/restore and delete
+        call it directly)."""
+        self._epochs[name] = self._epochs.get(name, 0) + 1
 
     # durability/checkpoint surface (same duck type as PodBackend — the
     # client's _pod_backend() probe picks this up, so bank rows flush and
@@ -801,12 +912,30 @@ class TpuBackend:
     def _op_hll_count(self, target: str, ops: List[Op]) -> None:
         row = self._hll_row(target, create=False)
         if row is None:
+            # Absent targets are never cached: creation does not bump the
+            # epoch, so a cached 0 could outlive the first insert.
             for op in ops:
                 op.future.set_result(0)
             return
+        epoch = self._epoch(target)
+        cached = self.read_cache.get(target, "hll_count", epoch)
+        if self.read_cache.is_hit(cached):
+            # No kernel, no D2H — but still resolve via the completer so
+            # per-target results stay FIFO behind reads already in flight.
+            self.completer.submit(_complete_all(ops, lambda v=cached: v))
+            return
         # async dispatch; D2H starts now, sync happens off-thread
         est = _start_d2h(engine.hll_bank_count(self.bank, np.int32(row)))
-        self.completer.submit(_complete_all(ops, lambda: int(round(float(est)))))
+
+        def materialize(est=est, epoch=epoch):
+            # graftlint: allow-sync(completer thread: blocking materialization is this thread's job)
+            v = int(round(float(est)))
+            # Stamped with the dispatch-time epoch: a write that raced in
+            # since then bumped the live epoch, so this entry can't serve.
+            self.read_cache.put(target, "hll_count", epoch, v)
+            return v
+
+        self.completer.submit(_complete_all(ops, materialize))
 
     def _op_hll_export(self, target: str, ops: List[Op]) -> None:
         """(registers uint8[m], version) on the dispatcher — serialized with
@@ -823,6 +952,7 @@ class TpuBackend:
         version = self._row_versions.get(target, 0)
         self.completer.submit(
             _complete_all(
+                # graftlint: allow-sync(completer thread: materializing the staged snapshot is this thread's job)
                 ops, lambda: (np.asarray(snapshot).astype(np.uint8), version)
             )
         )
@@ -860,6 +990,7 @@ class TpuBackend:
             est = _start_d2h(engine.hll_bank_count_rows(
                 self.bank, engine.pad_rows_repeat(rows)))
             self.completer.submit(
+                # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
                 _complete_all([op], lambda est=est: int(round(float(est))))
             )
 
@@ -933,12 +1064,30 @@ class TpuBackend:
         self.store.swap(obj.name, grown)
         return self.store.get(obj.name)
 
+    @staticmethod
+    def _max_index(ops: List[Op]) -> int:
+        """Largest bit index across the run, from the host-side `max_idx`
+        the models stamp at payload-build time — the grow/extent decision
+        must never reduce the index array inside dispatch (a device-resident
+        payload would turn `int(idx.max())` into a blocking per-op sync).
+        Falls back to a host numpy reduce for payloads without the stamp.
+        Returns -1 for an all-empty run."""
+        mx = -1
+        for op in ops:
+            m = op.payload.get("max_idx")
+            if m is None:
+                arr = op.payload["idx"]
+                m = int(arr.max()) if arr.size else -1
+            mx = max(mx, int(m))
+        return mx
+
     def _bitset_mutate(self, target: str, ops: List[Op], kernel) -> None:
         idx = np.concatenate([op.payload["idx"] for op in ops])
+        mx = self._max_index(ops)
         obj = self._bitset(target, nbits=1024)
-        obj = self._grow_for(obj, int(idx.max()) if idx.size else 0)
-        if idx.size:
-            self._extend(obj, int(idx.max()))
+        obj = self._grow_for(obj, mx if mx >= 0 else 0)
+        if mx >= 0:
+            self._extend(obj, mx)
         outs = []
         spans = []
         for s, e in engine.chunk_spans(idx.shape[0]):
@@ -948,13 +1097,17 @@ class TpuBackend:
             self.store.swap(target, new)
             outs.append(old)  # device handles; materialized off-thread
             spans.append(e - s)
+        self._touch(target)
         self.completer.submit(self._slice_results(ops, outs, spans))
 
     @staticmethod
-    def _slice_results(ops: List[Op], outs, spans, post=None) -> callable:
+    def _slice_results(ops: List[Op], outs, spans, post=None,
+                       on_result=None) -> callable:
         """Completion closure: materialize per-chunk device vectors, then
         slice per-op bool results in submission order. `post` (optional)
-        transforms the concatenated host vector before slicing."""
+        transforms the concatenated host vector before slicing; `on_result`
+        (optional) sees each (op, value) before the future resolves — the
+        read-cache fill hook."""
         for o in outs:
             _start_d2h(o)
 
@@ -976,7 +1129,10 @@ class TpuBackend:
                      else p["packed"].shape[0] if "packed" in p
                      else p["data"].shape[0])
                 if not op.future.done():
-                    op.future.set_result(flat[pos : pos + n].astype(bool))
+                    value = flat[pos : pos + n].astype(bool)
+                    if on_result is not None:
+                        on_result(op, value)
+                    op.future.set_result(value)
                 pos += n
 
         return run
@@ -1022,11 +1178,21 @@ class TpuBackend:
             for op in ops:
                 op.future.set_result(0)
             return
+        epoch = self._epoch(target)
+        cached = self.read_cache.get(target, "bitset_cardinality", epoch)
+        if self.read_cache.is_hit(cached):
+            self.completer.submit(_complete_all(ops, lambda v=cached: v))
+            return
         # Partials go D2H async; the 64-bit-exact combine happens at
         # completion (an int32 total wraps negative past 2^31 set bits).
         v = _start_d2h(engine.bitset_cardinality_partials(obj.state))
-        self.completer.submit(_complete_all(
-            ops, lambda: bitset_ops.combine_partials(v)))
+
+        def materialize(v=v, epoch=epoch):
+            out = bitset_ops.combine_partials(v)
+            self.read_cache.put(target, "bitset_cardinality", epoch, out)
+            return out
+
+        self.completer.submit(_complete_all(ops, materialize))
 
     def _op_bitset_length(self, target: str, ops: List[Op]) -> None:
         self._check_not_hll(target, ObjectType.BITSET)
@@ -1035,12 +1201,22 @@ class TpuBackend:
             for op in ops:
                 op.future.set_result(0)
             return
+        epoch = self._epoch(target)
+        cached = self.read_cache.get(target, "bitset_length", epoch)
+        if self.read_cache.is_hit(cached):
+            self.completer.submit(_complete_all(ops, lambda v=cached: v))
+            return
         # Same async shape as BITCOUNT: int32 local offsets go D2H, the
         # absolute position is assembled in 64-bit host ints at completion
         # (positions past 2^31 bits wrap an int32 device scalar).
         v = _start_d2h(engine.bitset_length_partials(obj.state))
-        self.completer.submit(_complete_all(
-            ops, lambda: bitset_ops.combine_length(v)))
+
+        def materialize(v=v, epoch=epoch):
+            out = bitset_ops.combine_length(v)
+            self.read_cache.put(target, "bitset_length", epoch, out)
+            return out
+
+        self.completer.submit(_complete_all(ops, materialize))
 
     def _op_bitset_size(self, target: str, ops: List[Op]) -> None:
         """STRLEN * 8 — the WRITTEN byte extent, exactly what redis
@@ -1070,6 +1246,7 @@ class TpuBackend:
                     self._extend(obj, end - 1)
             new = bitset_ops.set_range(obj.state, start, end, value)
             self.store.swap(target, new)
+            self._touch(target)
             op.future.set_result(None)
 
     def _op_bitset_op(self, target: str, ops: List[Op]) -> None:
@@ -1120,6 +1297,7 @@ class TpuBackend:
                 [obj.meta.get("extent_bits", 0)]
                 + [o.meta.get("extent_bits", 0) for o in src_objs])
             self.store.swap(target, acc)
+            self._touch(target)
             op.future.set_result(None)
 
     # -- Bloom --------------------------------------------------------------
@@ -1153,6 +1331,7 @@ class TpuBackend:
                     "blocked": blocked,
                 },
             )
+            self._touch(target)
             op.future.set_result(True)
 
     def _bloom_meta(self, target: str):
@@ -1237,6 +1416,10 @@ class TpuBackend:
         new = engine.bitset_absorb_packed(
             obj.state, jax.device_put(mir["bits"], self.store.device))
         self.store.swap(target, new)
+        # The absorb itself adds no logical bits (host writes already bumped
+        # the epoch), but replication/restore flows rebuild state through
+        # here — invalidate so no pre-absorb read survives (satellite pin).
+        self._touch(target)
         mir["absorbed_v"] = mir["host_v"]
         if was_valid:
             mir["synced_dev"] = obj.version  # device == mirror right now
@@ -1266,9 +1449,11 @@ class TpuBackend:
                     p["data"], p["lengths"], mir["bits"], k, m, self.seed)
             op.future.set_result(newly.view(np.bool_))  # zero-copy
         mir["host_v"] += 1
+        self._touch(target)
 
     def _bloom_host_contains(self, target: str, obj, m: int, k: int,
-                             ops: List[Op], count_only: bool = False) -> None:
+                             ops: List[Op], count_only: bool = False,
+                             on_result=None) -> None:
         from redisson_tpu import native as native_mod
 
         mir = self._bloom_mirror(target, obj, m)
@@ -1280,10 +1465,13 @@ class TpuBackend:
             else:
                 hits = native_mod.bloom_contains_rows(
                     p["data"], p["lengths"], mir["bits"], k, m, self.seed)
-            op.future.set_result(
-                int(hits.sum()) if count_only else hits.view(np.bool_))
+            res = int(hits.sum()) if count_only else hits.view(np.bool_)
+            if on_result is not None:
+                on_result(op, res)
+            op.future.set_result(res)
 
-    def _bloom_run(self, target: str, ops: List[Op], mutate: bool) -> None:
+    def _bloom_run(self, target: str, ops: List[Op], mutate: bool,
+                   on_result=None) -> None:
         """Shared bloom dispatch: a coalesced run is processed in op order
         (positional result slicing), packed runs coalesce small arrays via
         _segments (order-preserving concat) and chunk like the hll path,
@@ -1326,7 +1514,10 @@ class TpuBackend:
                     fn = add_bytes if mutate else contains_bytes
                     emit(fn(obj.state, pdata, plengths, valid,
                             k, m, self.seed), e - s)
-        self.completer.submit(self._slice_results(ops, outs, spans))
+        if mutate:
+            self._touch(target)
+        self.completer.submit(
+            self._slice_results(ops, outs, spans, on_result=on_result))
 
     @staticmethod
     def _bloom_kernels(obj):
@@ -1349,14 +1540,73 @@ class TpuBackend:
         self._bloom_device_sync(target)
         self._bloom_run(target, ops, mutate=True)
 
+    # Probe payloads above this many keys are not memoized — digesting the
+    # raw bytes would rival the membership kernel itself.
+    _CONTAINS_CACHE_MAX = 4096
+
+    @classmethod
+    def _probe_digest(cls, op: Op):
+        """Stable fingerprint of a small host probe payload, or None for
+        device-resident / oversized payloads (those skip the read cache)."""
+        import hashlib
+
+        p = op.payload
+        if "device_packed" in p:
+            return None
+        h = hashlib.blake2b(digest_size=16)
+        if "packed" in p:
+            arr = p["packed"]
+            if arr.shape[0] > cls._CONTAINS_CACHE_MAX:
+                return None
+            h.update(b"p")
+            h.update(np.ascontiguousarray(arr).tobytes())
+        else:
+            data, lengths = p["data"], p["lengths"]
+            if data.shape[0] > cls._CONTAINS_CACHE_MAX:
+                return None
+            h.update(b"b")
+            h.update(np.ascontiguousarray(data).tobytes())
+            h.update(np.ascontiguousarray(lengths).tobytes())
+        return h.digest()
+
     def _op_bloom_contains(self, target: str, ops: List[Op]) -> None:
         obj, m, k = self._bloom_meta(target)
         nkeys = sum(op.nkeys or self._payload_nkeys(op) for op in ops)
-        if self._bloom_use_host(target, obj, nkeys):
-            self._bloom_host_contains(target, obj, m, k, ops)
+        use_host = self._bloom_use_host(target, obj, nkeys)
+        if not use_host:
+            # Sync before the epoch read: absorbing pending host bits bumps
+            # the epoch, so the entries filled below stay servable after.
+            self._bloom_device_sync(target)
+        epoch = self._epoch(target)
+        pending: List[Op] = []
+        digests = {}
+        for op in ops:
+            dig = self._probe_digest(op)
+            if dig is not None:
+                hit = self.read_cache.get(
+                    target, "bloom_contains", epoch, extra=dig)
+                if self.read_cache.is_hit(hit):
+                    # Serve a copy via the completer so per-target resolution
+                    # order matches submission order even on a hit.
+                    self.completer.submit(
+                        _complete_all([op], lambda v=hit: v.copy()))
+                    continue
+                digests[id(op)] = dig
+            pending.append(op)
+        if not pending:
             return
-        self._bloom_device_sync(target)
-        self._bloom_run(target, ops, mutate=False)
+
+        def remember(op: Op, value) -> None:
+            dig = digests.get(id(op))
+            if dig is not None:
+                self.read_cache.put(target, "bloom_contains", epoch,
+                                    np.array(value, copy=True), extra=dig)
+
+        if use_host:
+            self._bloom_host_contains(target, obj, m, k, pending,
+                                      on_result=remember)
+            return
+        self._bloom_run(target, pending, mutate=False, on_result=remember)
 
     def _op_bloom_contains_count(self, target: str, ops: List[Op]) -> None:
         """Hit count per op (host-packed or device-resident keys): chunks
@@ -1408,18 +1658,30 @@ class TpuBackend:
 
         obj, m, k = self._bloom_meta(target)
         mir = self._bloom_mirrors.get(target)
-        if mir is not None and mir["synced_dev"] == obj.version:
+        use_mirror = mir is not None and mir["synced_dev"] == obj.version
+        if not use_mirror:
+            # Sync first: it may bump the epoch (absorb), and the cache fill
+            # below must be stamped with the post-absorb epoch to be useful.
+            self._bloom_device_sync(target)
+        epoch = self._epoch(target)
+        cached = self.read_cache.get(target, "bloom_count", epoch)
+        if self.read_cache.is_hit(cached):
+            for op in ops:
+                op.future.set_result(cached)
+            return
+        if use_mirror:
             # Valid mirror holds every bit: host popcount, zero link traffic.
             bc = native_mod.popcount(mir["bits"])
         else:
-            self._bloom_device_sync(target)
             # graftlint: allow-sync(mirror-miss fallback: count() is a synchronous API and must block on the fresh BITCOUNT)
             bc = int(engine.bitset_cardinality(obj.state))
         # bc is a host int here — the pure-math estimate matches the wire
         # tier (interop/bloom_redis) bit-for-bit and avoids a device call.
         est = bloom_math.count_estimate(bc, m, k)
+        val = int(round(est))
+        self.read_cache.put(target, "bloom_count", epoch, val)
         for op in ops:
-            op.future.set_result(int(round(est)))
+            op.future.set_result(val)
 
     def _op_bits_export(self, target: str, ops: List[Op]) -> None:
         """(otype, host cells, meta, version) for a bitset/bloom — the
@@ -1456,6 +1718,9 @@ class TpuBackend:
             self.store.swap(target, arr)
             obj.meta.update(meta)
             self._bloom_mirrors.pop(target, None)
+            # Checkpoint restore replaces the whole object: epoch bump so
+            # no pre-restore read survives in the cache.
+            self._touch(target)
             op.future.set_result(True)
 
     # -- generic ------------------------------------------------------------
@@ -1468,6 +1733,8 @@ class TpuBackend:
         else:
             self._bloom_mirrors.pop(target, None)
             res = self.store.delete(target)
+        self._touch(target)
+        self.read_cache.invalidate(target)
         for op in ops:
             op.future.set_result(res)
 
@@ -1508,6 +1775,10 @@ class TpuBackend:
                 mir = self._bloom_mirrors.pop(target, None)
                 if mir is not None:
                     self._bloom_mirrors[new] = mir
+            self._touch(target)
+            self._touch(new)
+            self.read_cache.invalidate(target)
+            self.read_cache.invalidate(new)
             op.future.set_result(True)
 
     def _op_flushall(self, target: str, ops: List[Op]) -> None:
@@ -1517,6 +1788,8 @@ class TpuBackend:
         self._alloc.clear()
         self.bank = None
         self._bloom_mirrors.clear()
+        self._epochs.clear()
+        self.read_cache.clear()
         self.store.flushall()
         for op in ops:
             op.future.set_result(None)

@@ -90,6 +90,7 @@ class RedissonTPU:
                 self._store, hll_impl=tcfg.hll_impl, seed=tcfg.hash_seed,
                 ingest=getattr(tcfg, "ingest", "auto"),
                 hll_hash=getattr(tcfg, "hll_hash", "murmur3"),
+                read_cache_entries=getattr(tcfg, "read_cache_entries", 1024),
             )
         self._routing = RoutingBackend(sketch)
         self._backend = self._routing
@@ -98,6 +99,11 @@ class RedissonTPU:
 
         self.metrics = MetricsRegistry()
         self._build_executor(self._routing, max_batch_keys=tcfg.max_batch_keys)
+        cache = getattr(sketch, "read_cache", None)
+        if cache is not None:
+            from redisson_tpu.observability import register_read_cache
+
+            register_read_cache(self.metrics, cache)
         self._pubsub = self._routing.pubsub
         self._watchdog = LockWatchdog(self._executor)
         self._eviction = EvictionScheduler(self._executor)
@@ -144,8 +150,12 @@ class RedissonTPU:
             kwargs["max_batch_keys"] = max_batch_keys
         self._executor = CommandExecutor(
             backend, metrics=ExecutorMetrics(self.metrics), policy=policy,
+            inflight_runs=getattr(self.config, "inflight_runs", 2),
             **kwargs)
         self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
+        self.metrics.gauge(
+            "executor.overlap_ratio",
+            lambda: self._executor.pipeline_stats()["overlap_ratio"])
         if scfg is not None:
             from redisson_tpu.serve import ServingLayer
 

@@ -55,6 +55,10 @@ class TpuConfig:
     # 149M inserts/s, 8M cap 174M/s, 32M slightly worse (latency).
     max_batch_keys: int = 1 << 23
     key_width_buckets: tuple = (16, 32, 64, 128, 256)
+    # Epoch-stamped read cache: memoized hll_count / BITCOUNT / bloom
+    # contains results per (target, write-epoch) — the client-side-caching
+    # analogue. Capacity in entries; 0 disables.
+    read_cache_entries: int = 1024
 
 
 @dataclass
@@ -162,6 +166,12 @@ class Config:
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
     threads: int = 0  # 0 => cpu_count, reference Config.java:50
+    # Executor pipeline depth: how many coalesced runs may be in flight at
+    # once (staged + dispatched, futures unresolved). 1 = the serial seed
+    # behavior; 2-4 overlaps host staging with device compute (the Netty
+    # channel-pipelining analogue). Per-target ordering is preserved at any
+    # depth.
+    inflight_runs: int = 2
 
     _MODES = ("local", "tpu", "pod", "redis")
 

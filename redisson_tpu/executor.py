@@ -14,6 +14,18 @@ Dispatch model (the TPU analogue of the reference's pipelining):
   * a single dispatcher thread (the "event loop") drains queues, coalescing
     consecutive same-kind key-batch ops on one object into a single padded
     device call (`CommandBatchService`-style batching, but implicit);
+  * dispatch is a three-stage pipeline (the reference keeps N commands in
+    flight per connection through the Netty channel + `CommandsQueue`; we
+    keep N *runs* in flight against the device): the dispatcher only STAGES
+    a run (pad + device_put + enqueue the jitted call — `backend.run`
+    returns without blocking on results), a bounded in-flight window
+    (`inflight_runs`, default 2) keeps the device busy, and the backend's
+    completer thread resolves futures as device results land. Per-target
+    serialization is preserved by never admitting a second run for a target
+    (or a GLOBAL_COALESCE kind) whose predecessor hasn't completed;
+    backends that commit all observable state at stage time (dispatch-time
+    state — they set `DISPATCH_TIME_STATE = True`) release that gate as
+    soon as `run()` returns, so only the window bounds their depth;
   * batching decisions are delegated to a policy object: the default
     `GreedyBatchPolicy` reproduces the seed behavior (drain until the key
     cap, never wait); the serving layer installs
@@ -49,6 +61,16 @@ from redisson_tpu.serve.errors import DeadlineExceeded
 
 # Op kinds that may coalesce with the previous op of the same kind+target.
 COALESCABLE = {"hll_add", "bloom_add", "bitset_set", "bitset_clear", "bitset_get", "bloom_contains"}
+
+# Kinds whose futures stay pending until a LATER op (a push serving the
+# parked waiter, or bpop_cancel) or a client-side timeout fulfils them.
+# Such a run must release its target gate at run() return and never occupy
+# an in-flight window slot: holding either would gate the very op that
+# fulfils it — two parked pops would wedge the whole window. These runs
+# keep the seed's dispatch semantics (the reference parks its timeoutless
+# blocking commands on a dedicated connection OUTSIDE the pipeline for the
+# same reason, `command/CommandAsyncService.java:491-497`).
+PARKED_KINDS = frozenset({"bpop"})
 
 _op_counter = itertools.count()
 
@@ -88,6 +110,34 @@ class GreedyBatchPolicy:
         return {"policy": "greedy"}
 
 
+class _InflightRun:
+    """Bookkeeping for one dispatched run, alive until its last op future
+    resolves (the executor-side analogue of one entry in the reference's
+    per-connection `CommandsQueue`)."""
+
+    __slots__ = ("kind", "target", "targets", "is_global", "nops", "nkeys",
+                 "t0", "queue_delay_s", "stage_s", "pending", "failed",
+                 "overlapped", "depth", "gates_held", "lock")
+
+    def __init__(self, kind: str, target: str, targets: frozenset,
+                 is_global: bool):
+        self.kind = kind
+        self.target = target
+        self.targets = targets
+        self.is_global = is_global
+        self.nops = 0
+        self.nkeys = 0
+        self.t0 = 0.0
+        self.queue_delay_s = 0.0
+        self.stage_s = None
+        self.pending = 0
+        self.failed = False
+        self.overlapped = False
+        self.depth = 1
+        self.gates_held = True
+        self.lock = threading.Lock()
+
+
 class CommandExecutor:
     """The async executor around a backend's op handlers.
 
@@ -97,7 +147,8 @@ class CommandExecutor:
     """
 
     def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None,
-                 policy=None, clock: Callable[[], float] = None):
+                 policy=None, clock: Callable[[], float] = None,
+                 inflight_runs: int = 2):
         self._backend = backend
         self._max_batch_keys = max_batch_keys
         self._metrics = metrics  # ExecutorMetrics or None (zero-cost when off)
@@ -107,6 +158,18 @@ class CommandExecutor:
         # pod backend's bank insert, where the device call carries a per-key
         # target row). Per-target FIFO is preserved: only queue heads join.
         self._global_kinds = frozenset(getattr(backend, "GLOBAL_COALESCE", ()))
+        # -- pipeline state (tentpole PR 4) --------------------------------
+        # A run stays "in flight" from dispatch until its last future
+        # resolves; the window bounds how many such runs may exist at once.
+        # Backends that commit observable state inside run() (dispatch-time
+        # state) let the per-target/per-kind gates release at stage time.
+        self._window = max(1, int(inflight_runs))
+        self._eager_release = bool(getattr(backend, "DISPATCH_TIME_STATE", False))
+        self._inflight: set = set()  # _InflightRun tokens
+        self._inflight_targets: set = set()  # gated object names
+        self._inflight_kinds: set = set()  # gated GLOBAL_COALESCE kinds
+        self._runs_completed = 0
+        self._runs_overlapped = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queues: Dict[str, deque] = {}
@@ -188,12 +251,20 @@ class CommandExecutor:
         try:
             while True:
                 with self._cv:
-                    while not self._ready and not self._shutdown:
+                    while True:
+                        if self._shutdown and not self._ready:
+                            return
+                        picked = None
+                        if self._ready and len(self._inflight) < self._window:
+                            picked = self._pick_target_locked()
+                        if picked is not None:
+                            break
+                        # Woken by: a new enqueue, a run completion freeing
+                        # a gate or a window slot, or shutdown().
                         self._cv.wait()
-                    if not self._ready:  # shutdown with an empty keyspace
-                        return
-                    kind, target, run = self._collect_run_locked()
-                self._dispatch(kind, target, run)
+                    kind, target, run = self._collect_run_locked(picked)
+                    token = self._admit_locked(kind, target, run)
+                self._dispatch(token, run)
         finally:
             # The dispatcher is the only thread that resolves queued ops; if
             # it exits for ANY reason (clean shutdown drain or an unexpected
@@ -201,10 +272,41 @@ class CommandExecutor:
             # blocks forever on a future nobody will complete.
             self._cancel_remaining()
 
-    def _collect_run_locked(self) -> Tuple[str, str, List[Op]]:
+    def _pick_target_locked(self) -> Optional[str]:
+        """First round-robin target whose queue head is admissible: no
+        in-flight predecessor holds its target gate (or its kind gate, for
+        GLOBAL_COALESCE kinds). Skipping a gated target instead of blocking
+        on it is what lets independent targets overlap while per-target FIFO
+        stays intact. Removes the pick from the round-robin."""
+        for target in self._ready:
+            if target in self._inflight_targets:
+                continue
+            head_kind = self._queues[target][0].kind
+            if head_kind in self._global_kinds and head_kind in self._inflight_kinds:
+                continue
+            self._ready.remove(target)
+            return target
+        return None
+
+    def _admit_locked(self, kind: str, target: str,
+                      run: List[Op]) -> _InflightRun:
+        """Mark the run in flight: hold its target gate(s) — a global steal
+        spans many targets — and, for global kinds, the kind gate."""
+        is_global = kind in self._global_kinds
+        targets = frozenset({op.target for op in run} | {target})
+        token = _InflightRun(kind, target, targets, is_global)
+        token.overlapped = bool(self._inflight)
+        self._inflight.add(token)
+        token.depth = len(self._inflight)
+        self._inflight_targets |= targets
+        if is_global:
+            self._inflight_kinds.add(kind)
+        return token
+
+    def _collect_run_locked(self, target: str) -> Tuple[str, str, List[Op]]:
         """Pop the next run: per-target coalesce + policy linger + the
-        cross-target steal for global kinds. Caller holds the lock."""
-        target = self._ready.popleft()
+        cross-target steal for global kinds. Caller holds the lock and has
+        already removed `target` from the round-robin."""
         q = self._queues[target]
         run = [q.popleft()]
         kind = run[0].kind
@@ -237,6 +339,11 @@ class CommandExecutor:
                 if other == target:
                     # A linger-time submitter can re-add `target` itself to
                     # the round-robin; its queue is the tail logic's problem.
+                    continue
+                if other in self._inflight_targets:
+                    # That target already has a run in flight; stealing its
+                    # head would put a second run for it in flight and break
+                    # per-target completion ordering.
                     continue
                 oq = self._queues[other]
                 while (
@@ -274,8 +381,14 @@ class CommandExecutor:
             run.append(op)
         return keys
 
-    def _dispatch(self, kind: str, target: str, run: List[Op]) -> None:
+    def _dispatch(self, token: _InflightRun, run: List[Op]) -> None:
+        """Stage one run: deadline-filter, call backend.run (stage + device
+        enqueue; non-blocking for device backends), then let the completion
+        callbacks — fired from the backend's completer thread as results
+        land, or inline for synchronous backends — retire the run. The
+        dispatcher never blocks on results here."""
         m = self._metrics
+        kind, target = token.kind, token.target
         now = self._clock()
         # Deadline propagation: expired ops complete with DeadlineExceeded
         # and NEVER reach backend.run — by this point the op has already
@@ -296,24 +409,120 @@ class CommandExecutor:
         if n_expired and m:
             m.record_expired(kind, n_expired)
         if not live:
+            self._retire(token, completed=False)
             return
-        nkeys = sum(op.nkeys for op in live)
-        t0 = self._clock()
+        token.nops = len(live)
+        token.nkeys = sum(op.nkeys for op in live)
+        t0 = token.t0 = self._clock()
+        token.queue_delay_s = t0 - min(op.enqueued_at for op in live)
+        token.pending = len(live)
+        parked = kind in PARKED_KINDS
+        if not parked:
+            # Attach completion accounting BEFORE the backend sees the ops: a
+            # synchronous backend resolves futures inside run(), and the last
+            # resolution must find the counter armed. Parked kinds skip this
+            # entirely — their completion is driven by a later op, so their
+            # "latency" is wait time, which must poison neither the window
+            # nor the cost model's service EWMA.
+            for op in live:
+                op.future.add_done_callback(
+                    lambda _fut, token=token: self._op_done(token))
         try:
             self._backend.run(kind, target, live)
-            dt = self._clock() - t0
-            self._policy.observe(kind, nkeys, dt)
-            if m:
-                m.record_batch(
-                    kind, len(live), nkeys, dt,
-                    queue_delay_s=t0 - min(op.enqueued_at for op in live),
-                    cap=self._max_batch_keys)
+            token.stage_s = self._clock() - t0
+            od = getattr(self._policy, "observe_dispatch", None)
+            if od is not None:
+                # Staging-side cost signal (host prep only — NOT service
+                # time; the cost model's service EWMA feeds from completion).
+                od(kind, token.nkeys, token.stage_s)
+            if self._eager_release and not parked:
+                # Dispatch-time-state backend: all observable state is
+                # committed once run() returns, so the next run for these
+                # targets may stage immediately; only the in-flight window
+                # still bounds depth.
+                self._release_gates(token)
         except Exception as exc:  # complete, never kill the loop
+            token.failed = True
+            token.stage_s = self._clock() - t0
             if m:
                 m.record_error(kind)
             for op in live:
                 if not op.future.done():
                     op.future.set_exception(exc)
+        if parked:
+            # The waiter is parked (or was served/failed inline); drop the
+            # gates and the window slot now — the fulfilling op must be able
+            # to dispatch against this same target.
+            self._retire(token, completed=False)
+
+    # -- completion path ----------------------------------------------------
+
+    def _op_done(self, token: _InflightRun) -> None:
+        """Done-callback on each live op future; runs on whichever thread
+        resolves it (the backend completer, or the dispatcher itself for
+        synchronous backends)."""
+        with token.lock:
+            token.pending -= 1
+            if token.pending > 0:
+                return
+        self._run_completed(token)
+
+    def _run_completed(self, token: _InflightRun) -> None:
+        """The whole run's results have landed: this is where service time
+        becomes observable (device compute + D2H, not just host staging), so
+        the cost model and latency metrics feed from HERE — the dispatcher's
+        own wall-clock around run() collapses to staging time once dispatch
+        stops blocking on results."""
+        dt = self._clock() - token.t0
+        if not token.failed:
+            self._policy.observe(token.kind, token.nkeys, dt)
+            if self._metrics:
+                self._metrics.record_batch(
+                    token.kind, token.nops, token.nkeys, dt,
+                    queue_delay_s=token.queue_delay_s,
+                    cap=self._max_batch_keys,
+                    stage_s=token.stage_s)
+        self._retire(token, completed=True)
+
+    def _release_gates_locked(self, token: _InflightRun) -> None:
+        if not token.gates_held:
+            return
+        token.gates_held = False
+        self._inflight_targets.difference_update(token.targets)
+        if token.is_global:
+            self._inflight_kinds.discard(token.kind)
+
+    def _release_gates(self, token: _InflightRun) -> None:
+        with self._cv:
+            self._release_gates_locked(token)
+            self._cv.notify_all()
+
+    def _retire(self, token: _InflightRun, completed: bool) -> None:
+        with self._cv:
+            self._release_gates_locked(token)
+            self._inflight.discard(token)
+            if completed:
+                self._runs_completed += 1
+                if token.overlapped:
+                    self._runs_overlapped += 1
+            self._cv.notify_all()
+        if completed and self._metrics:
+            self._metrics.record_run(token.depth, token.overlapped)
+
+    def pipeline_stats(self) -> Dict[str, Any]:
+        """Live pipeline counters (suite --pipeline-smoke + serve snapshot):
+        overlap_ratio is the fraction of completed runs that were dispatched
+        while at least one other run was still in flight."""
+        with self._lock:
+            done = self._runs_completed
+            return {
+                "window": self._window,
+                "eager_release": self._eager_release,
+                "inflight": len(self._inflight),
+                "runs_completed": done,
+                "runs_overlapped": self._runs_overlapped,
+                "overlap_ratio": (self._runs_overlapped / done) if done else 0.0,
+            }
 
     def _cancel_remaining(self) -> None:
         """Drain every queue and cancel the stranded ops' futures, so
@@ -336,6 +545,7 @@ class CommandExecutor:
             self._shutdown = True
             self._cv.notify_all()
         if wait:
+            t_end = time.monotonic() + timeout
             self._thread.join(timeout=timeout)
             if self._thread.is_alive():
                 # Dispatcher wedged inside backend.run past the join budget:
@@ -344,6 +554,17 @@ class CommandExecutor:
                 # cancel those now. (A clean drain leaves the queues empty
                 # and this is a no-op.)
                 self._cancel_remaining()
+                return
+            # Queues drained; now drain the in-flight window too (bounded by
+            # the same budget) so a clean shutdown implies every dispatched
+            # run's futures resolved — the backend completer is still alive
+            # at this point, client teardown stops it after us.
+            with self._cv:
+                while self._inflight:
+                    remaining = t_end - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(remaining)
 
     # -- batch facade -------------------------------------------------------
 

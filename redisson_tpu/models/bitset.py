@@ -23,6 +23,13 @@ def _idx(indexes) -> np.ndarray:
     return arr.astype(np.int64)
 
 
+def _mutate_payload(arr: np.ndarray) -> dict:
+    """Payload for set/clear: `max_idx` is precomputed host-side so the
+    backend's grow path never has to reduce a (possibly device-resident)
+    index array at dispatch time."""
+    return {"idx": arr, "max_idx": int(arr.max()) if arr.size else -1}
+
+
 class RBitSet(RObject):
     # -- single-bit / batched ------------------------------------------------
 
@@ -50,7 +57,7 @@ class RBitSet(RObject):
     def set_bits_async(self, indexes):
         arr = _idx(indexes)
         return self._executor.execute_async(
-            self.name, "bitset_set", {"idx": arr}, nkeys=arr.shape[0]
+            self.name, "bitset_set", _mutate_payload(arr), nkeys=arr.shape[0]
         )
 
     def clear_bits(self, indexes: Iterable[int]) -> np.ndarray:
@@ -59,7 +66,7 @@ class RBitSet(RObject):
     def clear_bits_async(self, indexes):
         arr = _idx(indexes)
         return self._executor.execute_async(
-            self.name, "bitset_clear", {"idx": arr}, nkeys=arr.shape[0]
+            self.name, "bitset_clear", _mutate_payload(arr), nkeys=arr.shape[0]
         )
 
     def set_range(self, start: int, end: int, value: bool = True) -> None:

@@ -273,7 +273,7 @@ class ExecutorMetrics:
 
     def record_batch(self, kind: str, nops: int, nkeys: int,
                      latency_s: float, queue_delay_s: Optional[float] = None,
-                     cap: int = 0) -> None:
+                     cap: int = 0, stage_s: Optional[float] = None) -> None:
         r = self.registry
         r.inc(f"executor.ops.{kind}", nops)
         r.inc("executor.ops_total", nops)
@@ -281,13 +281,28 @@ class ExecutorMetrics:
         r.inc("executor.batches_total")
         r.observe("executor.batch_ops", nops)
         r.observe("executor.batch_keys", nkeys)
+        # With pipelined dispatch this is completion latency (stage + device
+        # compute + D2H), observed when the run's last future resolves.
         r.observe(f"executor.latency_s.{kind}", latency_s)
+        if stage_s is not None:
+            # Host-side staging cost alone (pad + device_put + enqueue) —
+            # the dispatcher-thread share of the latency above.
+            r.observe(f"executor.stage_s.{kind}", stage_s)
         if queue_delay_s is not None:
             # Oldest-op wait from enqueue to dispatch: THE serving-latency
             # number admission control exists to bound.
             r.observe("executor.queue_delay_s", max(0.0, queue_delay_s))
         if cap > 0:
             r.observe("executor.batch_occupancy", nkeys / cap)
+
+    def record_run(self, inflight_depth: int, overlapped: bool) -> None:
+        """One pipelined run retired: depth seen at its dispatch, and
+        whether another run was already in flight then (overlap)."""
+        r = self.registry
+        r.inc("executor.runs_total")
+        r.observe("executor.inflight_depth", inflight_depth)
+        if overlapped:
+            r.inc("executor.runs_overlapped_total")
 
     def record_error(self, kind: str) -> None:
         self.registry.inc(f"executor.errors.{kind}")
@@ -301,3 +316,18 @@ class ExecutorMetrics:
     def record_cancelled(self, nops: int) -> None:
         """Ops still queued when the dispatcher exited (shutdown sweep)."""
         self.registry.inc("executor.cancelled_total", nops)
+
+
+def register_read_cache(registry: MetricsRegistry, cache) -> None:
+    """Expose a backend's epoch-stamped read cache (hits / misses / hit
+    ratio / live entries) as gauges — the client wires this when the sketch
+    backend carries one (client-side-caching observability analogue)."""
+    registry.gauge("backend.read_cache_hits", lambda: cache.hits)
+    registry.gauge("backend.read_cache_misses", lambda: cache.misses)
+    registry.gauge("backend.read_cache_entries", lambda: len(cache))
+
+    def _ratio() -> float:
+        total = cache.hits + cache.misses
+        return (cache.hits / total) if total else 0.0
+
+    registry.gauge("backend.read_cache_hit_ratio", _ratio)

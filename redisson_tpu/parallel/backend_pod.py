@@ -59,6 +59,10 @@ class _PodBits:
 class PodBackend:
     GLOBAL_COALESCE = frozenset({"hll_add"})
     BLOOM_STRICT_MOD = True  # same _mod_u64 precondition as the 1-chip tier
+    # Like the 1-chip tier: bank/store swaps and version bumps all happen on
+    # the dispatcher thread inside run(); only result materialization is
+    # deferred. The executor may release per-target gates at staging time.
+    DISPATCH_TIME_STATE = True
 
     def __init__(self, cfg):
         self.mesh = build_mesh(cfg.num_shards)
@@ -346,6 +350,7 @@ class PodBackend:
             return
         est = _start_d2h(sharded.bank_count_row(self.bank, np.int32(row)))
         self.completer.submit(
+            # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
             _complete_all(ops, lambda: int(round(float(est)))))
 
     def _op_hll_count_with(self, target: str, ops: List[Op]) -> None:
@@ -362,6 +367,7 @@ class PodBackend:
                 sharded.bank_count_rows_merged(self.bank, rows_arr, self.mesh)
             )
             self.completer.submit(
+                # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
                 _complete_all([op], lambda est=est: int(round(float(est)))))
 
     def _merge_rows(self, target: str):
@@ -393,12 +399,14 @@ class PodBackend:
             self._row_versions[target] = self._row_versions.get(target, 0) + 1
             est = _start_d2h(est)
             self.completer.submit(
+                # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
                 _complete_all([op], lambda est=est: int(round(float(est)))))
 
     def _op_hll_count_all(self, target: str, ops: List[Op]) -> None:
         """Union count of the entire bank — one ICI pmax all-reduce."""
         est = _start_d2h(sharded.bank_count_all(self.bank, self.mesh))
         self.completer.submit(
+            # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
             _complete_all(ops, lambda: int(round(float(est)))))
 
     # -- sharded BitSet (mesh-spanning bit arrays) ---------------------------

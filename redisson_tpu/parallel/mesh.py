@@ -14,6 +14,7 @@ def build_mesh(num_devices: int = 0, axis: str = SHARD_AXIS) -> Mesh:
     devs = jax.devices()
     if num_devices:
         devs = devs[:num_devices]
+    # graftlint: allow-sync(host metadata: jax.devices() is a python list of device handles, not a device array)
     return Mesh(np.array(devs), (axis,))
 
 

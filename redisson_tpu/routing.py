@@ -26,6 +26,12 @@ class RoutingBackend:
         self.structures = structures or StructureBackend()
         self.GLOBAL_COALESCE = frozenset(getattr(sketch_backend, "GLOBAL_COALESCE", ()))
         self.BLOOM_STRICT_MOD = bool(getattr(sketch_backend, "BLOOM_STRICT_MOD", False))
+        # Both tiers commit all observable state inside run() (the structure
+        # engine resolves synchronously), so the router is dispatch-time-state
+        # exactly when the sketch tier is — the executor may then release
+        # per-target gates at staging time and pipeline the device work.
+        self.DISPATCH_TIME_STATE = bool(
+            getattr(sketch_backend, "DISPATCH_TIME_STATE", False))
         self.pubsub = self.structures.pubsub
 
     # sketch kinds = everything the sketch backend implements, minus the

@@ -49,6 +49,7 @@ class CostModel:
         self._overhead_s: Dict[str, float] = {}
         self._generic_s_per_key: Optional[float] = None
         self._observations = 0
+        self._stage_s: Dict[str, float] = {}
 
     def observe(self, kind: str, nkeys: int, seconds: float) -> None:
         if seconds <= 0.0:
@@ -70,6 +71,19 @@ class CostModel:
                 whole if self._generic_s_per_key is None
                 else (1 - a) * self._generic_s_per_key + a * whole)
             self._observations += 1
+
+    def observe_stage(self, kind: str, nkeys: int, seconds: float) -> None:
+        """Host-side staging cost (pad + device_put + enqueue) from the
+        pipelined executor's dispatcher. Tracked separately — it must NOT
+        feed the service-time EWMA, which with async dispatch would
+        otherwise collapse to ~staging time and starve batch sizing."""
+        if seconds <= 0.0:
+            return
+        with self._lock:
+            prev = self._stage_s.get(kind)
+            self._stage_s[kind] = (seconds if prev is None
+                                   else (1 - self._alpha) * prev
+                                   + self._alpha * seconds)
 
     def s_per_key(self, kind: Optional[str]) -> float:
         with self._lock:
@@ -110,6 +124,7 @@ class CostModel:
                 "s_per_key": dict(self._s_per_key),
                 "overhead_s": dict(self._overhead_s),
                 "generic_s_per_key": self._generic_s_per_key,
+                "stage_s": dict(self._stage_s),
             }
 
 
@@ -153,7 +168,14 @@ class AdaptiveBatchPolicy:
         return close_at - now
 
     def observe(self, kind: str, nkeys: int, seconds: float) -> None:
+        """Completion latency of a run (stage + device + D2H) — the service
+        time the EWMA sizes batches against. With the pipelined executor
+        this fires from the completion callback, not the dispatcher."""
         self.cost_model.observe(kind, nkeys, seconds)
+
+    def observe_dispatch(self, kind: str, nkeys: int, seconds: float) -> None:
+        """Dispatcher staging time for a run (non-blocking backend.run)."""
+        self.cost_model.observe_stage(kind, nkeys, seconds)
 
     def snapshot(self) -> Dict[str, Any]:
         return {

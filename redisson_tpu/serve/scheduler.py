@@ -394,6 +394,9 @@ class ServingLayer:
             "breakers": self._breakers.snapshot(),
             "policy": pol.snapshot() if pol is not None else None,
             "executor_queue_depth": self._executor.queue_depth(),
+            "pipeline": (self._executor.pipeline_stats()
+                         if hasattr(self._executor, "pipeline_stats")
+                         else None),
             "counters": {
                 k: v for k, v in
                 self._registry.snapshot()["counters"].items()

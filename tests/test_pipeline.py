@@ -1,0 +1,389 @@
+"""Pipelined dispatch semantics (PR 4 tentpole) + epoch read cache.
+
+Pins the acceptance contract: per-target FIFO and read-your-writes hold at
+any in-flight window, a randomized op schedule is bit-identical to the
+serial (window=1) executor, shutdown drains in-flight runs and cancels
+staged-but-undispatched ops without hanging, deadline expiry still fires
+pre-dispatch, the cost-model EWMA converges to device-completion time (not
+staging time), and the epoch-stamped read cache invalidates on write /
+delete / rename / import / absorb / flushall.
+"""
+
+import queue
+import random
+import threading
+import time
+from concurrent.futures import CancelledError
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config, TpuConfig
+from redisson_tpu.executor import CommandExecutor
+from redisson_tpu.observability import ExecutorMetrics, MetricsRegistry
+from redisson_tpu.serve.errors import DeadlineExceeded
+from redisson_tpu.serve.policy import AdaptiveBatchPolicy, CostModel
+
+
+class AsyncSimBackend:
+    """Toy key-value backend with device-like asynchrony: run() commits
+    state synchronously on the dispatcher (dispatch-time state, like the
+    TPU tier's store swaps) but resolves futures on a worker thread after a
+    simulated device delay — the shape the pipeline must stay correct
+    against."""
+
+    DISPATCH_TIME_STATE = True
+
+    def __init__(self, device_s: float = 0.0):
+        self.device_s = device_s
+        self.state = {}  # target -> list of applied payloads
+        self.runs = []  # (kind, target) in dispatch order
+        self._q = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def run(self, kind, target, ops):
+        self.runs.append((kind, target))
+        staged = []
+        for op in ops:
+            if op.kind == "set":
+                vals = self.state.setdefault(op.target, [])
+                vals.append(op.payload)
+                staged.append((op, len(vals)))
+            elif op.kind == "get":
+                # Snapshot at stage time = dispatch-time-state semantics.
+                staged.append((op, list(self.state.get(op.target, []))))
+            else:
+                raise ValueError(op.kind)
+        self._q.put(staged)
+
+    def _drain(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            if self.device_s:
+                time.sleep(self.device_s)
+            for op, val in item:
+                if not op.future.done():
+                    op.future.set_result(val)
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+
+class WedgedBackend:
+    """run() blocks until released — models a hung device call."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def run(self, kind, target, ops):
+        self.entered.set()
+        self.release.wait(timeout=30)
+        for op in ops:
+            if not op.future.done():
+                op.future.set_result(None)
+
+
+def make_executor(backend, window, **kw):
+    return CommandExecutor(backend, inflight_runs=window, **kw)
+
+
+def test_read_your_writes_window_gt1():
+    backend = AsyncSimBackend(device_s=0.005)
+    ex = make_executor(backend, window=4)
+    try:
+        futures = []
+        for i in range(20):
+            ex.execute_async("t", "set", i, nkeys=1)
+            futures.append(ex.execute_async("t", "get", None, nkeys=1))
+        for i, f in enumerate(futures):
+            # The read staged right after write i must observe writes 0..i.
+            assert f.result(timeout=10) == list(range(i + 1))
+    finally:
+        ex.shutdown()
+        backend.close()
+
+
+def test_per_target_fifo_resolution_order():
+    backend = AsyncSimBackend(device_s=0.002)
+    ex = make_executor(backend, window=4)
+    resolved = []
+    lock = threading.Lock()
+    try:
+        futs = []
+        for i in range(30):
+            target = f"t{i % 3}"
+            f = ex.execute_async(target, "set", i, nkeys=1)
+            f.add_done_callback(
+                lambda _f, t=target, i=i: (lock.acquire(),
+                                           resolved.append((t, i)),
+                                           lock.release()))
+            futs.append(f)
+        for f in futs:
+            f.result(timeout=10)
+        per_target = {}
+        for t, i in resolved:
+            per_target.setdefault(t, []).append(i)
+        for t, seq in per_target.items():
+            assert seq == sorted(seq), f"{t} resolved out of order: {seq}"
+    finally:
+        ex.shutdown()
+        backend.close()
+
+
+def test_randomized_schedule_identical_to_serial():
+    """Acceptance pin: dispatch-time-state results are bit-identical between
+    the serial executor and a deep pipeline on a randomized schedule."""
+    rng = random.Random(7)
+    schedule = []
+    for _ in range(200):
+        target = f"k{rng.randrange(5)}"
+        if rng.random() < 0.6:
+            schedule.append((target, "set", rng.randrange(1000)))
+        else:
+            schedule.append((target, "get", None))
+
+    def play(window):
+        backend = AsyncSimBackend(device_s=0.001 if window > 1 else 0.0)
+        ex = make_executor(backend, window=window)
+        try:
+            futs = [ex.execute_async(t, k, p, nkeys=1) for t, k, p in schedule]
+            results = [f.result(timeout=30) for f in futs]
+        finally:
+            ex.shutdown()
+            backend.close()
+        return results, backend.state
+
+    serial_results, serial_state = play(1)
+    piped_results, piped_state = play(4)
+    assert piped_results == serial_results
+    assert piped_state == serial_state
+
+
+def test_overlap_happens_and_window_bounds_depth():
+    reg = MetricsRegistry()
+    backend = AsyncSimBackend(device_s=0.02)
+    ex = make_executor(backend, window=2, metrics=ExecutorMetrics(reg))
+    try:
+        futs = [ex.execute_async(f"t{i}", "set", i, nkeys=1)
+                for i in range(10)]
+        for f in futs:
+            f.result(timeout=10)
+        stats = ex.pipeline_stats()
+        assert stats["window"] == 2
+        assert stats["eager_release"] is True
+        assert stats["runs_completed"] >= 10
+        assert stats["overlap_ratio"] > 0.0
+        depth = reg.histogram("executor.inflight_depth").snapshot()
+        assert depth["max"] <= 2  # the window is a hard bound
+    finally:
+        ex.shutdown()
+        backend.close()
+
+
+def test_shutdown_drains_inflight_runs():
+    backend = AsyncSimBackend(device_s=0.02)
+    ex = make_executor(backend, window=4)
+    futs = [ex.execute_async(f"t{i}", "set", i, nkeys=1) for i in range(6)]
+    ex.shutdown(wait=True)
+    backend.close()
+    for f in futs:
+        assert f.done()
+        assert f.result(timeout=0) is not None
+
+
+def test_shutdown_cancels_queued_behind_wedged_backend():
+    backend = WedgedBackend()
+    ex = make_executor(backend, window=1)
+    a = ex.execute_async("t", "set", 0, nkeys=1)
+    assert backend.entered.wait(timeout=5)
+    b = ex.execute_async("t", "set", 1, nkeys=1)
+    c = ex.execute_async("u", "set", 2, nkeys=1)
+    t0 = time.monotonic()
+    ex.shutdown(wait=True, timeout=0.5)
+    assert time.monotonic() - t0 < 5.0  # bounded, no hang
+    for f in (b, c):
+        with pytest.raises(CancelledError):
+            f.result(timeout=0)
+    backend.release.set()
+    a.result(timeout=10)
+
+
+class ParkingBackend:
+    """Non-DTS backend where bpop parks its future and a later op to the
+    same target fulfils it — the redis tier's blocking-pop shape."""
+
+    def __init__(self):
+        self.parked = []
+
+    def run(self, kind, target, ops):
+        for op in ops:
+            if op.kind == "bpop":
+                self.parked.append(op)
+            else:
+                while self.parked:
+                    self.parked.pop(0).future.set_result(op.payload)
+                op.future.set_result(True)
+
+
+def test_parked_bpop_does_not_wedge_window():
+    """Regression: a parked blocking pop must release its target gate AND
+    its window slot at run() return, or (window=1, non-DTS backend) the
+    push that would fulfil it could never dispatch — the deadlock the
+    redis-tier conformance suite hit."""
+    backend = ParkingBackend()
+    ex = make_executor(backend, window=1)
+    try:
+        take = ex.execute_async("q", "bpop", {"side": "left"}, nkeys=1)
+        take2 = ex.execute_async("q2", "bpop", {"side": "left"}, nkeys=1)
+        ex.execute_async("q", "set", b"v", nkeys=1)
+        assert take.result(timeout=5) == b"v"
+        assert take2.result(timeout=5) == b"v"  # served by the same push
+        assert ex.pipeline_stats()["inflight"] == 0
+    finally:
+        ex.shutdown()
+
+
+def test_deadline_expiry_fires_pre_dispatch():
+    backend = AsyncSimBackend()
+    ex = make_executor(backend, window=2)
+    try:
+        f = ex.execute_async("t", "set", 1, nkeys=1,
+                             deadline=time.monotonic() - 1.0)
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=10)
+        assert backend.runs == []  # never reached the backend
+    finally:
+        ex.shutdown()
+        backend.close()
+
+
+def test_ewma_converges_to_device_time_not_staging_time():
+    """Satellite regression: with async dispatch the policy's service EWMA
+    must feed from completion latency (~device_s here), while the staging
+    EWMA stays near the (tiny) host prep cost."""
+    device_s = 0.05
+    policy = AdaptiveBatchPolicy(CostModel())
+    backend = AsyncSimBackend(device_s=device_s)
+    ex = make_executor(backend, window=2, policy=policy)
+    try:
+        for i in range(12):
+            ex.execute_async("t", "set", i, nkeys=1).result(timeout=10)
+        est = policy.cost_model.estimate("set", 1)
+        assert est > device_s / 2, (
+            f"service estimate {est:.6f}s collapsed toward staging time")
+        stage = policy.cost_model.snapshot()["stage_s"].get("set", 0.0)
+        assert stage < device_s / 2, (
+            f"staging EWMA {stage:.6f}s absorbed device time")
+    finally:
+        ex.shutdown()
+        backend.close()
+
+
+# ---------------------------------------------------------------------------
+# Epoch-stamped read cache (backend_tpu.EpochReadCache) — client-level
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def client():
+    c = RedissonTPU.create(Config(tpu=TpuConfig(device_index=0)))
+    yield c
+    c.shutdown()
+
+
+def _cache_of(client):
+    return client._routing.sketch.read_cache
+
+
+def test_hll_count_cached_and_invalidated_on_write(client):
+    h = client.get_hyper_log_log("pipe:hll")
+    h.add_all(list(range(1000)))
+    first = h.count()
+    hits0 = _cache_of(client).hits
+    assert h.count() == first
+    assert _cache_of(client).hits > hits0  # second read served from cache
+    h.add_all(list(range(1000, 3000)))  # write bumps the epoch
+    assert h.count() > first  # not the stale cached value
+
+
+def test_bitset_cardinality_cached_and_delete_invalidates(client):
+    b = client.get_bit_set("pipe:bits")
+    b.set_bits([1, 5, 9, 300])
+    assert b.cardinality() == 4
+    hits0 = _cache_of(client).hits
+    assert b.cardinality() == 4
+    assert _cache_of(client).hits > hits0
+    client.delete("pipe:bits")
+    b2 = client.get_bit_set("pipe:bits")
+    b2.set_bits([2])
+    assert b2.cardinality() == 1  # delete invalidated the cached 4
+
+
+def test_bloom_contains_cached_and_add_invalidates(client):
+    f = client.get_bloom_filter("pipe:bloom")
+    f.try_init(10_000, 0.01)
+    f.add_all([b"a", b"b", b"c"])
+    assert list(f.contains_all([b"a", b"b"])) == [True, True]
+    hits0 = _cache_of(client).hits
+    assert list(f.contains_all([b"a", b"b"])) == [True, True]
+    assert _cache_of(client).hits > hits0
+    # A write must invalidate: the same probe re-evaluates and d appears.
+    f.add_all([b"d"])
+    assert list(f.contains_all([b"d"])) == [True]
+
+
+def test_rename_invalidates_both_names(client):
+    h = client.get_hyper_log_log("pipe:src")
+    h.add_all(list(range(500)))
+    n_src = h.count()
+    h.rename("pipe:dst")
+    h2 = client.get_hyper_log_log("pipe:dst")
+    assert abs(h2.count() - n_src) <= max(2, int(0.05 * n_src))
+    # Recreated source must not serve the old cached count.
+    h3 = client.get_hyper_log_log("pipe:src")
+    h3.add_all([1, 2, 3])
+    assert h3.count() < 100
+
+
+def test_flushall_clears_epochs_and_cache(client):
+    h = client.get_hyper_log_log("pipe:flush")
+    h.add_all(list(range(2000)))
+    h.count()
+    h.count()  # populate the cache
+    client.flushall()
+    assert len(_cache_of(client)) == 0
+    h2 = client.get_hyper_log_log("pipe:flush")
+    h2.add_all([1])
+    assert h2.count() <= 2  # fresh object, no stale epoch hit
+
+
+def test_bits_import_invalidates(client):
+    b = client.get_bit_set("pipe:imp")
+    b.set_bits([0, 1, 2, 3])
+    assert b.cardinality() == 4
+    # Restore a smaller checkpoint over the same name (replication path).
+    ex = client._executor
+    arr = np.zeros((64,), np.uint8)
+    arr[0] = 1
+    from redisson_tpu.store import ObjectType
+
+    ex.execute_sync("pipe:imp", "bits_import", {
+        "otype": ObjectType.BITSET, "array": arr,
+        "meta": {"nbits": 64, "extent_bits": 64}})
+    assert b.cardinality() == 1  # import bumped the epoch
+
+
+def test_read_cache_stats_exposed_in_metrics(client):
+    h = client.get_hyper_log_log("pipe:metrics")
+    h.add_all(list(range(100)))
+    h.count()
+    h.count()
+    snap = client.metrics.snapshot()["gauges"]
+    assert snap["backend.read_cache_hits"] >= 1
+    assert 0.0 < snap["backend.read_cache_hit_ratio"] <= 1.0

@@ -160,6 +160,44 @@ def test_g002_scoped_to_dispatch_paths():
     assert "G002" not in rules_of(cold)
 
 
+def test_g002_one_hop_name_provenance():
+    """`x = engine_call(...); int(x)` is flagged, not just direct nesting —
+    the shape the pipelined executor's staging code must never contain."""
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def count(bits):
+            est = jnp.sum(bits, axis=0)
+            return int(est)
+    """)
+    assert "G002" in rules_of(findings)
+
+
+def test_g002_provenance_host_assignment_ok():
+    """A Name assigned from host-only math does not trip the hop."""
+    findings = lint_src("""
+        import jax.numpy as jnp
+
+        def count(bits):
+            est = len(bits) * 2
+            return int(est)
+    """)
+    assert "G002" not in rules_of(findings)
+
+
+def test_g002_executor_in_sync_scope():
+    """executor.py staging code is now inside the G002 scope."""
+    src = """
+        import jax.numpy as jnp
+
+        def stage(bits):
+            return int(jnp.max(bits, axis=0))
+    """
+    hot = FileLinter(os.path.join(REPO, "redisson_tpu", "executor.py"),
+                     repo_root=REPO, source=textwrap.dedent(src)).run()
+    assert "G002" in rules_of(hot)
+
+
 def test_g003_python_scalar_missing_static():
     findings = lint_src("""
         import jax

@@ -4,9 +4,13 @@ All rules are heuristic pattern matches tuned to this codebase's real
 failure modes (see findings.RULES). Scope notes:
 
 * G002 (sync) only applies to dispatch-path files under
-  ``redisson_tpu/`` (engine.py, backend_tpu.py, parallel/, ingest/) —
-  unless the file was passed to the CLI explicitly, in which case every
-  rule applies (so scratch files get full coverage).
+  ``redisson_tpu/`` (engine.py, backend_tpu.py, executor.py, parallel/,
+  ingest/) — unless the file was passed to the CLI explicitly, in which
+  case every rule applies (so scratch files get full coverage). The rule
+  follows one hop of Name provenance inside the enclosing function:
+  ``x = engine.foo(...); int(x)`` is flagged, not just ``int(engine.foo())``.
+  Completer-thread closures (where blocking is the job) carry reasoned
+  ``allow-sync`` suppressions.
 * G004 is disabled inside ``ops/u64.py`` (that module IS the lane
   discipline) and G004's big-literal check exempts arguments of u64
   helper calls and module-level named-constant assignments.
@@ -139,7 +143,7 @@ class FileLinter:
             return False
         sub = rel[len("redisson_tpu/"):]
         return (
-            sub in ("engine.py", "backend_tpu.py")
+            sub in ("engine.py", "backend_tpu.py", "executor.py")
             or sub.startswith("parallel/")
             or sub.startswith("ingest/")
         )
@@ -232,7 +236,7 @@ class FileLinter:
         if isinstance(node, ast.Call):
             self._check_g001(node)
             if self._g002_on:
-                self._check_g002(node)
+                self._check_g002(node, fn_node)
             if self._g006_on:
                 self._check_g006(node)
             self._check_jit_construction(node, in_func, in_loop)
@@ -356,7 +360,7 @@ class FileLinter:
 
     # -- G002: implicit host syncs ------------------------------------------
 
-    def _check_g002(self, call: ast.Call) -> None:
+    def _check_g002(self, call: ast.Call, fn_node=None) -> None:
         f = call.func
         label = None
         target = None
@@ -369,8 +373,27 @@ class FileLinter:
             elif (f.attr in ("asarray", "array") and self._is_np(f.value)
                     and call.args):
                 label, target = f"np.{f.attr}", call.args[0]
-        if target is None or not self._contains_device_call(target):
+        if target is None or not self._device_provenance(target, fn_node):
             return
+        self._device_provenance_emit(call, label)
+
+    def _device_provenance(self, target: ast.AST, fn_node) -> bool:
+        """Does `target` carry a device value? Direct device-call
+        expressions, plus one hop of Name provenance within the enclosing
+        function (`x = engine.foo(...)` then `int(x)`) — the shape the
+        pipelined executor's staging code must never contain."""
+        if self._contains_device_call(target):
+            return True
+        if isinstance(target, ast.Name) and fn_node is not None:
+            for stmt in ast.walk(fn_node):
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == target.id
+                        and self._contains_device_call(stmt.value)):
+                    return True
+        return False
+
+    def _device_provenance_emit(self, call: ast.Call, label: str) -> None:
         self._emit(
             "G002", call,
             f"`{label}(...)` on a device value — blocking device->host sync "
