@@ -437,6 +437,67 @@ def bench_read_cache(n, reps=20):
         client.shutdown()
 
 
+def bench_journal_overhead(rounds=200, reps=3):
+    """Write-ahead journal tax (PR 6): the batched-insert path with the
+    everysec journal hooked into the dispatcher vs the same client without
+    persistence. Async submits keep the dispatch window (>= 2) full so
+    journal appends overlap device work; best-of-reps squeezes out
+    scheduler jitter. The acceptance budget for this number is < 10%."""
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    batch = 64
+    ints = np.random.default_rng(11).integers(
+        0, 2**63, size=(rounds, batch), dtype=np.uint64)
+
+    def timed(client):
+        h = client.get_hyper_log_log("bench:wal")
+        m = client.get_map("bench:walm")
+        best = float("inf")
+        for _ in range(reps):
+            pend = []
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                pend.append(h.add_ints_async(ints[i]))
+                pend.append(m.put_async(f"f{i}", i))
+                if len(pend) >= 8:
+                    for f in pend:
+                        f.result(timeout=60)
+                    pend.clear()
+            for f in pend:
+                f.result(timeout=60)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    root = tempfile.mkdtemp(prefix="rtpu-bench-wal-")
+    try:
+        base_client = RedissonTPU.create()
+        try:
+            timed(base_client)  # warm compile/caches
+            base = timed(base_client)
+        finally:
+            base_client.shutdown()
+
+        cfg = Config()
+        cfg.use_persist(root).fsync = "everysec"
+        wal_client = RedissonTPU.create(cfg)
+        try:
+            timed(wal_client)
+            wal = timed(wal_client)
+        finally:
+            wal_client.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    pct = 100.0 * (wal / base - 1.0)
+    print(f"# journal_overhead: {base * 1e3:.1f} ms bare -> {wal * 1e3:.1f} ms "
+          f"with everysec journal ({pct:+.1f}%)", file=sys.stderr)
+    return pct
+
+
 def bench_pfmerge(jax, dev, sketches=1000):
     """PFMERGE+count across 1K sketches (BASELINE: <50 ms)."""
     from redisson_tpu import engine
@@ -554,6 +615,11 @@ def main():
             1 << 12 if quick else 1 << 18, reps=5 if quick else 20)
     except Exception as exc:  # noqa: BLE001
         print(f"# read-cache bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["journal_overhead_pct"] = round(bench_journal_overhead(
+            50 if quick else 200, reps=2 if quick else 3), 1)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# journal overhead bench failed: {exc!r}", file=sys.stderr)
     try:
         result["pfmerge_1000_ms"] = round(
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)

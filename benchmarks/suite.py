@@ -796,6 +796,136 @@ def pipeline_smoke():
     return ok
 
 
+def _engine_digest(client) -> str:
+    """Bit-identical engine fingerprint (sketch arrays + structure tier) —
+    the same definition tests/test_persist.py pins recovery against."""
+    import hashlib
+
+    h = hashlib.sha256()
+    store = client._store
+    for name in sorted(store.keys()):
+        obj = store.get(name)
+        if obj is None:
+            continue
+        arr = np.asarray(obj.state)
+        h.update(name.encode())
+        h.update(str(obj.otype).encode())
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+        h.update(repr(sorted(obj.meta.items())).encode())
+    structures = getattr(client._routing, "structures", None)
+    if structures is not None:
+        h.update(structures.dump_state())
+    return h.hexdigest()
+
+
+def persist_smoke():
+    """fsync-policy sweep through the write-ahead journal on the real local
+    client: a pipelined batched-insert workload (async submits, window =
+    Config.inflight_runs >= 2) per policy {none, off, everysec, always},
+    reporting wall time, overhead vs the journal-less baseline, and journal
+    stats. Then every persisted directory is treated as a crash image and
+    recovered into a fresh engine, which must be digest-identical to its
+    leader. Exit contract (the CPU-only CI acceptance for this PR):
+    everysec overhead < 10% AND every recovery bit-identical."""
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    rounds = 60 if _TINY else 300
+    batch = 64
+    rng = np.random.default_rng(7)
+    hll_batches = rng.integers(0, 2**63, size=(rounds, batch), dtype=np.uint64)
+
+    def run_workload(c):
+        """Batched inserts, submitted async so the dispatch window (>= 2)
+        can overlap journal appends with device work."""
+        pend = []
+        h = c.get_hyper_log_log("ps:hll")
+        m = c.get_map("ps:m")
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            pend.append(h.add_ints_async(hll_batches[i]))
+            pend.append(m.put_async(f"f{i}", i))
+            pend.append(c.get_bucket(f"ps:b{i % 32}").set_async(i))
+            if len(pend) >= 4 * 3:
+                for f in pend:
+                    f.result(timeout=60)
+                pend.clear()
+        for f in pend:
+            f.result(timeout=60)
+        return time.perf_counter() - t0
+
+    policies = ("none", "off", "everysec", "always")
+    root = tempfile.mkdtemp(prefix="rtpu-persist-smoke-")
+    walls, digests, jstats = {}, {}, {}
+    ok = True
+    try:
+        for policy in policies:
+            cfg = Config()
+            cfg.use_local()
+            if policy != "none":
+                cfg.use_persist(os.path.join(root, policy)).fsync = policy
+            c = RedissonTPU.create(cfg)
+            try:
+                run_workload(c)  # warm compile/caches
+                c.flushall()
+                # Best-of-N: walls are ~0.1s at tiny scale, where scheduler
+                # jitter swamps the real journal cost. Every repeat issues
+                # the identical op stream, so min is the honest estimate.
+                repeats = 3 if _TINY else 2
+                walls[policy] = min(run_workload(c) for _ in range(repeats))
+                if policy != "none":
+                    c.persist.journal.sync()
+                    jstats[policy] = c.persist.journal.stats()
+                    digests[policy] = _engine_digest(c)
+                    # crash image: copy while the journal is quiescent
+                    shutil.copytree(os.path.join(root, policy),
+                                    os.path.join(root, policy + ".img"))
+            finally:
+                c.shutdown()
+
+        base = walls["none"]
+        print(f"{'fsync':>9} {'wall_s':>8} {'overhead%':>9} "
+              f"{'fsyncs':>7} {'group_mean':>10}")
+        for policy in policies:
+            over = 100.0 * (walls[policy] / base - 1.0)
+            st = jstats.get(policy, {})
+            print(f"{policy:>9} {walls[policy]:>8.3f} {over:>8.1f}% "
+                  f"{st.get('fsyncs', 0):>7} {st.get('group_mean', 0.0):>10.2f}")
+            if policy == "everysec" and over >= 10.0:
+                print(f"#   everysec overhead {over:.1f}% >= 10% budget",
+                      file=sys.stderr)
+                ok = False
+
+        for policy in ("off", "everysec", "always"):
+            r = RedissonTPU.create(_persist_cfg(os.path.join(root, policy + ".img")))
+            try:
+                rec = r.persist.last_recovery or {}
+                same = _engine_digest(r) == digests[policy]
+                print(f"# recover[{policy}]: replayed {rec.get('replayed', 0)} "
+                      f"ops at {rec.get('ops_per_s', 0.0):.0f} op/s, "
+                      f"digest {'identical' if same else 'MISMATCH'}")
+                if not same or rec.get("replay_errors"):
+                    ok = False
+            finally:
+                r.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return ok
+
+
+def _persist_cfg(path):
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_persist(path)
+    return cfg
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -818,6 +948,10 @@ def main():
                     help="in-flight window sweep {1,2,4}: overlap ratio, "
                          "result identity vs serial, read-cache hit rate, "
                          "then exit")
+    ap.add_argument("--persist-smoke", action="store_true",
+                    help="fsync-policy sweep {none,off,everysec,always}: "
+                         "journal overhead per policy + kill-and-recover "
+                         "digest identity, then exit")
     args = ap.parse_args()
 
     if args.serve_smoke:
@@ -825,6 +959,9 @@ def main():
 
     if args.pipeline_smoke:
         sys.exit(0 if pipeline_smoke() else 1)
+
+    if args.persist_smoke:
+        sys.exit(0 if persist_smoke() else 1)
 
     if args.lint_smoke:
         from tools.graftlint import run_lint

@@ -638,6 +638,18 @@ class TpuBackend:
         call it directly)."""
         self._epochs[name] = self._epochs.get(name, 0) + 1
 
+    def notify_restored(self, name: str) -> None:
+        """Checkpoint/snapshot restore swapped `name`'s device state in
+        UNDER the op path (store.swap, not an _op_ handler): bump the
+        epoch so epoch-stamped cached reads go stale, drop the entry's
+        cached reads outright, and discard any host bloom mirror built
+        against the pre-restore filter (it would silently serve wrong
+        membership bits). Replayed journal ops need none of this — they
+        re-enter through run() and touch epochs like live traffic."""
+        self._bloom_mirrors.pop(name, None)
+        self._touch(name)
+        self.read_cache.invalidate(name)
+
     # durability/checkpoint surface (same duck type as PodBackend — the
     # client's _pod_backend() probe picks this up, so bank rows flush and
     # checkpoint through dispatcher-serialized hll_export/hll_import).

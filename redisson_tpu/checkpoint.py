@@ -45,15 +45,48 @@ def _swap_lock(path: str) -> threading.Lock:
         return _path_locks[os.path.abspath(path)]
 
 
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Directory fsync: makes the rename/creation itself durable. No-op
+    where directories can't be opened (exotic filesystems)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save(store: SketchStore, path: str,
          names: Optional[List[str]] = None,
-         extra_objects: Optional[Dict] = None) -> int:
+         extra_objects: Optional[Dict] = None,
+         manifest_extra: Optional[Dict] = None,
+         extra_files: Optional[Dict[str, bytes]] = None) -> int:
     """Snapshot the named objects (default all) into `path`. Returns count.
 
     extra_objects: {name: (otype, host_array, meta, version)} for state
     living outside the store — pod-mode bank rows exported by the client
     (dispatcher-serialized). Saved identically, so checkpoints are portable
-    between pod and single-chip modes."""
+    between pod and single-chip modes.
+
+    manifest_extra: extra top-level manifest keys (the persist snapshotter
+    records its journal watermark here); load() ignores unknown keys.
+
+    extra_files: {filename: bytes} written beside the manifest — opaque
+    sidecar state (the structure tier's pickled keyspace). Read back via
+    `extra_file()`. Pass names=[] to skip the store walk entirely and save
+    only extra_objects/extra_files (a pre-captured consistent cut)."""
     if names is None:
         names = store.keys()
     objs = {}
@@ -88,13 +121,29 @@ def save(store: SketchStore, path: str,
     # Unique tmp dir: concurrent save() calls never clobber each other.
     tmp = tempfile.mkdtemp(prefix=os.path.basename(path) + ".tmp.", dir=parent)
     try:
+        manifest = {"version": FORMAT_VERSION, "written_at": time.time(),
+                    "objects": objs}
+        manifest.update(manifest_extra or {})
         with open(os.path.join(tmp, MANIFEST), "w") as f:
-            json.dump({"version": FORMAT_VERSION, "written_at": time.time(),
-                       "objects": objs}, f, indent=1)
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
         # Prefix array keys: a sketch literally named "file" would collide
         # with savez's first positional parameter as a bare kwarg.
         np.savez_compressed(os.path.join(tmp, STATE),
                             **{_KEY_PREFIX + k: v for k, v in arrays.items()})
+        for fname, blob in (extra_files or {}).items():
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+        # Durability before the swap: the rename below is atomic against a
+        # crash of THIS process, but after power loss the directory entry
+        # may point at files whose data never left the page cache — fsync
+        # every payload file and the tmp directory first, and the parent
+        # after the swap so the rename itself is durable.
+        _fsync_file(os.path.join(tmp, STATE))
+        _fsync_dir(tmp)
         # Exchange-style swap: the previous good checkpoint survives (as
         # `.old`) through every crash point; load() falls back to it.
         # In-process concurrent saves serialize here.
@@ -105,6 +154,7 @@ def save(store: SketchStore, path: str,
             if os.path.exists(path):
                 os.replace(path, old)
             os.replace(tmp, path)
+            _fsync_dir(parent)
             if os.path.exists(old):
                 shutil.rmtree(old)
     except BaseException:
@@ -160,6 +210,28 @@ def load(store: SketchStore, path: str,
 
 
 def info(path: str) -> Dict:
-    """Read a checkpoint's manifest without loading state."""
+    """Read a checkpoint's manifest without loading state. Falls back to
+    the `.old` sibling exactly like load() — a crash between the two
+    os.replace calls leaves only `.old` valid, and callers probing "is
+    there a checkpoint here?" must see the same answer load() would act
+    on."""
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        old = path + ".old"
+        if os.path.exists(os.path.join(old, MANIFEST)):
+            path = old
     with open(os.path.join(path, MANIFEST)) as f:
         return json.load(f)
+
+
+def extra_file(path: str, name: str) -> Optional[bytes]:
+    """Read a sidecar file written via save(extra_files=...), honoring the
+    same `.old` fallback as load()/info(). None when absent."""
+    if not os.path.exists(os.path.join(path, MANIFEST)):
+        old = path + ".old"
+        if os.path.exists(os.path.join(old, MANIFEST)):
+            path = old
+    full = os.path.join(path, name)
+    if not os.path.exists(full):
+        return None
+    with open(full, "rb") as f:
+        return f.read()

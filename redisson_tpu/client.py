@@ -114,6 +114,21 @@ class RedissonTPU:
         self._remote_services = {}
         self._durability = None
         self._resp = None
+        # Native durability (persist/): journal + snapshots + auto-recover.
+        # Wired BEFORE user traffic can flow (the getters don't exist yet)
+        # and before the redis durability tier, so a recovered engine
+        # flushes recovered state, not a partial one.
+        self._persist = None
+        pcfg = self.config.persist
+        if pcfg is not None and pcfg.dir:
+            from redisson_tpu.persist import PersistenceManager
+
+            self._persist = PersistenceManager(self, pcfg)
+            try:
+                self._persist.start()
+            except Exception:
+                self.shutdown()
+                raise
         if self.config.redis is not None and mode != "redis":
             try:
                 self._connect_durability()
@@ -259,6 +274,12 @@ class RedissonTPU:
         from redisson_tpu.interop.backend_redis import RedisBackend
         from redisson_tpu.observability import MetricsRegistry
 
+        if self.config.persist is not None and self.config.persist.dir:
+            raise NotImplementedError(
+                "persist/ journals an engine-owned state tier; in redis "
+                "passthrough mode the server owns the state (use the "
+                "server's own AOF/RDB)")
+        self._persist = None
         self._resp = self._make_resp_pool()
         try:
             self._resp.connect()
@@ -362,6 +383,19 @@ class RedissonTPU:
         """The DurabilityManager when a redis tier is configured, else None."""
         return self._durability
 
+    @property
+    def persist(self):
+        """The PersistenceManager when Config.persist is set, else None."""
+        return getattr(self, "_persist", None)
+
+    def snapshot_now(self) -> str:
+        """On-demand persistent snapshot (BGSAVE analogue): cuts through
+        the dispatcher barrier, writes via checkpoint.py, truncates covered
+        journal segments. Returns the snapshot directory."""
+        if self._persist is None:
+            raise RuntimeError("no persistence configured (Config.persist)")
+        return self._persist.snapshot()
+
     def flush_to_redis(self, names=None) -> int:
         if self._durability is None:
             raise RuntimeError("no redis durability tier configured")
@@ -438,7 +472,17 @@ class RedissonTPU:
                 return True
             return False  # default store path
 
-        return checkpoint.load(self._store, path, names, put=put)
+        restored = checkpoint.load(self._store, path, names, put=put)
+        # Restore swaps state in UNDER the op path (store.swap), which the
+        # epoch-stamped read cache and bloom host mirrors can't see — tell
+        # the backend so stale cached reads/mirrors die with the old state.
+        sketch = getattr(self._routing, "sketch", None)
+        if sketch is not None and hasattr(sketch, "notify_restored"):
+            for n in checkpoint.info(path).get("objects", {}):
+                if names is not None and n not in names:
+                    continue
+                sketch.notify_restored(n)
+        return restored
 
     def _require_store(self, feature: str) -> None:
         if self._store is None:
@@ -739,6 +783,11 @@ class RedissonTPU:
             self._is_shutdown = True
 
     def _shutdown_inner(self):
+        if getattr(self, "_persist", None) is not None:
+            # Phase 1: stop the snapshotter before the executor drains (a
+            # barrier cut submitted after shutdown would never dispatch);
+            # the journal stays attached so drained ops still journal.
+            self._persist.stop_background()
         for rs in self._remote_services.values():
             try:
                 rs.shutdown(wait=False)
@@ -784,6 +833,14 @@ class RedissonTPU:
             self.serve.shutdown()
         else:
             self._executor.shutdown()
+        if getattr(self, "_persist", None) is not None:
+            # Phase 2: executor drained — every dispatched op has journaled;
+            # final flush + fsync, then release the segment files.
+            try:
+                self._persist.close()
+            except Exception:
+                pass
+            self._persist = None
         sketch = getattr(getattr(self, "_routing", None), "sketch", None)
         completer = getattr(sketch, "completer", None)
         if completer is not None:

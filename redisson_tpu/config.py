@@ -155,6 +155,34 @@ class ServeConfig:
 
 
 @dataclass
+class PersistConfig:
+    """Durability subsystem (redisson_tpu/persist/): write-ahead op journal
+    + background snapshots + crash recovery. Orthogonal to the backend mode
+    (any engine-owned tier persists; redis passthrough mode has no
+    client-side state to persist and rejects this section)."""
+
+    # Journal + snapshot directory ("" disables persistence even when the
+    # section is present — lets configs toggle without deleting it).
+    dir: str = ""
+    # appendfsync analogue: "always" (group-committed write-ahead fsync,
+    # durability lag bounded by the pipeline window), "everysec"
+    # (background fsync every fsync_interval_s), "off" (OS-paced).
+    fsync: str = "everysec"
+    fsync_interval_s: float = 1.0
+    # Group-commit size for fsync="always"; 0 = follow Config.inflight_runs
+    # (one fsync per pipeline window). 1 = strict fsync-per-run.
+    group_commit_runs: int = 0
+    segment_max_bytes: int = 64 << 20
+    # Background BGSAVE cadence (0 = on-demand via client.persist.snapshot()
+    # only). Each snapshot truncates wholly-covered journal segments.
+    snapshot_interval_s: float = 0.0
+    snapshot_keep: int = 2
+    # Replay snapshot + journal suffix automatically at client create when
+    # the directory holds prior state.
+    auto_recover: bool = True
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -162,6 +190,8 @@ class Config:
     redis: Optional[RedisConfig] = None
     # QoS serving layer (None = raw executor, the seed behavior).
     serve: Optional[ServeConfig] = None
+    # Durability subsystem (None = no journal/snapshots, the seed behavior).
+    persist: Optional[PersistConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -208,6 +238,12 @@ class Config:
         self.serve = self.serve or ServeConfig()
         return self.serve
 
+    def use_persist(self, dir: str = "") -> "PersistConfig":
+        self.persist = self.persist or PersistConfig()
+        if dir:
+            self.persist.dir = dir
+        return self.persist
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -238,6 +274,7 @@ class Config:
             "pod": PodConfig,
             "redis": RedisConfig,
             "serve": ServeConfig,
+            "persist": PersistConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

@@ -72,6 +72,15 @@ COALESCABLE = {"hll_add", "bloom_add", "bitset_set", "bitset_clear", "bitset_get
 # same reason, `command/CommandAsyncService.java:491-497`).
 PARKED_KINDS = frozenset({"bpop"})
 
+# Pseudo-kind intercepted by the dispatcher itself: the op's payload is a
+# zero-arg callable executed inline on the dispatcher thread. Because the
+# dispatcher is the only thread that stages runs AND appends journal
+# records, a barrier is an exact consistency cut for dispatch-time-state
+# backends — every previously dispatched run's state is committed and its
+# journal records appended, and nothing new stages while the callable
+# runs. The persist snapshotter cuts its snapshots through this.
+BARRIER_KIND = "__barrier__"
+
 _op_counter = itertools.count()
 
 
@@ -148,8 +157,12 @@ class CommandExecutor:
 
     def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None,
                  policy=None, clock: Callable[[], float] = None,
-                 inflight_runs: int = 2):
+                 inflight_runs: int = 2, journal=None):
         self._backend = backend
+        # Write-ahead op journal (persist/journal.py) or None. Appended on
+        # the dispatcher thread before each run stages; installed late by
+        # the client (after recovery replay) via set_journal().
+        self._journal = journal
         self._max_batch_keys = max_batch_keys
         self._metrics = metrics  # ExecutorMetrics or None (zero-cost when off)
         self._policy = policy or GreedyBatchPolicy()
@@ -191,6 +204,19 @@ class CommandExecutor:
         """The live batch policy (greedy unless the serving layer installed
         an adaptive one)."""
         return self._policy
+
+    @property
+    def journal(self):
+        """The attached write-ahead journal, or None (journaling off)."""
+        return self._journal
+
+    def set_journal(self, journal) -> None:
+        """Attach/detach the write-ahead journal. The client installs it
+        AFTER recovery replay (replayed ops must not re-journal) and
+        detaches before close; the swap is lock-ordered with dispatch so
+        no run straddles the transition."""
+        with self._cv:
+            self._journal = journal
 
     # -- submission ---------------------------------------------------------
 
@@ -239,6 +265,12 @@ class CommandExecutor:
     def execute_sync(self, target: str, kind: str, payload: Any, nkeys: int = 0):
         # graftlint: allow-g006(sync facade: blocks exactly like the reference's CommandSyncExecutor latch; serve-mode callers get deadline-bounded waits via the serving layer)
         return self.execute_async(target, kind, payload, nkeys).result()
+
+    def execute_barrier(self, fn: Callable[[], Any], target: str = "") -> Future:
+        """Run `fn` inline on the dispatcher thread, ordered like an op on
+        `target`; the future resolves with fn's return value. See
+        BARRIER_KIND for the consistency-cut contract."""
+        return self.execute_async(target, BARRIER_KIND, fn)
 
     def queue_depth(self) -> int:
         """Total ops waiting across all object queues (locked snapshot)."""
@@ -411,6 +443,17 @@ class CommandExecutor:
         if not live:
             self._retire(token, completed=False)
             return
+        if kind == BARRIER_KIND:
+            # Consistency cut: executes here, on the dispatcher, with no
+            # run staging concurrently. Never touches the backend or the
+            # journal and never counts toward batch metrics.
+            for op in live:
+                try:
+                    op.future.set_result(op.payload())
+                except Exception as exc:
+                    op.future.set_exception(exc)
+            self._retire(token, completed=False)
+            return
         token.nops = len(live)
         token.nkeys = sum(op.nkeys for op in live)
         t0 = token.t0 = self._clock()
@@ -427,6 +470,27 @@ class CommandExecutor:
             for op in live:
                 op.future.add_done_callback(
                     lambda _fut, token=token: self._op_done(token))
+        journal = self._journal
+        if journal is not None and not parked:
+            # Write-ahead ordering: the record reaches the journal before
+            # the backend commits state at stage time, so an acknowledged
+            # op is always journaled (read kinds are a no-op inside
+            # append_run). `defer` hints that more dispatch work is queued,
+            # letting the "always" policy group-commit one fsync across
+            # the pipeline window instead of paying one per run.
+            try:
+                journal.append_run(kind, live, defer=bool(self._ready))
+            except Exception as exc:
+                # A journal that cannot accept the record must fail the
+                # ops — applying an unjournaled mutation would silently
+                # break the recovery contract.
+                token.failed = True
+                if m:
+                    m.record_error(kind)
+                for op in live:
+                    if not op.future.done():
+                        op.future.set_exception(exc)
+                return
         try:
             self._backend.run(kind, target, live)
             token.stage_s = self._clock() - t0

@@ -331,3 +331,43 @@ def register_read_cache(registry: MetricsRegistry, cache) -> None:
         return (cache.hits / total) if total else 0.0
 
     registry.gauge("backend.read_cache_hit_ratio", _ratio)
+
+
+def register_persist(registry: MetricsRegistry, manager) -> None:
+    """Expose the durability subsystem (persist/) as persist.* gauges:
+    journal throughput and group-commit behavior, snapshot cadence, and —
+    when the manager recovered at startup — the replay rate. Follower lag
+    lives on the follower's own client registry (register_follower)."""
+    def _journal(key, default=0):
+        def read():
+            j = manager.journal
+            return j.stats().get(key, default) if j is not None else default
+        return read
+
+    registry.gauge("persist.appended", _journal("records_appended"))
+    registry.gauge("persist.runs_appended", _journal("runs_appended"))
+    registry.gauge("persist.bytes_appended", _journal("bytes_appended"))
+    registry.gauge("persist.fsyncs", _journal("fsyncs"))
+    registry.gauge("persist.group_mean", _journal("group_mean", 0.0))
+    registry.gauge("persist.last_seq", _journal("last_seq"))
+    registry.gauge("persist.durable_seq", _journal("durable_seq"))
+    registry.gauge("persist.unsynced_runs", _journal("unsynced_runs"))
+    registry.gauge("persist.segments", _journal("segments"))
+    registry.gauge(
+        "persist.snapshots_taken",
+        lambda: manager.snapshotter.snapshots_taken if manager.snapshotter else 0)
+    registry.gauge(
+        "persist.snapshot_seq",
+        lambda: manager.snapshotter.last_seq if manager.snapshotter else 0)
+    registry.gauge(
+        "persist.replay_ops_s",
+        lambda: (manager.last_recovery or {}).get("ops_per_s", 0.0))
+    registry.gauge(
+        "persist.replayed",
+        lambda: (manager.last_recovery or {}).get("replayed", 0))
+
+
+def register_follower(registry: MetricsRegistry, follower) -> None:
+    """Bounded-lag gauge for a warm standby (persist/follower.py)."""
+    registry.gauge("persist.follower_lag", follower.lag)
+    registry.gauge("persist.follower_applied_seq", lambda: follower.applied_seq)

@@ -204,6 +204,7 @@ class PodBackend:
             for op in ops:
                 op.future.set_result(True)
             return
+        # graftlint: allow-journal(backend-internal delegation: the delete was journaled at the executor before this backend ran it; the delegate is just the non-bank tier)
         self._delegate.run("delete", target, ops)
 
     def _op_exists(self, target: str, ops: List[Op]) -> None:

@@ -1,0 +1,52 @@
+"""persist/ — durability for an engine that OWNS its state in HBM.
+
+The reference outsources durability to the Redis server (SURVEY §5: "the
+client is stateless"); this framework keeps the authoritative state in
+device memory, so the AOF/RDB capability pair has to live client-side:
+
+  * journal.py     — segmented append-only log of committed mutating ops
+                     (the AOF analogue), fsync policies always/everysec/off
+                     with group commit sized to the pipeline window.
+  * snapshotter.py — background snapshot via checkpoint.py + journal
+                     rotation/truncation (the BGSAVE / AOF-rewrite
+                     analogue): recovery cost is bounded by one snapshot
+                     plus one segment suffix.
+  * recover.py     — newest snapshot + journal-suffix replay through the
+                     normal executor/backend op path (same codepath as
+                     live traffic, so replay is golden-testable).
+  * follower.py    — a second engine instance tails the journal and
+                     applies ops with a bounded-lag gauge; `promote()` is
+                     the warm-standby failover drill.
+
+`PersistenceManager` (manager.py) wires the pieces to one client.
+"""
+
+from redisson_tpu.persist.codec import encode_payload, decode_payload
+from redisson_tpu.persist.journal import (
+    Journal,
+    JournalCorruption,
+    JournalRecord,
+    JournalTail,
+    iter_records,
+    last_seq_in_dir,
+)
+from redisson_tpu.persist.manager import PersistenceManager
+from redisson_tpu.persist.recover import recover
+from redisson_tpu.persist.snapshotter import Snapshotter, find_snapshots
+from redisson_tpu.persist.follower import JournalFollower
+
+__all__ = [
+    "Journal",
+    "JournalCorruption",
+    "JournalRecord",
+    "JournalTail",
+    "JournalFollower",
+    "PersistenceManager",
+    "Snapshotter",
+    "decode_payload",
+    "encode_payload",
+    "find_snapshots",
+    "iter_records",
+    "last_seq_in_dir",
+    "recover",
+]

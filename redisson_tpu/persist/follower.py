@@ -1,0 +1,223 @@
+"""Warm standby: a second engine instance that tails the leader's journal.
+
+The follower owns a full client of its own (default: local mode — the same
+engine the leader runs, minus the device) with persistence OFF, bootstraps
+from the leader's newest snapshot, then applies journal records through its
+own executor — the same codepath as live traffic, so a promoted follower is
+bit-identical to a recovered leader at the same sequence number.
+
+Two tail modes:
+  * file (default) — `JournalTail` polls the leader's segment files; works
+    across processes. Lag is bounded by the leader's flush cadence (the
+    journal syncer flushes on `fsync_interval_s` even under fsync=off) plus
+    the poll interval.
+  * queue — `attach(journal)` registers an in-process listener; records
+    arrive on the leader's dispatcher thread and queue here, for
+    same-process drills with near-zero lag.
+
+`promote()` is the failover drill: stop tailing, drain whatever the journal
+still holds, and hand back the (now-leader) client. `lag()` is the gauge
+the issue asks for: leader's last committed seq minus ours.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from redisson_tpu import checkpoint
+from redisson_tpu.persist.journal import (
+    JournalGap,
+    JournalRecord,
+    JournalTail,
+    last_seq_in_dir,
+)
+from redisson_tpu.persist.snapshotter import STRUCTURES_FILE, find_snapshots
+
+
+class JournalFollower:
+    def __init__(self, path: str, config=None, poll_interval_s: float = 0.05,
+                 apply_window: int = 1024):
+        from redisson_tpu.client import RedissonTPU
+        from redisson_tpu.config import Config
+
+        self.path = path
+        self._poll_s = poll_interval_s
+        self._apply_window = apply_window
+        cfg = config or Config()
+        if getattr(cfg, "persist", None) is not None:
+            raise ValueError("follower clients must not persist — they'd "
+                             "journal the leader's ops a second time")
+        self.client = RedissonTPU.create(cfg)
+        self._applied = 0
+        self._applied_lock = threading.Lock()
+        self._records_applied = 0
+        self._apply_errors = 0
+        self._queue: Optional[deque] = None  # in-process mode
+        self._queue_lock = threading.Lock()
+        self._source_journal = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._bootstraps = 0
+        self._bootstrap()
+
+    # -- bootstrap / tail ----------------------------------------------------
+
+    def _bootstrap(self) -> None:
+        """(Re)load the newest leader snapshot; reset the apply cursor to
+        its watermark. Called at start and after a JournalGap (the leader
+        compacted history past our cursor)."""
+        snaps = find_snapshots(self.path)
+        watermark = 0
+        if snaps:
+            watermark, snap_path = snaps[-1]
+            if self._bootstraps:
+                # Re-bootstrap: drop divergent state before reloading.
+                self.client._dispatch.execute_sync("", "flushall", None)
+            structures = getattr(self.client._routing, "structures", None)
+            blob = checkpoint.extra_file(snap_path, STRUCTURES_FILE)
+            if structures is not None and blob is not None:
+                self.client._executor.execute_barrier(
+                    lambda: structures.load_state(blob)).result(timeout=120)
+            self.client.load_checkpoint(snap_path)
+        with self._applied_lock:
+            self._applied = watermark
+        self._tail = JournalTail(self.path, from_seq=watermark)
+        self._bootstraps += 1
+
+    def attach(self, journal) -> None:
+        """Switch to in-process queue tailing of a live Journal (leader in
+        the same process). Records already applied are deduped by seq."""
+        self._queue = deque()
+        self._source_journal = journal
+        journal.add_listener(self._on_records)
+
+    def _on_records(self, records: List[JournalRecord]) -> None:
+        with self._queue_lock:
+            self._queue.extend(records)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="redisson-tpu-follower", daemon=True)
+            self._thread.start()
+
+    def _next_records(self) -> List[JournalRecord]:
+        if self._queue is not None:
+            with self._queue_lock:
+                records = list(self._queue)
+                self._queue.clear()
+            return [r for r in records if r.seq > self._applied]
+        return self._tail.poll(max_records=self._apply_window)
+
+    def _apply(self, records: List[JournalRecord]) -> None:
+        if not records:
+            return
+        futures: List = []
+        executor = self.client._executor
+
+        def drain() -> None:
+            for fut in futures:
+                try:
+                    fut.result(timeout=120)
+                except Exception:
+                    self._apply_errors += 1
+            futures.clear()
+
+        # Concurrency only WITHIN a run of consecutive same-(kind, target)
+        # records — the executor's per-target queue keeps those FIFO; across
+        # targets it round-robins, so a group boundary must drain or the
+        # follower's apply order diverges from the journal (see recover.py).
+        group = None
+        for rec in records:
+            key = (rec.kind, rec.target)
+            if key != group:
+                drain()
+                group = key
+            futures.append(
+                executor.execute_async(rec.target, rec.kind, rec.payload))
+        drain()
+        with self._applied_lock:
+            self._applied = records[-1].seq
+            self._records_applied += len(records)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                records = self._next_records()
+            except JournalGap:
+                self._bootstrap()
+                continue
+            if records:
+                self._apply(records)
+            else:
+                self._stop.wait(self._poll_s)
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def applied_seq(self) -> int:
+        with self._applied_lock:
+            return self._applied
+
+    def lag(self) -> int:
+        """Records the leader has committed that we haven't applied (the
+        bounded-lag gauge). File mode re-scans the leader's journal; queue
+        mode reads the live journal's counter."""
+        if self._source_journal is not None:
+            leader = self._source_journal.last_seq
+        else:
+            leader = last_seq_in_dir(self.path)
+        return max(0, leader - self.applied_seq)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "applied_seq": self.applied_seq,
+            "records_applied": self._records_applied,
+            "apply_errors": self._apply_errors,
+            "lag": self.lag(),
+            "bootstraps": self._bootstraps,
+            "mode": "queue" if self._queue is not None else "file",
+        }
+
+    # -- failover ------------------------------------------------------------
+
+    def promote(self, catch_up: bool = True, timeout_s: float = 30.0):
+        """Failover drill: stop tailing, optionally drain every record the
+        journal still exposes, and return the caught-up client — the new
+        leader. The old leader's journal is left untouched (a real failover
+        would fence it first)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            self._thread = None
+        if self._source_journal is not None:
+            self._source_journal.remove_listener(self._on_records)
+        if catch_up:
+            deadline = time.monotonic() + timeout_s
+            idle_polls = 0
+            while idle_polls < 2 and time.monotonic() < deadline:
+                try:
+                    records = self._next_records()
+                except JournalGap:
+                    self._bootstrap()
+                    continue
+                if records:
+                    self._apply(records)
+                    idle_polls = 0
+                else:
+                    idle_polls += 1
+        return self.client
+
+    def close(self, shutdown_client: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self._source_journal is not None:
+            self._source_journal.remove_listener(self._on_records)
+            self._source_journal = None
+        if shutdown_client:
+            self.client.shutdown()

@@ -1,0 +1,206 @@
+"""Background snapshots + journal truncation (the BGSAVE / AOF-rewrite
+analogue).
+
+A snapshot is cut through the executor's barrier primitive: the cut
+callable runs inline on the dispatcher thread, where — because both engine
+tiers commit observable state at stage time and journal records are
+appended on the same thread — it sees exactly the state produced by the
+journal prefix `[1..last_seq]`. The cut is cheap (jax array handles are
+immutable, so grabbing them IS a consistent snapshot; the structure tier
+pickles its keyspace); the expensive host copies and the checkpoint.save
+happen afterwards on the snapshotter thread while traffic keeps flowing.
+
+At the cut the journal also rotates, so the snapshot watermark falls on a
+segment boundary; once the snapshot is durably on disk every wholly-covered
+segment is deleted. Recovery cost is therefore bounded by one snapshot plus
+one segment suffix, whatever the uptime.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from redisson_tpu import checkpoint
+from redisson_tpu.executor import Op
+
+SNAPSHOT_PREFIX = "snap-"
+STRUCTURES_FILE = "structures.bin"
+
+
+def find_snapshots(path: str) -> List[Tuple[int, str]]:
+    """Sorted (journal_seq, snapshot_dir) for every readable snapshot under
+    a persist directory. Trusts the manifest watermark, not the dirname —
+    and checkpoint.info's `.old` fallback keeps a half-swapped snapshot
+    usable."""
+    out = []
+    if not os.path.isdir(path):
+        return out
+    for name in os.listdir(path):
+        if not name.startswith(SNAPSHOT_PREFIX) or name.endswith(".old"):
+            continue
+        full = os.path.join(path, name)
+        try:
+            manifest = checkpoint.info(full)
+        except (OSError, ValueError):
+            continue
+        out.append((int(manifest.get("journal_seq", 0)), full))
+    out.sort()
+    return out
+
+
+class Snapshotter:
+    """Periodic (or on-demand) snapshot of one client's full state.
+
+    Serializes with itself: overlapping snapshot_now() calls queue on an
+    internal lock, so at most one snapshot is being written at a time (the
+    reference refuses concurrent BGSAVEs the same way).
+    """
+
+    def __init__(self, client, journal, path: str, interval_s: float = 0.0,
+                 keep: int = 2, cut_timeout_s: float = 120.0):
+        self._client = client
+        self._journal = journal
+        self.path = os.path.abspath(path)
+        self._interval_s = float(interval_s)
+        self._keep = max(1, int(keep))
+        self._cut_timeout_s = cut_timeout_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # stats (persist.* gauges read these)
+        self.snapshots_taken = 0
+        self.last_seq = 0
+        self.last_duration_s = 0.0
+        self.last_path: Optional[str] = None
+        self.last_error: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._interval_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="redisson-tpu-snapshotter", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._cut_timeout_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.snapshot_now()
+            except Exception as exc:  # keep the period alive; surface via stats
+                self.last_error = f"{type(exc).__name__}: {exc}"
+
+    # -- the snapshot itself ------------------------------------------------
+
+    def _cut(self) -> Tuple[int, Dict[str, tuple], Optional[bytes]]:
+        """Dispatcher-thread consistency cut (see module docstring): captures
+        the journal watermark, every sketch object's immutable device handle,
+        bank-row exports, and the structure tier's pickled keyspace — then
+        rotates the journal so the watermark seals a segment."""
+        client = self._client
+        store = client._store
+        routing = client._routing
+        sketch = routing.sketch
+        objs: Dict[str, tuple] = {}
+        # Bloom barrier first: pending host-mirror bits must reach device
+        # state before the handles below are captured (same contract as
+        # save_checkpoint / the durability flush).
+        from redisson_tpu.store import ObjectType
+
+        for name in store.keys():
+            obj = store.get(name)
+            if obj is not None and obj.otype == ObjectType.BLOOM:
+                probe = Op(target=name, kind="bloom_sync", payload=None)
+                # graftlint: allow-g007(snapshot cut runs ON the dispatcher inside a barrier — it IS downstream of the journal hook, and bloom_sync is engine-internal mirror maintenance that replay reconstructs from the journaled bloom_adds)
+                sketch.run("bloom_sync", name, [probe])
+                # graftlint: allow-block(same-thread: run() completes the probe future before returning for the engine backends)
+                probe.future.result(timeout=self._cut_timeout_s)
+        for name in store.keys():
+            obj = store.get(name)
+            if obj is None:
+                continue
+            # jax arrays are immutable: the handle is the snapshot. meta is
+            # a live dict — copy it now, on the mutating thread.
+            objs[name] = (obj.otype, obj.state, dict(obj.meta), obj.version)
+        bank = client._pod_backend()
+        if bank is not None:
+            for name in bank.bank_names():
+                probe = Op(target=name, kind="hll_export", payload=None)
+                # graftlint: allow-g007(hll_export is write=False; flagged only when the registry changes — keep the suppression local to the probe idiom)
+                sketch.run("hll_export", name, [probe])
+                # graftlint: allow-block(same-thread: run() completes the probe future before returning for the engine backends)
+                exported = probe.future.result(timeout=self._cut_timeout_s)
+                if exported is not None:
+                    regs, version = exported
+                    objs[name] = ("hll", regs, {}, version)
+            for name in (bank.sharded_bits_names()
+                         if hasattr(bank, "sharded_bits_names") else []):
+                probe = Op(target=name, kind="bits_export", payload=None)
+                # graftlint: allow-g007(bits_export is write=False; same probe idiom as above)
+                sketch.run("bits_export", name, [probe])
+                # graftlint: allow-block(same-thread: run() completes the probe future before returning for the engine backends)
+                exported = probe.future.result(timeout=self._cut_timeout_s)
+                if exported is not None:
+                    otype, host, meta, version = exported
+                    objs[name] = (otype, host, meta, version)
+        structures = getattr(routing, "structures", None)
+        blob = structures.dump_state() if structures is not None else None
+        seq = self._journal.last_seq
+        self._journal.rotate()
+        return seq, objs, blob
+
+    def snapshot_now(self) -> str:
+        """Take one full snapshot; returns its directory. Blocks until the
+        snapshot is durable and superseded journal segments are deleted."""
+        with self._lock:
+            t0 = time.monotonic()
+            fut = self._client._executor.execute_barrier(self._cut)
+            seq, objs, blob = fut.result(timeout=self._cut_timeout_s)
+            # Off the dispatcher now: materialize host copies and write.
+            extra_objects = {
+                name: (otype, np.asarray(state), meta, version)
+                for name, (otype, state, meta, version) in objs.items()
+            }
+            snap_path = os.path.join(self.path, f"{SNAPSHOT_PREFIX}{seq:020d}")
+            checkpoint.save(
+                self._client._store, snap_path, names=[],
+                extra_objects=extra_objects,
+                manifest_extra={"journal_seq": seq},
+                extra_files=({STRUCTURES_FILE: blob} if blob is not None else None),
+            )
+            self._journal.remove_segments_below(seq)
+            self._prune()
+            self.snapshots_taken += 1
+            self.last_seq = seq
+            self.last_duration_s = time.monotonic() - t0
+            self.last_path = snap_path
+            self.last_error = None
+            return snap_path
+
+    def _prune(self) -> None:
+        snaps = find_snapshots(self.path)
+        for _, snap_path in snaps[:-self._keep]:
+            shutil.rmtree(snap_path, ignore_errors=True)
+            shutil.rmtree(snap_path + ".old", ignore_errors=True)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "snapshots_taken": self.snapshots_taken,
+            "last_seq": self.last_seq,
+            "last_duration_s": self.last_duration_s,
+            "last_path": self.last_path,
+            "last_error": self.last_error,
+            "interval_s": self._interval_s,
+            "keep": self._keep,
+        }

@@ -100,6 +100,7 @@ class RoutingBackend:
             if in_sketch:
                 self.structures.delete(new)
                 probe = Op(target=target, kind="rename", payload=op.payload)
+                # graftlint: allow-journal(fan-out of an already-journaled rename: the executor journaled the original op before calling into this backend, this is tier routing below the commit point)
                 self.sketch.run("rename", target, [probe])
                 try:
                     # graftlint: allow-block(same-thread: sketch.run above completes the probe future before returning)
@@ -108,6 +109,7 @@ class RoutingBackend:
                     op.future.set_exception(exc)
             else:
                 self._sketch_side("delete", new)
+                # graftlint: allow-journal(same fan-out: the journaled rename op is forwarded to the structures tier below the commit point)
                 self.structures.run("rename", target, [op])
 
     def _both_keys(self, target: str, ops: List[Op]) -> None:

@@ -385,9 +385,16 @@ class ServingLayer:
     # -- debug endpoint -----------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:
-        """One-stop QoS debug view: admission, breakers, policy, queues."""
+        """One-stop QoS debug view: admission, breakers, policy, queues.
+
+        `journal` surfaces the durability subsystem when the executor is
+        journaled: an fsync stall on the write-ahead hook runs ON the
+        dispatcher, so it shows up here as rising executor.queue_delay_s /
+        queue depth — the journal stats (unsynced_runs, group_mean) say
+        whether durability is the cause."""
         now = self._clock()
         pol = getattr(self._executor, "policy", None)
+        journal = getattr(self._executor, "journal", None)
         return {
             "now": now,
             "admission": self._admission.snapshot(now),
@@ -397,6 +404,7 @@ class ServingLayer:
             "pipeline": (self._executor.pipeline_stats()
                          if hasattr(self._executor, "pipeline_stats")
                          else None),
+            "journal": journal.stats() if journal is not None else None,
             "counters": {
                 k: v for k, v in
                 self._registry.snapshot()["counters"].items()

@@ -260,6 +260,36 @@ class StructureBackend(ExtendedOps):
         with self._lock:
             self._data.clear()
 
+    # -- persistence (persist/snapshotter.py) --------------------------------
+
+    def dump_state(self) -> bytes:
+        """Serialize the whole keyspace (the structure-tier half of a
+        snapshot cut). Must run on the dispatcher thread — the single
+        mutator — so the pickle is a consistent point-in-time copy.
+        Excluded on purpose: parked blocking-pop waiters (transient; their
+        futures belong to the crashed process) and the SCRIPT cache
+        (callables don't pickle; re-register via script_load after
+        recovery, same as a restarted Redis loses its script cache)."""
+        import pickle
+
+        with self._lock:
+            return pickle.dumps({"format": 1, "data": self._data},
+                                protocol=pickle.HIGHEST_PROTOCOL)
+
+    def load_state(self, blob: bytes) -> int:
+        """Replace the keyspace with a dump_state() capture. Returns the
+        number of keys restored. Dispatcher-thread (or pre-traffic) only."""
+        import pickle
+
+        payload = pickle.loads(blob)
+        if payload.get("format") != 1:
+            raise ValueError(f"unsupported structure dump format "
+                             f"{payload.get('format')!r}")
+        data = payload["data"]
+        with self._lock:
+            self._data = data
+        return len(data)
+
     # -- generic / expiry (RedissonExpirable surface) ------------------------
 
     def _op_delete(self, key: str, op: Op) -> None:

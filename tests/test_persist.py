@@ -1,0 +1,496 @@
+"""persist/ — journal, snapshots, crash recovery, warm standby.
+
+Layers:
+
+1. Codec round-trips — every payload shape the op table produces.
+2. Journal unit tests — framing, group commit, rotation, compaction,
+   torn-tail repair, tailing.
+3. The central durability property — a journal truncated at an ARBITRARY
+   byte offset recovers to exactly a committed prefix of the op stream,
+   bit-identical to executing that prefix serially on a fresh engine.
+4. Kill-and-recover + snapshot integration through the real client.
+5. Follower tailing + mid-stream promotion convergence.
+6. checkpoint `.old` fallback (crash between the two swap renames).
+"""
+
+import hashlib
+import os
+import pickle
+import random
+import shutil
+
+import numpy as np
+import pytest
+
+from redisson_tpu import checkpoint
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config, PersistConfig
+from redisson_tpu.persist import codec
+from redisson_tpu.persist.journal import (
+    Journal,
+    JournalGap,
+    JournalTail,
+    _list_segments,
+    iter_records,
+    last_seq_in_dir,
+)
+from redisson_tpu.persist.follower import JournalFollower
+
+
+def make_client(tmp_path=None, fsync="always", **persist_kw):
+    cfg = Config()
+    cfg.use_local()
+    if tmp_path is not None:
+        pc = cfg.use_persist(str(tmp_path))
+        pc.fsync = fsync
+        for k, v in persist_kw.items():
+            setattr(pc, k, v)
+    return RedissonTPU.create(cfg)
+
+
+def engine_digest(client) -> str:
+    """Bit-identical fingerprint of engine state: every sketch-store array
+    (host copy) plus the structure tier's dump. Version counters are
+    excluded — the property under test is about DATA."""
+    h = hashlib.sha256()
+    store = client._store
+    for name in sorted(store.keys()):
+        obj = store.get(name)
+        if obj is None:
+            continue
+        arr = np.asarray(obj.state)
+        h.update(name.encode())
+        h.update(str(obj.otype).encode())
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+        h.update(repr(sorted(obj.meta.items())).encode())
+    structures = getattr(client._routing, "structures", None)
+    if structures is not None:
+        blob = structures.dump_state()
+        h.update(pickle.loads(blob)["format"].to_bytes(2, "little"))
+        h.update(blob)
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# 1. codec
+# ---------------------------------------------------------------------------
+
+class TestCodec:
+    @pytest.mark.parametrize("value", [
+        None, True, False, 0, -1, 2**80, -(2**80), 1.5, float("inf"),
+        "", "héllo", b"", b"\x00\xff" * 9,
+        [1, "two", b"3", None], (4, (5, 6)), {"k": [1, 2], b"b": {"n": None}},
+    ])
+    def test_roundtrip_scalars_containers(self, value):
+        out = codec.decode_payload(codec.encode_payload(value))
+        assert out == value
+        assert type(out) is type(value)
+
+    @pytest.mark.parametrize("arr", [
+        np.arange(7, dtype=np.uint32),
+        np.zeros((3, 5), np.uint8),
+        np.array([[1.5, -2.5]], np.float64),
+        np.array([], np.int64),
+    ])
+    def test_roundtrip_ndarray(self, arr):
+        out = codec.decode_payload(codec.encode_payload(arr))
+        assert out.dtype == arr.dtype and out.shape == arr.shape
+        assert np.array_equal(out, arr)
+
+    def test_numpy_scalar_decays_to_python(self):
+        assert codec.decode_payload(codec.encode_payload(np.uint32(7))) == 7
+
+    def test_nested_payload_like_real_ops(self):
+        payload = {"field": b"f1", "value": b"v1", "nx": False,
+                   "items": [b"a", b"b"],
+                   "scores": np.arange(3, dtype=np.float64)}
+        out = codec.decode_payload(codec.encode_payload(payload))
+        assert out["field"] == b"f1" and out["nx"] is False
+        assert np.array_equal(out["scores"], payload["scores"])
+
+
+# ---------------------------------------------------------------------------
+# 2. journal unit tests
+# ---------------------------------------------------------------------------
+
+class _Op:
+    def __init__(self, target, kind, payload):
+        self.target, self.kind, self.payload = target, kind, payload
+
+
+class TestJournal:
+    def test_append_read_roundtrip(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        j.append_run("set", [_Op("b1", "set", {"value": b"v"})])
+        j.append_run("hput", [_Op("m1", "hput", {"field": b"f", "value": b"1"})])
+        j.close()
+        recs = list(iter_records(str(tmp_path)))
+        assert [(r.seq, r.target, r.kind) for r in recs] == [
+            (1, "b1", "set"), (2, "m1", "hput")]
+        assert recs[0].payload == {"value": b"v"}
+
+    def test_read_kinds_are_not_journaled(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        assert j.append_run("num_get", [_Op("b1", "num_get", {})]) == 0
+        assert j.append_run("exists", [_Op("b1", "exists", {})]) == 0
+        assert j.last_seq == 0
+        j.close()
+        assert list(iter_records(str(tmp_path))) == []
+
+    def test_group_commit_defers_then_syncs_on_fill(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always", group_commit_runs=2,
+                    fsync_interval_s=60.0)  # long linger: only the fill syncs
+        j.append_run("set", [_Op("a", "set", {"value": b"1"})], defer=True)
+        assert j.durable_seq == 0  # deferred, group not full
+        j.append_run("set", [_Op("b", "set", {"value": b"2"})], defer=True)
+        assert j.durable_seq == 2  # group filled -> inline fsync
+        j.close()
+
+    def test_rotation_and_compaction(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        for i in range(3):
+            j.append_run("set", [_Op(f"k{i}", "set", {"value": b"x"})])
+        j.rotate()
+        j.rotate()  # idempotent on an empty active segment
+        j.append_run("set", [_Op("k3", "set", {"value": b"x"})])
+        assert [b for b, _ in _list_segments(str(tmp_path))] == [1, 4]
+        j.remove_segments_below(3)
+        assert [b for b, _ in _list_segments(str(tmp_path))] == [4]
+        assert [r.seq for r in iter_records(str(tmp_path), from_seq=3)] == [4]
+        j.close()
+        # reopen continues the sequence
+        j2 = Journal(str(tmp_path), fsync="always")
+        assert j2.last_seq == 4
+        j2.append_run("set", [_Op("k4", "set", {"value": b"x"})])
+        assert j2.last_seq == 5
+        j2.close()
+
+    def test_segment_size_rotation(self, tmp_path):
+        # 1 << 16 is the enforced floor for segment_max_bytes
+        j = Journal(str(tmp_path), fsync="off", segment_max_bytes=1 << 16)
+        for i in range(20):
+            j.append_run("set", [_Op(f"k{i}", "set", {"value": b"x" * 5000})])
+        j.close()
+        assert len(_list_segments(str(tmp_path))) > 1
+        assert [r.seq for r in iter_records(str(tmp_path))] == list(range(1, 21))
+
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        for i in range(4):
+            j.append_run("set", [_Op(f"k{i}", "set", {"value": b"y" * 100})])
+        j.close()
+        _, seg = _list_segments(str(tmp_path))[-1]
+        size = os.path.getsize(seg)
+        with open(seg, "r+b") as f:
+            f.truncate(size - 37)  # mid-frame
+        j2 = Journal(str(tmp_path), fsync="always")
+        assert j2.last_seq == 3
+        assert j2.stats()["recovered_tail_bytes"] > 0
+        j2.append_run("set", [_Op("k", "set", {"value": b"z"})])
+        j2.close()
+        assert [r.seq for r in iter_records(str(tmp_path))] == [1, 2, 3, 4]
+
+    def test_corrupt_crc_stops_replay_at_prefix(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        for i in range(3):
+            j.append_run("set", [_Op(f"k{i}", "set", {"value": b"v" * 50})])
+        j.close()
+        _, seg = _list_segments(str(tmp_path))[-1]
+        with open(seg, "r+b") as f:
+            f.seek(os.path.getsize(seg) - 10)
+            f.write(b"\xde\xad")
+        assert [r.seq for r in iter_records(str(tmp_path))] == [1, 2]
+
+    def test_tail_poll_and_gap(self, tmp_path):
+        j = Journal(str(tmp_path), fsync="always")
+        tail = JournalTail(str(tmp_path))
+        j.append_run("set", [_Op("a", "set", {"value": b"1"})])
+        assert [r.seq for r in tail.poll()] == [1]
+        assert tail.poll() == []
+        j.rotate()
+        j.append_run("set", [_Op("b", "set", {"value": b"2"})])
+        assert [r.seq for r in tail.poll()] == [2]  # follows rotation
+        j.close()
+        stale = JournalTail(str(tmp_path), from_seq=0)
+        j2 = Journal(str(tmp_path), fsync="always")
+        j2.remove_segments_below(2)
+        with pytest.raises(JournalGap):
+            stale.poll()
+        j2.close()
+
+    def test_last_seq_in_dir(self, tmp_path):
+        assert last_seq_in_dir(str(tmp_path)) == 0
+        j = Journal(str(tmp_path), fsync="always")
+        j.append_run("set", [_Op("a", "set", {"value": b"1"})])
+        j.close()
+        assert last_seq_in_dir(str(tmp_path)) == 1
+
+
+# ---------------------------------------------------------------------------
+# 3. the durability property: truncate anywhere -> a committed prefix
+# ---------------------------------------------------------------------------
+
+def _write_ops(n_mix=3):
+    """A deterministic mixed-op script; each call = exactly one journal
+    record (all sync singleton dispatches)."""
+    ops = []
+    for i in range(n_mix):
+        ops.append(lambda c, i=i: c.get_bucket(f"b{i}").set({"round": i}))
+        ops.append(lambda c, i=i: c.get_map("m").put(f"f{i}", i * 11))
+        ops.append(lambda c, i=i: c.get_bit_set("bits").set(i * 7 + 3, True))
+        ops.append(lambda c, i=i: c.get_hyper_log_log("h").add_all(
+            [f"u{i}-{k}" for k in range(50)]))
+        ops.append(lambda c, i=i: c.get_atomic_long("ctr").add_and_get(i + 1))
+    return ops
+
+
+def test_truncate_anywhere_recovers_committed_prefix(tmp_path):
+    """THE acceptance property: for random byte offsets t, truncating the
+    journal at t and recovering yields state identical to serially
+    re-executing the eligible op prefix on a fresh engine."""
+    ops = _write_ops()
+    lead_dir = tmp_path / "leader"
+    c = make_client(lead_dir, fsync="always")
+    try:
+        for op in ops:
+            op(c)
+        c.persist.journal.sync()
+        committed = list(iter_records(str(lead_dir)))
+        assert len(committed) == len(ops)
+
+        # golden digests: digest[k] = state after serially executing ops[:k]
+        golden = RedissonTPU.create(Config())
+        digests = {0: engine_digest(golden)}
+        try:
+            for k, op in enumerate(ops, start=1):
+                op(golden)
+                digests[k] = engine_digest(golden)
+        finally:
+            golden.shutdown()
+
+        _, seg = _list_segments(str(lead_dir))[0]
+        size = os.path.getsize(seg)
+        rng = random.Random(0xD15C)
+        offsets = sorted(rng.sample(range(1, size - 1), 6)) + [8, size]
+        for t in offsets:
+            crash_dir = tmp_path / f"crash-{t}"
+            shutil.copytree(lead_dir, crash_dir)
+            _, cseg = _list_segments(str(crash_dir))[0]
+            with open(cseg, "r+b") as f:
+                f.truncate(t)
+            surviving = list(iter_records(str(crash_dir)))
+            k = len(surviving)
+            # prefix property at the record level
+            assert [r.seq for r in surviving] == list(range(1, k + 1))
+            r = make_client(crash_dir, fsync="always")
+            try:
+                rec = r.persist.last_recovery
+                if k:
+                    assert rec["replayed"] == k and rec["replay_errors"] == 0
+                else:
+                    assert rec is None  # nothing survived -> nothing recovers
+                assert engine_digest(r) == digests[k], (
+                    f"truncate@{t}: recovered state != serial prefix of {k} ops")
+            finally:
+                r.shutdown()
+            shutil.rmtree(crash_dir)
+    finally:
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. kill-and-recover + snapshots through the client
+# ---------------------------------------------------------------------------
+
+def _crash_image(src, dst):
+    """Simulate kill -9: act on a copy of the on-disk state, never a live
+    shared directory."""
+    if os.path.exists(dst):
+        shutil.rmtree(dst)
+    shutil.copytree(src, dst)
+
+
+def test_kill_and_recover_full_replay(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always")
+    try:
+        c.get_bucket("b1").set({"x": 1})
+        c.get_hyper_log_log("h1").add_all([f"k{i}" for i in range(1000)])
+        bs = c.get_bit_set("bits"); bs.set(5, True); bs.set(100, True)
+        m = c.get_map("m1"); m.put("a", 1); m.put("b", 2)
+        c.get_bloom_filter("bf").try_init(1000, 0.01)
+        c.get_bloom_filter("bf").add("member-1")
+        c.persist.journal.sync()
+        expect_hll = c.get_hyper_log_log("h1").count()
+        _crash_image(lead, tmp_path / "img")
+    finally:
+        c.shutdown()
+    r = make_client(tmp_path / "img", fsync="always")
+    try:
+        assert r.persist.last_recovery["replay_errors"] == 0
+        assert r.get_bucket("b1").get() == {"x": 1}
+        assert r.get_map("m1").get("a") == 1 and r.get_map("m1").get("b") == 2
+        assert r.get_bit_set("bits").get(5) and r.get_bit_set("bits").get(100)
+        assert not r.get_bit_set("bits").get(6)
+        assert r.get_hyper_log_log("h1").count() == expect_hll
+        assert r.get_bloom_filter("bf").contains("member-1")
+        # the recovered leader keeps journaling past the recovered seq
+        seq0 = r.persist.journal.last_seq
+        r.get_bucket("b2").set("post")
+        assert r.persist.journal.last_seq == seq0 + 1
+    finally:
+        r.shutdown()
+
+
+def test_snapshot_bounds_recovery_to_suffix(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always")
+    try:
+        for i in range(10):
+            c.get_map("m").put(f"f{i}", i)
+        snap_path = c.snapshot_now()
+        assert os.path.basename(snap_path).startswith("snap-")
+        assert checkpoint.info(snap_path)["journal_seq"] == 10
+        # pre-snapshot history is compacted away
+        assert all(b > 10 for b, _ in _list_segments(str(lead)))
+        c.get_bucket("after").set("suffix")
+        c.persist.journal.sync()
+        _crash_image(lead, tmp_path / "img")
+        digest = engine_digest(c)
+    finally:
+        c.shutdown()
+    r = make_client(tmp_path / "img", fsync="always")
+    try:
+        rec = r.persist.last_recovery
+        assert rec["snapshot_seq"] == 10
+        assert rec["replayed"] == 1  # ONLY the suffix replays
+        assert r.get_bucket("after").get() == "suffix"
+        assert r.get_map("m").get("f7") == 7
+        assert engine_digest(r) == digest
+    finally:
+        r.shutdown()
+
+
+def test_everysec_clean_shutdown_loses_nothing(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="everysec")
+    try:
+        for i in range(5):
+            c.get_bucket(f"b{i}").set(i)
+    finally:
+        c.shutdown()  # close() flushes + fsyncs the tail
+    assert [r.seq for r in iter_records(str(lead))] == [1, 2, 3, 4, 5]
+
+
+def test_recovery_stats_and_gauges(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always")
+    try:
+        c.get_bucket("b").set(1)
+        _crash_image(lead, tmp_path / "img")
+    finally:
+        c.shutdown()
+    r = make_client(tmp_path / "img", fsync="always")
+    try:
+        gauges = r.metrics.snapshot()["gauges"]
+        assert gauges["persist.last_seq"] == 1
+        assert gauges["persist.replayed"] == 1
+        assert gauges["persist.segments"] >= 1
+        st = r.persist.stats()
+        assert st["journal"]["last_seq"] == 1
+        assert st["recovery"]["replayed"] == 1
+    finally:
+        r.shutdown()
+
+
+def test_persist_config_from_dict_and_redis_mode_guard(tmp_path):
+    cfg = Config.from_dict({
+        "persist": {"dir": str(tmp_path / "p"), "fsync": "off",
+                    "snapshot_keep": 5},
+    })
+    assert isinstance(cfg.persist, PersistConfig)
+    assert cfg.persist.fsync == "off" and cfg.persist.snapshot_keep == 5
+
+
+# ---------------------------------------------------------------------------
+# 5. follower / warm standby
+# ---------------------------------------------------------------------------
+
+def test_follower_tails_and_promotes_mid_stream(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always")
+    follower = None
+    promoted = None
+    try:
+        for i in range(4):
+            c.get_map("m").put(f"f{i}", i)
+        follower = JournalFollower(str(lead), poll_interval_s=0.01)
+        follower.start()
+        # keep writing while the follower is live, then promote mid-stream:
+        # the drain inside promote() must pick up whatever it hadn't applied
+        for i in range(4, 12):
+            c.get_map("m").put(f"f{i}", i)
+        c.get_bit_set("bits").set(9, True)
+        c.persist.journal.sync()
+        leader_digest = engine_digest(c)
+        promoted = follower.promote(catch_up=True, timeout_s=30)
+        assert follower.lag() == 0
+        assert engine_digest(promoted) == leader_digest
+        assert promoted.get_map("m").get("f11") == 11
+        st = follower.stats()
+        assert st["applied_seq"] == c.persist.journal.last_seq
+        assert st["apply_errors"] == 0
+    finally:
+        if follower is not None:
+            follower.close()
+        c.shutdown()
+
+
+def test_follower_queue_mode_attach(tmp_path):
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="off")  # queue mode needs no disk flushes
+    follower = None
+    try:
+        follower = JournalFollower(str(lead), poll_interval_s=0.01)
+        follower.attach(c.persist.journal)
+        follower.start()
+        for i in range(6):
+            c.get_bucket(f"b{i}").set(i * 3)
+        promoted = follower.promote(catch_up=True, timeout_s=30)
+        for i in range(6):
+            assert promoted.get_bucket(f"b{i}").get() == i * 3
+        assert follower.stats()["mode"] == "queue"
+    finally:
+        if follower is not None:
+            follower.close()
+        c.shutdown()
+
+
+def test_follower_rejects_persisting_config(tmp_path):
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_persist(str(tmp_path / "f"))
+    with pytest.raises(ValueError):
+        JournalFollower(str(tmp_path / "lead"), config=cfg)
+
+
+# ---------------------------------------------------------------------------
+# 6. checkpoint .old fallback (satellite: crash between the swap renames)
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_old_fallback_after_interrupted_swap(tmp_path):
+    c = RedissonTPU.create(Config())
+    try:
+        c.get_bit_set("bits").set(3, True)
+        path = str(tmp_path / "ckpt")
+        assert c.save_checkpoint(path) >= 1
+        # simulate a crash after `path -> path.old` but before `tmp -> path`
+        os.replace(path, path + ".old")
+        assert checkpoint.info(path)["version"] == 1  # info() falls back
+        c.get_bit_set("bits").set(3, False)
+        assert c.load_checkpoint(path) >= 1  # load() falls back
+        assert c.get_bit_set("bits").get(3)
+        assert checkpoint.extra_file(path, "nope.bin") is None
+    finally:
+        c.shutdown()

@@ -312,6 +312,79 @@ def test_g006_suppression_with_reason():
     assert "G006" not in rules_of(findings)
 
 
+def test_g007_literal_write_run_flagged():
+    findings = lint_src("""
+        def evict(backend, target, ops):
+            backend.run("delete", target, ops)
+    """)
+    assert "G007" in rules_of(findings)
+
+
+def test_g007_read_kind_not_flagged():
+    findings = lint_src("""
+        def peek(backend, target, ops):
+            backend.run("exists", target, ops)
+            backend.run("hll_export", target, ops)
+    """)
+    assert "G007" not in rules_of(findings)
+
+
+def test_g007_variable_kind_not_flagged():
+    """The executor's own dispatch (`run(kind, ...)` with a variable) is the
+    sanctioned path — only literal kinds are a bypass signature."""
+    findings = lint_src("""
+        def dispatch(backend, kind, target, ops):
+            backend.run(kind, target, ops)
+    """)
+    assert "G007" not in rules_of(findings)
+
+
+def test_g007_scoped_outside_executor():
+    src = """
+        def evict(backend, target, ops):
+            backend.run("delete", target, ops)
+    """
+    hot = FileLinter(os.path.join(REPO, "redisson_tpu", "routing.py"),
+                     repo_root=REPO, source=textwrap.dedent(src)).run()
+    commit_point = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "executor.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    outside = FileLinter(os.path.join(REPO, "benchmarks", "bench.py"),
+                         repo_root=REPO, source=textwrap.dedent(src)).run()
+    assert "G007" in rules_of(hot)
+    assert "G007" not in rules_of(commit_point)
+    assert "G007" not in rules_of(outside)
+
+
+def test_g007_suppression_with_reason():
+    findings = lint_src("""
+        def evict(backend, target, ops):
+            # graftlint: allow-journal(below the commit point: delegate fan-out)
+            backend.run("delete", target, ops)
+    """)
+    assert "G007" not in rules_of(findings)
+
+
+def test_g007_registry_coverage():
+    """Every OP_TABLE kind behaves per its write flag: all write kinds are
+    flagged when dispatched as a literal `.run`, no read kind ever is. Pins
+    the rule to the registry so new commands are covered automatically."""
+    from redisson_tpu.commands import OP_TABLE
+
+    write_kinds = {k for k, d in OP_TABLE.items() if d.write}
+    read_kinds = set(OP_TABLE) - write_kinds
+    assert len(write_kinds) > 50  # sanity: the registry actually loaded
+
+    def flagged(kind):
+        src = f'def f(b, t, ops):\n    b.run("{kind}", t, ops)\n'
+        return "G007" in rules_of(lint_src(src))
+
+    missed = sorted(k for k in write_kinds if not flagged(k))
+    spurious = sorted(k for k in read_kinds if flagged(k))
+    assert missed == [], f"write kinds not flagged by G007: {missed}"
+    assert spurious == [], f"read kinds wrongly flagged by G007: {spurious}"
+
+
 def test_serve_package_lints_clean():
     dicts = run_lint([os.path.join(ENGINE_DIR, "serve")], jaxpr=False)
     assert dicts == [], dicts

@@ -19,6 +19,16 @@ failure modes (see findings.RULES). Scope notes:
   ``redisson_tpu/`` (executor.py, routing.py, serve/) — unless the file
   was passed explicitly. The models' sync facades are the *documented*
   blocking API and stay out of scope.
+* G007 (journal) applies everywhere under ``redisson_tpu/`` except
+  executor.py (the commit point that OWNS the journal hook). It flags
+  ``anything.run("<kind>", ...)`` where the literal kind is a write op in
+  the command registry — such a call mutates engine state without the
+  write-ahead journal seeing it, so recovery and followers silently
+  diverge. Calls below the commit point (backend-internal delegates) or
+  deliberately unjournaled maintenance carry reasoned
+  ``allow-journal``/``allow-g007`` suppressions. The registry is imported
+  lazily; if ``redisson_tpu.commands`` cannot be imported the rule is
+  skipped rather than guessed.
 
 Suppression: ``# graftlint: allow-<name>(reason)`` on the flagged line,
 anywhere within the flagged expression's line span, or on a standalone
@@ -50,6 +60,24 @@ _U64_MODULE = "redisson_tpu.ops.u64"
 _PALLAS_MODULE = "jax.experimental.pallas"
 
 _ITEM_RE = re.compile(r"allow-([A-Za-z0-9_-]+)\(([^)]*)\)")
+
+_write_kinds_cache: frozenset | None = None
+
+
+def _write_kinds() -> frozenset:
+    """Kinds the command registry marks write=True (lazy; empty set when
+    the package isn't importable so graftlint still runs standalone)."""
+    global _write_kinds_cache
+    if _write_kinds_cache is None:
+        try:
+            from redisson_tpu.commands import OP_TABLE
+        except Exception:
+            _write_kinds_cache = frozenset()
+        else:
+            _write_kinds_cache = frozenset(
+                kind for kind, d in OP_TABLE.items() if d.write
+            )
+    return _write_kinds_cache
 
 
 def _rel(path: str, repo_root: str | None) -> str:
@@ -95,6 +123,7 @@ class FileLinter:
             self.module_defs[name] = node
         self._g002_on = self.explicit or self._in_sync_scope()
         self._g006_on = self.explicit or self._in_block_scope()
+        self._g007_on = self.explicit or self._in_journal_scope()
         self._g004_on = not self.relpath.endswith("ops/u64.py")
         self._pallas_file = any(
             full == _PALLAS_MODULE for full in self.alias_modules.values()
@@ -157,6 +186,13 @@ class FileLinter:
             sub in ("executor.py", "routing.py")
             or sub.startswith("serve/")
         )
+
+    def _in_journal_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        # executor.py is the commit point that owns the journal hook
+        return rel != "redisson_tpu/executor.py"
 
     # -- alias helpers -----------------------------------------------------
 
@@ -239,6 +275,8 @@ class FileLinter:
                 self._check_g002(node, fn_node)
             if self._g006_on:
                 self._check_g006(node)
+            if self._g007_on:
+                self._check_g007(node)
             self._check_jit_construction(node, in_func, in_loop)
             if self._pallas_file:
                 self._check_pallas_call(node, fn_node)
@@ -419,6 +457,31 @@ class FileLinter:
             "pass a timeout, or bound the wait with a serve deadline; if the "
             "future is provably already resolved (done-callback context) or "
             "blocking IS the contract, add `# graftlint: allow-block(reason)`",
+        )
+
+    # -- G007: writes bypassing the journal hook ------------------------------
+
+    def _check_g007(self, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "run"):
+            return
+        if not call.args:
+            return
+        kind = call.args[0]
+        if not (isinstance(kind, ast.Constant) and isinstance(kind.value, str)):
+            return
+        if kind.value not in _write_kinds():
+            return
+        self._emit(
+            "G007", call,
+            f'direct `.run("{kind.value}")` — a write op dispatched below/'
+            "beside the executor commit point; the write-ahead journal never "
+            "records it, so crash recovery and followers silently diverge",
+            "route the mutation through executor.execute_async/execute_sync "
+            "so the journal hook sees it; if this call is backend-internal "
+            "delegation (already downstream of the hook) or deliberately "
+            "unjournaled maintenance, add "
+            "`# graftlint: allow-journal(reason)`",
         )
 
     # -- G003: recompilation hazards ----------------------------------------

@@ -70,6 +70,12 @@ RULES = {
         "unbounded blocking: Future.result() with no timeout in a "
         "dispatch/serve path (executor.py, routing.py, serve/)",
     ),
+    "G007": (
+        "journal",
+        "write-op mutation bypassing the journal hook: a literal "
+        '.run("<kind>") whose kind is write=True in the OP_TABLE, outside '
+        "the executor commit point — persistence/replication never sees it",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
