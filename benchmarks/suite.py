@@ -796,6 +796,126 @@ def pipeline_smoke():
     return ok
 
 
+def delta_smoke():
+    """Delta-ingest acceptance smoke (the CPU-only CI contract for the
+    delta tentpole):
+
+      1. a mixed hll/bloom/bitset workload run once with ingest="delta"
+         and once with ingest="device" (scatter) must land in
+         BIT-IDENTICAL device state with identical per-op results;
+      2. a 1M-key PFADD batch must ship < 1/8 of the raw-key bytes over
+         the link (the dense 16 KB register plane vs 8 B/key);
+      3. with the in-flight window >= 2, host folds must overlap device
+         merges (executor overlap ratio > 0).
+    """
+    from redisson_tpu import native as native_mod
+
+    if not native_mod.available():
+        print("# delta-smoke: native library unavailable; SKIP",
+              file=sys.stderr)
+        return True
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config, TpuConfig
+
+    # The <1/8 link criterion needs n > 16384 (dense HLL plane is 16 KB
+    # vs 8 B/key raw), so the tiny scale floors at 128K keys, not _scale.
+    n = 1 << (17 if _TINY else 20)
+    rng = np.random.default_rng(21)
+    hll_batches = [rng.integers(0, 2**63, n, np.uint64) for _ in range(4)]
+    bloom_batches = [rng.integers(0, 2**63, 1 << 13, np.uint64)
+                     for _ in range(3)]
+    bloom_batches.append(bloom_batches[0])  # re-adds: try_add must say False
+    bits_batches = [rng.integers(0, 1 << 16, 1 << 12, np.int64)
+                    for _ in range(3)]
+    bits_batches.append(bits_batches[0])  # re-sets: old bits must say True
+
+    def play(ingest):
+        c = RedissonTPU.create(Config(tpu=TpuConfig(ingest=ingest)))
+        try:
+            results = []
+            hs = [c.get_hyper_log_log(f"ds:h{i}") for i in range(2)]
+            bf = c.get_bloom_filter("ds:bloom")
+            bf.try_init(expected_insertions=200_000, false_probability=0.01)
+            bs = c.get_bit_set("ds:bits")
+            # Serial op-by-op: both paths must agree per op, and serial
+            # submission pins the visibility point (each op sees all
+            # earlier ops' state) so the comparison is exact.
+            for i, b in enumerate(hll_batches):
+                results.append(bool(hs[i % 2].add_ints(b)))
+            for b in bloom_batches:
+                results.append(bf.add_ints(b).tolist())
+            for b in bits_batches:
+                results.append(bs.set_bits(b).tolist())
+            be = c._routing.sketch
+            state = {}
+            bank = np.asarray(be._ensure_bank())
+            for i in range(2):
+                state[f"ds:h{i}"] = bank[be._rows[f"ds:h{i}"]].copy()
+            be._bloom_device_sync("ds:bloom")  # host-mirror path parity
+            for name in ("ds:bloom", "ds:bits"):
+                state[name] = np.asarray(be.store.get(name).state).copy()
+            return results, state
+        finally:
+            _close(c)
+
+    ok = True
+    res_d, state_d = play("delta")
+    res_s, state_s = play("device")
+    identical = res_d == res_s and all(
+        np.array_equal(state_d[k], state_s[k]) for k in state_s)
+    print(f"# delta-smoke: delta vs scatter — results "
+          f"{'identical' if res_d == res_s else 'DIVERGED'}, state "
+          f"{'bit-identical' if identical else 'MISMATCH'}")
+    if not identical:
+        for k in state_s:
+            if not np.array_equal(state_d[k], state_s[k]):
+                print(f"#   state mismatch: {k}", file=sys.stderr)
+        ok = False
+
+    # -- link bytes/key at the 1M-key batch ---------------------------------
+    c = RedissonTPU.create(Config(tpu=TpuConfig(ingest="delta")))
+    try:
+        h = c.get_hyper_log_log("ds:link")
+        h.add_ints(hll_batches[0])
+        stats = c._routing.sketch.ingest_stats()
+        ratio = stats["link_bytes"] / max(stats["raw_bytes"], 1)
+        print(f"# delta-smoke: {stats['delta_bytes_per_key']:.4f} B/key "
+              f"shipped vs 8 raw ({ratio:.4f} of raw; "
+              f"{stats['merge_launches']} launch/"
+              f"{stats['delta_runs']} run)")
+        if ratio >= 1 / 8:
+            print(f"#   link ratio {ratio:.3f} >= 1/8", file=sys.stderr)
+            ok = False
+    finally:
+        _close(c)
+
+    # -- fold/merge overlap with window >= 2 --------------------------------
+    cfg = Config(tpu=TpuConfig(ingest="delta"))
+    cfg.tpu.inflight_runs = 2
+    # One op per run: cap the batch at one submission so the greedy policy
+    # cannot collapse the burst into a single window (which would leave
+    # nothing to overlap).
+    cfg.tpu.max_batch_keys = n
+    c = RedissonTPU.create(cfg)
+    try:
+        h = c.get_hyper_log_log("ds:ov")
+        h.add_ints(hll_batches[0])  # warm compile outside the burst
+        futs = [h.add_ints_async(hll_batches[i % len(hll_batches)])
+                for i in range(8)]
+        for f in futs:
+            f.result(timeout=120)
+        stats = c._executor.pipeline_stats()
+        print(f"# delta-smoke: window=2 overlap ratio "
+              f"{stats['overlap_ratio']:.2f} "
+              f"({stats['runs_completed']} runs)")
+        if stats["overlap_ratio"] <= 0.0:
+            print("#   no fold/merge overlap observed", file=sys.stderr)
+            ok = False
+    finally:
+        _close(c)
+    return ok
+
+
 def _engine_digest(client) -> str:
     """Bit-identical engine fingerprint (sketch arrays + structure tier) —
     the same definition tests/test_persist.py pins recovery against."""
@@ -936,7 +1056,7 @@ def main():
                     help="write results into BASELINE.json['published']")
     ap.add_argument("--ingest", default="auto",
                     choices=("auto", "device", "hostfold",
-                             "scatter", "sort", "segment"),
+                             "scatter", "sort", "segment", "delta"),
                     help="sketch ingest path (auto = measured planner)")
     ap.add_argument("--lint-smoke", action="store_true",
                     help="graftlint Tier A over the engine AND this bench "
@@ -948,6 +1068,10 @@ def main():
                     help="in-flight window sweep {1,2,4}: overlap ratio, "
                          "result identity vs serial, read-cache hit rate, "
                          "then exit")
+    ap.add_argument("--delta-smoke", action="store_true",
+                    help="delta-ingest acceptance: bit-identical state vs "
+                         "scatter, link bytes/key < 1/8 raw at the 1M-key "
+                         "batch, fold/merge overlap at window 2, then exit")
     ap.add_argument("--persist-smoke", action="store_true",
                     help="fsync-policy sweep {none,off,everysec,always}: "
                          "journal overhead per policy + kill-and-recover "
@@ -959,6 +1083,9 @@ def main():
 
     if args.pipeline_smoke:
         sys.exit(0 if pipeline_smoke() else 1)
+
+    if args.delta_smoke:
+        sys.exit(0 if delta_smoke() else 1)
 
     if args.persist_smoke:
         sys.exit(0 if persist_smoke() else 1)

@@ -104,6 +104,10 @@ class RedissonTPU:
             from redisson_tpu.observability import register_read_cache
 
             register_read_cache(self.metrics, cache)
+        if callable(getattr(sketch, "ingest_stats", None)):
+            from redisson_tpu.observability import register_delta_ingest
+
+            register_delta_ingest(self.metrics, sketch)
         self._pubsub = self._routing.pubsub
         self._watchdog = LockWatchdog(self._executor)
         self._eviction = EvictionScheduler(self._executor)

@@ -46,7 +46,12 @@ class TpuConfig:
     # force one path: "device" ships raw keys (8 B/key) and inserts with
     # the configured hll_impl; "scatter" / "sort" / "segment" force that
     # device insert kernel (segment = the Pallas segmented-scatter);
-    # "hostfold" folds into a 16 KB sketch natively and ships that.
+    # "hostfold" folds into a 16 KB sketch natively and ships that;
+    # "delta" folds hll_add/bloom_add/bitset_set batches into per-target
+    # delta planes on the host and retires every plane staged in one
+    # pipeline window through a single fused device merge (README "Delta
+    # ingest"); under "auto" the same path competes in the planner's cost
+    # table as the "delta" candidate.
     ingest: str = "auto"
     hash_seed: int = 0
     # Coalescing cap for one dispatcher run. Device kernels still chunk at

@@ -395,6 +395,26 @@ def hll_bank_set_row(bank, regs, row):
     return bank.at[row].set(regs.astype(jnp.int32))
 
 
+@jax.jit
+def hll_bank_rows_u8(bank, rows):
+    """Gather bank rows as uint8 register images (registers are 0..64, so
+    the narrowing is lossless) — the old-state side of a delta-merge
+    stack row."""
+    return bank[rows].astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def hll_bank_set_rows(bank, regs_u8, rows):
+    """Overwrite bank rows [R] with merged [R, m] uint8 register images —
+    the writeback half of the fused delta merge (rows are unique within a
+    run, so a flat set scatter is race-free)."""
+    s, m = bank.shape
+    flat = bank.reshape(-1)
+    idx = rows[:, None] * m + jnp.arange(m, dtype=rows.dtype)[None, :]
+    return flat.at[idx.reshape(-1)].set(
+        regs_u8.astype(jnp.int32).reshape(-1)).reshape(s, m)
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def hll_bank_zero_row(bank, row):
     return bank.at[row].set(0)
@@ -445,6 +465,45 @@ def bitset_absorb_packed(bits, packed):
     sh = jnp.asarray([7, 6, 5, 4, 3, 2, 1, 0], jnp.uint8)
     unpacked = ((packed[:, None] >> sh[None, :]) & 1).reshape(-1)[:m]
     return jnp.maximum(bits, unpacked.astype(bits.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Delta ingest — device half (ingest/delta.py is the host half).
+#
+# Every host-folded plane staged in one pipeline window becomes a row of a
+# [T, L] uint8 cell stack (L = max cell count, zero-padded; zeros are an
+# identity under max), merged against the matching old-state rows in ONE
+# fused elementwise-max launch. OR == max on 0/1 bit cells and HLL
+# registers are 0..64, so one kernel covers all three delta kinds.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("cells",))
+def delta_unpack(packed, cells: int):
+    """Packed big-endian byte plane -> [cells] uint8 cells (bit i lives at
+    byte i>>3, bit 7-(i&7) — numpy packbits order, see bitset_pack)."""
+    sh = jnp.asarray([7, 6, 5, 4, 3, 2, 1, 0], jnp.uint8)
+    return ((packed[:, None] >> sh[None, :]) & 1).astype(
+        jnp.uint8).reshape(-1)[:cells]
+
+
+@functools.partial(jax.jit, static_argnames=("nbytes",))
+def delta_scatter_bytes(idx, val, nbytes: int):
+    """Expand a sparse (idx, val) byte-plane encoding to its dense form.
+    Padded entries carry (0, 0): .at[0].max(0) is a no-op."""
+    return jnp.zeros((nbytes,), jnp.uint8).at[idx].max(val)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def delta_merge_stack(old, delta):
+    """ONE fused multi-target delta merge: elementwise max of the [T, L]
+    uint8 old-state stack against the delta stack -> (merged [T, L],
+    changed [T] bool). Pallas streaming kernel on TPU, XLA elsewhere.
+    Both stacks are per-window temporaries, so both donate."""
+    if pk.use_pallas():
+        return pk.delta_merge(old, delta)
+    merged = jnp.maximum(old, delta)
+    return merged, jnp.any(merged != old, axis=1)
 
 
 # ---------------------------------------------------------------------------

@@ -126,7 +126,7 @@ class _InflightRun:
 
     __slots__ = ("kind", "target", "targets", "is_global", "nops", "nkeys",
                  "t0", "queue_delay_s", "stage_s", "pending", "failed",
-                 "overlapped", "depth", "gates_held", "lock")
+                 "op_failed", "overlapped", "depth", "gates_held", "lock")
 
     def __init__(self, kind: str, target: str, targets: frozenset,
                  is_global: bool):
@@ -141,6 +141,7 @@ class _InflightRun:
         self.stage_s = None
         self.pending = 0
         self.failed = False
+        self.op_failed = False
         self.overlapped = False
         self.depth = 1
         self.gates_held = True
@@ -171,6 +172,12 @@ class CommandExecutor:
         # pod backend's bank insert, where the device call carries a per-key
         # target row). Per-target FIFO is preserved: only queue heads join.
         self._global_kinds = frozenset(getattr(backend, "GLOBAL_COALESCE", ()))
+        # Optional kind -> group aliasing for the cross-target steal: kinds
+        # sharing a group value coalesce into ONE run (the TPU backend's
+        # delta window stacks hll_add/bloom_add/bitset_set planes into a
+        # single fused merge launch). Ungrouped kinds gate under their own
+        # name, which reproduces the plain same-kind steal.
+        self._coalesce_groups = dict(getattr(backend, "COALESCE_GROUPS", {}))
         # -- pipeline state (tentpole PR 4) --------------------------------
         # A run stays "in flight" from dispatch until its last future
         # resolves; the window bounds how many such runs may exist at once.
@@ -314,11 +321,17 @@ class CommandExecutor:
             if target in self._inflight_targets:
                 continue
             head_kind = self._queues[target][0].kind
-            if head_kind in self._global_kinds and head_kind in self._inflight_kinds:
+            if (head_kind in self._global_kinds
+                    and self._group_of(head_kind) in self._inflight_kinds):
                 continue
             self._ready.remove(target)
             return target
         return None
+
+    def _group_of(self, kind: str) -> str:
+        """Gate/steal key for a global kind: its COALESCE_GROUPS alias, or
+        itself when ungrouped."""
+        return self._coalesce_groups.get(kind, kind)
 
     def _admit_locked(self, kind: str, target: str,
                       run: List[Op]) -> _InflightRun:
@@ -332,7 +345,7 @@ class CommandExecutor:
         token.depth = len(self._inflight)
         self._inflight_targets |= targets
         if is_global:
-            self._inflight_kinds.add(kind)
+            self._inflight_kinds.add(self._group_of(kind))
         return token
 
     def _collect_run_locked(self, target: str) -> Tuple[str, str, List[Op]]:
@@ -360,10 +373,13 @@ class CommandExecutor:
                 keys = self._drain_same_kind(q, kind, run, keys, cap)
         if kind in self._global_kinds:
             keys = sum(op.nkeys for op in run)
-            # Steal queue heads of the same kind from other targets. Mutate
-            # _ready/_queues only AFTER the scan — removing entries while
-            # walking a snapshot of the round-robin is how targets get
-            # dropped (satellite regression: test_serve.py interleave test).
+            group = self._group_of(kind)
+            # Steal queue heads of the same gate group (same kind unless the
+            # backend aliases kinds together, e.g. the delta window) from
+            # other targets. Mutate _ready/_queues only AFTER the scan —
+            # removing entries while walking a snapshot of the round-robin is
+            # how targets get dropped (satellite regression: test_serve.py
+            # interleave test).
             emptied: List[str] = []
             for other in list(self._ready):
                 if keys >= cap:
@@ -380,7 +396,8 @@ class CommandExecutor:
                 oq = self._queues[other]
                 while (
                     oq
-                    and oq[0].kind == kind
+                    and oq[0].kind in self._global_kinds
+                    and self._group_of(oq[0].kind) == group
                     and keys + oq[0].nkeys <= cap
                 ):
                     op = oq.popleft()
@@ -469,7 +486,7 @@ class CommandExecutor:
             # nor the cost model's service EWMA.
             for op in live:
                 op.future.add_done_callback(
-                    lambda _fut, token=token: self._op_done(token))
+                    lambda fut, token=token: self._op_done(token, fut))
         journal = self._journal
         if journal is not None and not parked:
             # Write-ahead ordering: the record reaches the journal before
@@ -521,10 +538,16 @@ class CommandExecutor:
 
     # -- completion path ----------------------------------------------------
 
-    def _op_done(self, token: _InflightRun) -> None:
+    def _op_done(self, token: _InflightRun, fut=None) -> None:
         """Done-callback on each live op future; runs on whichever thread
         resolves it (the backend completer, or the dispatcher itself for
         synchronous backends)."""
+        if fut is not None and not fut.cancelled() and \
+                fut.exception() is not None:
+            # A backend that isolates failures per op/group (the delta
+            # window) completes futures with exceptions instead of raising
+            # out of run() — the error metric must still see the run.
+            token.op_failed = True
         with token.lock:
             token.pending -= 1
             if token.pending > 0:
@@ -538,6 +561,10 @@ class CommandExecutor:
         own wall-clock around run() collapses to staging time once dispatch
         stops blocking on results."""
         dt = self._clock() - token.t0
+        if token.op_failed and not token.failed and self._metrics:
+            # failed (staging raised) already recorded the error inline;
+            # count per-op failures once per run, like a staging failure.
+            self._metrics.record_error(token.kind)
         if not token.failed:
             self._policy.observe(token.kind, token.nkeys, dt)
             if self._metrics:
@@ -554,7 +581,7 @@ class CommandExecutor:
         token.gates_held = False
         self._inflight_targets.difference_update(token.targets)
         if token.is_global:
-            self._inflight_kinds.discard(token.kind)
+            self._inflight_kinds.discard(self._group_of(token.kind))
 
     def _release_gates(self, token: _InflightRun) -> None:
         with self._cv:

@@ -132,7 +132,22 @@ class IngestPlanner:
         self.platform = platform or jax.default_backend()
         self._measure = measure or measure_device_paths
         self._table: Dict[tuple, Dict[str, float]] = {}
+        # Advisory seed costs (a previous process's table, a config hint):
+        # NEVER a substitute for the first-use measurement. A stale prior
+        # once let `sort` survive in the candidate set at 5x the measured
+        # scatter cost (BENCH_r05: 11.2 vs 58.0 M keys/s) — so plan() always
+        # measures the row on first use and measured values override the
+        # prior; a prior only fills paths the measurement cannot time.
+        self._priors: Dict[tuple, Dict[str, float]] = {}
         self._lock = threading.Lock()
+
+    def set_prior(self, structure: str, nkeys: int,
+                  costs: Dict[str, float]) -> None:
+        """Seed advisory ns/key costs for one (structure, size class) row.
+        Priors never pre-empt measurement — see __init__."""
+        key = (structure, self.size_class(nkeys))
+        with self._lock:
+            self._priors.setdefault(key, {}).update(costs)
 
     @staticmethod
     def size_class(nkeys: int) -> int:
@@ -164,9 +179,16 @@ class IngestPlanner:
         with self._lock:
             costs = self._table.get(key)
         if costs is None:
+            # First use of this row: measure EVERY device path now, even
+            # ones a prior claims to know — measured values override the
+            # prior, so a dominated path (the stale `sort` prior) can never
+            # outlive its first real timing. Priors only contribute paths
+            # the measurement loop cannot time on this platform.
             fresh = self._measure(structure, 1 << key[1])
             with self._lock:
-                costs = self._table.setdefault(key, dict(fresh))
+                row = dict(self._priors.get(key, {}))
+                row.update(fresh)
+                costs = self._table.setdefault(key, row)
         all_costs = {k: v + device_overhead for k, v in costs.items()}
         if extra_costs:
             all_costs.update(extra_costs)

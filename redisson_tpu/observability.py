@@ -333,6 +333,25 @@ def register_read_cache(registry: MetricsRegistry, cache) -> None:
     registry.gauge("backend.read_cache_hit_ratio", _ratio)
 
 
+def register_delta_ingest(registry: MetricsRegistry, backend) -> None:
+    """Expose a backend's delta-ingest counters (see TpuBackend.counters /
+    ingest_stats) as backend.* gauges: link bytes actually shipped vs what
+    the raw-key path would have cost, host fold time, and fused merge
+    launches — the observable core of the delta tentpole (link compression
+    and one-launch-per-window retirement)."""
+    def _stat(key, default=0):
+        return lambda: backend.ingest_stats().get(key, default)
+
+    registry.gauge("backend.link_bytes", _stat("link_bytes"))
+    registry.gauge("backend.raw_bytes", _stat("raw_bytes"))
+    registry.gauge("backend.delta_fold_s", _stat("delta_fold_s", 0.0))
+    registry.gauge("backend.merge_launches", _stat("merge_launches"))
+    registry.gauge("backend.delta_runs", _stat("delta_runs"))
+    registry.gauge("backend.delta_keys", _stat("delta_keys"))
+    registry.gauge("backend.delta_bytes_per_key",
+                   _stat("delta_bytes_per_key", 0.0))
+
+
 def register_persist(registry: MetricsRegistry, manager) -> None:
     """Expose the durability subsystem (persist/) as persist.* gauges:
     journal throughput and group-commit behavior, snapshot cadence, and —

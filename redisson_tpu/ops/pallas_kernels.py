@@ -14,6 +14,11 @@ pass regardless of bank size:
 * `popcount_cells` / `bitop_cells` — BITCOUNT / BITOP over the unpacked
   one-uint8-cell-per-bit device layout (`ops/bitset.py`), gridded so
   arbitrarily long bit arrays stream block-by-block.
+* `delta_merge` — the delta-ingest retire kernel: one fused elementwise
+  max over a [T, L] uint8 stack of host-folded per-target delta planes
+  vs their current device state, with a per-row changed flag. OR == max
+  in the unpacked cell domain, so one kernel serves hll_add, bloom_add
+  and bitset_set deltas in a single launch per pipeline window.
 
 All kernels run in interpreter mode off-TPU (CPU tests) and compiled on
 TPU; `engine` gates them on the backend platform. The HLL insert fold
@@ -85,6 +90,63 @@ def merge_stack(stack: jnp.ndarray, block: int = 64) -> jnp.ndarray:
         out_specs=pl.BlockSpec((m,), lambda i: (0,), memory_space=pltpu.VMEM),
         interpret=_interpret(),
     )(stack)
+
+
+# ---------------------------------------------------------------------------
+# delta_merge: fused multi-target delta merge over [T, L] uint8 cell stacks
+# ---------------------------------------------------------------------------
+
+
+def _delta_merge_kernel(old_ref, delta_ref, out_ref, changed_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        changed_ref[0, 0] = 0
+
+    merged = jnp.maximum(old_ref[:], delta_ref[:])
+    out_ref[:] = merged
+    changed_ref[0, 0] = changed_ref[0, 0] | jnp.any(
+        merged != old_ref[:]).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def delta_merge(old: jnp.ndarray, delta: jnp.ndarray, block: int = 1 << 15):
+    """The delta-ingest retire kernel: elementwise max of two [T, L] uint8
+    stacks (one row per target; OR == max in the unpacked 0/1 cell domain,
+    HLL registers fit uint8) plus a per-row changed flag.
+
+    Streams `block` cells of one row per grid step; rows iterate on the
+    outer grid axis with a per-row SMEM changed accumulator (the TPU grid
+    is sequential, inner axis fastest, so the `j == 0` reset is safe).
+    Purely elementwise — bandwidth-bound, no scatter issue port in sight.
+    Returns (merged [T, L], changed [T] bool)."""
+    t, l = old.shape
+    block = min(block, l)
+    # Callers pad L to a power of two >= 1024, so block divides l.
+    grid = (t, l // block)
+    merged, changed = pl.pallas_call(
+        _delta_merge_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((t, l), old.dtype),
+            jax.ShapeDtypeStruct((t, 1), jnp.int32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+        ),
+        interpret=_interpret(),
+    )(old, delta)
+    return merged, changed[:, 0] != 0
 
 
 # ---------------------------------------------------------------------------

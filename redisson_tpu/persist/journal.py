@@ -315,16 +315,23 @@ class Journal:
         "always" policy group-commit the fsync across the pipeline window
         instead of paying one fsync per run.
         """
-        if not self.journals(kind):
+        # Coalesced runs may mix kinds (group-coalesced delta windows stack
+        # hll_add/bloom_add/bitset_set ops behind one run kind): each record
+        # is stamped with ITS op's kind so replay re-dispatches the original
+        # per-op stream byte-identically, and read-kind riders are skipped
+        # per op, not per run.
+        ops = [op for op in ops if self.journals(getattr(op, "kind", kind))]
+        if not ops:
             return 0
         frames = bytearray()
         records: List[JournalRecord] = []
         seq = self._last_seq
         for op in ops:
+            op_kind = getattr(op, "kind", kind)
             seq += 1
             payload = encode_payload(op.payload)
             target = op.target.encode("utf-8")
-            kb = kind.encode("ascii")
+            kb = op_kind.encode("ascii")
             body = bytearray()
             body += _U64.pack(seq)
             body += _U32.pack(len(target))
@@ -337,7 +344,7 @@ class Journal:
             frames += _FRAME.pack(len(body), crc32(body))
             frames += body
             if self._listeners:
-                records.append(JournalRecord(seq, op.target, kind, op.payload))
+                records.append(JournalRecord(seq, op.target, op_kind, op.payload))
         with self._io:
             if self._closed:
                 raise RuntimeError("journal is closed")

@@ -25,6 +25,7 @@ class RoutingBackend:
         self.sketch = sketch_backend
         self.structures = structures or StructureBackend()
         self.GLOBAL_COALESCE = frozenset(getattr(sketch_backend, "GLOBAL_COALESCE", ()))
+        self.COALESCE_GROUPS = dict(getattr(sketch_backend, "COALESCE_GROUPS", {}))
         self.BLOOM_STRICT_MOD = bool(getattr(sketch_backend, "BLOOM_STRICT_MOD", False))
         # Both tiers commit all observable state inside run() (the structure
         # engine resolves synchronously), so the router is dispatch-time-state

@@ -395,6 +395,9 @@ class ServingLayer:
         now = self._clock()
         pol = getattr(self._executor, "policy", None)
         journal = getattr(self._executor, "journal", None)
+        backend = getattr(self._executor, "backend", None)
+        sketch = getattr(backend, "sketch", backend)  # router -> device tier
+        ingest_stats = getattr(sketch, "ingest_stats", None)
         return {
             "now": now,
             "admission": self._admission.snapshot(now),
@@ -405,6 +408,10 @@ class ServingLayer:
                          if hasattr(self._executor, "pipeline_stats")
                          else None),
             "journal": journal.stats() if journal is not None else None,
+            # Delta-ingest link/fold/merge gauges (backend.link_bytes et
+            # al.): is the write path actually shipping planes, and how
+            # many fused launches is each window costing?
+            "ingest": ingest_stats() if callable(ingest_stats) else None,
             "counters": {
                 k: v for k, v in
                 self._registry.snapshot()["counters"].items()
