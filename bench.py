@@ -554,6 +554,100 @@ def bench_journal_overhead(rounds=200, reps=3):
     return pct
 
 
+def bench_fault(rounds=200, reps=3):
+    """Fault-subsystem numbers (PR 8): fault_overhead_pct — the batched-
+    insert workload with taxonomy + injection seams + watchdog + rebuild
+    guard all wired but idle, vs a bare client (budget < 1%: the disabled
+    `fire()` seam is one module-global read, the enqueue guard two empty-
+    set checks) — and fault_rebuild_s, the wall time of one self-healing
+    HBM rebuild (quarantine -> snapshot+journal re-materialize -> resume)
+    after an injected device-loss fault."""
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    batch = 64
+    ints = np.random.default_rng(23).integers(
+        0, 2**63, size=(rounds, batch), dtype=np.uint64)
+
+    def timed(client):
+        h = client.get_hyper_log_log("bench:fault")
+        m = client.get_map("bench:faultm")
+        best = float("inf")
+        for _ in range(reps):
+            pend = []
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                pend.append(h.add_ints_async(ints[i]))
+                pend.append(m.put_async(f"f{i}", i))
+                if len(pend) >= 8:
+                    for f in pend:
+                        f.result(timeout=60)
+                    pend.clear()
+            for f in pend:
+                f.result(timeout=60)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    base_client = RedissonTPU.create()
+    try:
+        timed(base_client)  # warm compile/caches
+        base = timed(base_client)
+    finally:
+        base_client.shutdown()
+
+    cfg = Config()
+    fc = cfg.use_faults()
+    fc.watchdog = True
+    wired_client = RedissonTPU.create(cfg)
+    try:
+        timed(wired_client)
+        wired = timed(wired_client)
+    finally:
+        wired_client.shutdown()
+    pct = 100.0 * (wired / base - 1.0)
+    print(f"# fault_overhead: {base * 1e3:.1f} ms bare -> {wired * 1e3:.1f} ms"
+          f" with fault subsystem idle ({pct:+.2f}%)", file=sys.stderr)
+
+    # One rebuild, timed by the coordinator itself: persist a workload,
+    # inject a device-loss at d2h, wait for the heal.
+    root = tempfile.mkdtemp(prefix="rtpu-bench-fault-")
+    rebuild_s = 0.0
+    try:
+        cfg = Config()
+        cfg.use_persist(root).fsync = "always"
+        sc = cfg.use_serve()
+        sc.retry_interval_ms = 5
+        fc = cfg.use_faults()
+        fc.plan = [{"seam": "d2h_complete", "fault": "device_lost",
+                    "nth": rounds // 2, "kind": "hll_add"}]
+        c = RedissonTPU.create(cfg)
+        try:
+            h = c.get_hyper_log_log("bench:fault")
+            for i in range(rounds):
+                try:
+                    h.add_ints(ints[i])
+                except Exception:  # noqa: BLE001 - the injected fault
+                    pass
+            if not c.fault.rebuild.wait_idle(timeout=120):
+                raise RuntimeError("rebuild did not settle")
+            snap = c.fault.rebuild.snapshot()
+            if snap["rebuild_failures"] or not snap["rebuilt_total"]:
+                raise RuntimeError(f"rebuild failed: {snap}")
+            rebuild_s = snap["last_rebuild_s"]
+            print(f"# fault_rebuild: {rebuild_s * 1e3:.1f} ms to re-"
+                  f"materialize {snap['rebuilt_total']} target(s), "
+                  f"{snap['replayed_total']} journal records replayed",
+                  file=sys.stderr)
+        finally:
+            c.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return pct, rebuild_s
+
+
 def bench_pfmerge(jax, dev, sketches=1000):
     """PFMERGE+count across 1K sketches (BASELINE: <50 ms)."""
     from redisson_tpu import engine
@@ -686,6 +780,13 @@ def main():
             50 if quick else 200, reps=2 if quick else 3), 1)
     except Exception as exc:  # noqa: BLE001
         print(f"# journal overhead bench failed: {exc!r}", file=sys.stderr)
+    try:
+        pct, rebuild_s = bench_fault(
+            50 if quick else 200, reps=2 if quick else 3)
+        result["fault_overhead_pct"] = round(pct, 2)
+        result["fault_rebuild_s"] = round(rebuild_s, 4)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# fault bench failed: {exc!r}", file=sys.stderr)
     try:
         result["pfmerge_1000_ms"] = round(
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)

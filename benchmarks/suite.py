@@ -1046,6 +1046,159 @@ def _persist_cfg(path):
     return cfg
 
 
+def chaos_smoke():
+    """Seeded chaos through the fault subsystem (PR 8) on the real local
+    client. Three gates, all on the CPU-only CI path:
+
+      (a) RECOVERY/RETRY: pre-commit retryable plans (stage_h2d /
+          kernel_launch / journal_fsync) — every op must ack (the serve
+          retry absorbs the faults) and the engine digest must be
+          bit-identical to a fault-free oracle;
+      (b) REBUILD: a state-uncertain d2h plan — every future completes
+          (typed fault or success, never a hang), the HBM rebuild
+          settles with no failures, and the surviving state must
+          digest-equal a fresh recovery of the committed journal (no
+          acked write lost, no stranded future);
+      (c) OVERHEAD: with the subsystem wired but idle (no plan), the
+          same workload must cost < 1% over a bare client — the
+          disabled `fire()` seam is one module-global read.
+    """
+    import random
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    rounds = 60 if _TINY else 240
+    rng = np.random.default_rng(13)
+    hll_batches = rng.integers(0, 2**63, size=(rounds, 32), dtype=np.uint64)
+
+    def make_cfg(persist_dir=None, plan=None, faults=False):
+        cfg = Config()
+        cfg.use_local()
+        sc = cfg.use_serve()
+        sc.retry_interval_ms = 5
+        if persist_dir is not None:
+            cfg.use_persist(persist_dir).fsync = "always"
+        if faults or plan:
+            fc = cfg.use_faults()
+            fc.plan = plan or []
+        return cfg
+
+    def run_workload(c, chaos=False):
+        """hll/bitset/bloom mix. chaos=False asserts every op acks and
+        returns the wall; chaos=True collects outcome names instead."""
+        h = c.get_hyper_log_log("cs:hll")
+        bits = c.get_bit_set("cs:bits")
+        bloom = c.get_bloom_filter("cs:bloom")
+        bloom.try_init(4096, 0.01)
+        outcomes = []
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            try:
+                h.add_ints(hll_batches[i])
+                bits.set(i % 997, True)
+                bloom.add(f"b{i}")
+                outcomes.append("ok")
+            except Exception as exc:  # noqa: BLE001 - chaos audit
+                if not chaos:
+                    raise
+                outcomes.append(type(exc).__name__)
+        wall = time.perf_counter() - t0
+        return wall, outcomes
+
+    ok = True
+    root = tempfile.mkdtemp(prefix="rtpu-chaos-smoke-")
+    try:
+        # -- (a) retry absorption: digest-identical to the oracle --------
+        oracle = RedissonTPU.create(make_cfg())
+        try:
+            run_workload(oracle)
+            want = _engine_digest(oracle)
+        finally:
+            oracle.shutdown()
+        plan_rng = random.Random(0xC405)
+        plan = [{"seam": plan_rng.choice(
+                    ("stage_h2d", "kernel_launch", "journal_fsync")),
+                 "fault": "retryable",
+                 "nth": plan_rng.randint(1, rounds),
+                 "times": plan_rng.randint(1, 2)} for _ in range(4)]
+        c = RedissonTPU.create(make_cfg(os.path.join(root, "retry"), plan))
+        try:
+            _, outcomes = run_workload(c, chaos=True)
+            acked = outcomes.count("ok")
+            injected = c.fault.injector.injected
+            retries = int(c.metrics.counter("serve.retries_total"))
+            same = _engine_digest(c) == want
+            print(f"# chaos-smoke[retry]: {acked}/{rounds} acked, "
+                  f"{injected} injected, {retries} retries, digest "
+                  f"{'identical' if same else 'MISMATCH'}")
+            if acked != rounds or not same:
+                ok = False
+        finally:
+            c.shutdown()
+
+        # -- (b) uncertain fault -> quarantine -> rebuild -> recovery ----
+        live_dir = os.path.join(root, "rebuild")
+        plan = [{"seam": "d2h_complete", "fault": "state_uncertain",
+                 "nth": rounds // 3},
+                {"seam": "d2h_complete", "fault": "device_lost",
+                 "nth": rounds // 2}]
+        c = RedissonTPU.create(make_cfg(live_dir, plan))
+        try:
+            _, outcomes = run_workload(c, chaos=True)
+            settled = c.fault.rebuild.wait_idle(timeout=60)
+            snap = c.fault.rebuild.snapshot()
+            c.persist.journal.sync()
+            live = _engine_digest(c)
+            print(f"# chaos-smoke[rebuild]: {outcomes.count('ok')}/{rounds} "
+                  f"acked, rebuilt {snap['rebuilt_total']} targets "
+                  f"({snap['replayed_total']} replayed, "
+                  f"{snap['last_rebuild_s'] * 1e3:.1f} ms), "
+                  f"failures={snap['rebuild_failures']}")
+            if not settled or snap["rebuild_failures"] or snap["degraded"]:
+                ok = False
+        finally:
+            c.shutdown()
+        r = RedissonTPU.create(_persist_cfg(live_dir))
+        try:
+            same = _engine_digest(r) == live
+            print(f"# chaos-smoke[rebuild]: recovered digest "
+                  f"{'identical' if same else 'MISMATCH'} to live survivor")
+            if not same:
+                ok = False
+        finally:
+            r.shutdown()
+
+        # -- (c) fault-free overhead ------------------------------------
+        def best_wall(cfg):
+            c = RedissonTPU.create(cfg)
+            try:
+                run_workload(c)  # warm compile/caches
+                c.flushall()
+                best = float("inf")
+                for _ in range(3 if _TINY else 2):
+                    best = min(best, run_workload(c)[0])
+                    c.flushall()
+                return best
+            finally:
+                c.shutdown()
+
+        bare = best_wall(make_cfg())
+        wired = best_wall(make_cfg(faults=True))
+        over = 100.0 * (wired / bare - 1.0)
+        print(f"# chaos-smoke[overhead]: {bare * 1e3:.1f} ms bare -> "
+              f"{wired * 1e3:.1f} ms wired-idle ({over:+.2f}%)")
+        if over >= 1.0:
+            print(f"#   fault-free overhead {over:.2f}% >= 1% budget",
+                  file=sys.stderr)
+            ok = False
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -1076,6 +1229,11 @@ def main():
                     help="fsync-policy sweep {none,off,everysec,always}: "
                          "journal overhead per policy + kill-and-recover "
                          "digest identity, then exit")
+    ap.add_argument("--chaos-smoke", action="store_true",
+                    help="seeded fault injection: retry absorption digest-"
+                         "identical to a fault-free oracle, uncertain-fault "
+                         "rebuild + recovery digest identity, and the <1% "
+                         "fault-free overhead gate, then exit")
     args = ap.parse_args()
 
     if args.serve_smoke:
@@ -1089,6 +1247,9 @@ def main():
 
     if args.persist_smoke:
         sys.exit(0 if persist_smoke() else 1)
+
+    if args.chaos_smoke:
+        sys.exit(0 if chaos_smoke() else 1)
 
     if args.lint_smoke:
         from tools.graftlint import run_lint

@@ -22,6 +22,8 @@ import numpy as np
 
 from redisson_tpu import engine
 from redisson_tpu.executor import Op
+from redisson_tpu.fault import inject as fault_inject
+from redisson_tpu.fault.taxonomy import classify
 from redisson_tpu.ingest import delta as delta_mod
 from redisson_tpu.ingest.pipeline import StagingPipeline
 from redisson_tpu.ingest.planner import IngestPlanner, default_planner
@@ -66,7 +68,8 @@ class Completer:
                 return
             try:
                 fn()
-            except Exception:  # fn is responsible for its futures
+            except Exception:
+                # graftlint: allow-bare(completion closures own their futures and classify internally; an escape here means the futures are already resolved — re-raising would only kill the completer thread)
                 pass
             finally:
                 self._q.task_done()
@@ -125,6 +128,7 @@ def _start_d2h(x):
         try:
             start()
         except Exception:  # pragma: no cover — committed arrays only
+            # graftlint: allow-bare(best-effort copy kickoff: the completer's materialization retries the same readback and classifies its failure)
             pass
     return x
 
@@ -134,8 +138,16 @@ def _complete_all(ops: List[Op], materialize: Callable[[], object]) -> Callable:
 
     def run():
         try:
+            fault_inject.fire("d2h_complete",
+                              kind=ops[0].kind if ops else "",
+                              target=ops[0].target if ops else "")
             value = materialize()
         except Exception as exc:  # noqa: BLE001 — device errors surface here
+            # Post-dispatch failure: the device run already launched, so a
+            # transient error here means the commit state is unknown —
+            # classify maps it to StateUncertainFault and the executor's
+            # fault listener routes the targets to the rebuild path.
+            exc = classify(exc, seam="d2h_complete")
             for op in ops:
                 if not op.future.done():
                     op.future.set_exception(exc)
@@ -160,8 +172,12 @@ def complete_changed_rows(completer: "Completer", ops: List[Op],
 
     def run():
         try:
+            fault_inject.fire("d2h_complete",
+                              kind=ops[0].kind if ops else "",
+                              target=ops[0].target if ops else "")
             host = None if flag is None else np.asarray(flag)
         except Exception as exc:  # noqa: BLE001
+            exc = classify(exc, seam="d2h_complete")
             for op in ops:
                 if not op.future.done():
                     op.future.set_exception(exc)
@@ -734,6 +750,7 @@ class TpuBackend:
             try:
                 self._op_hll_add(hll_ops[0].target, hll_ops)
             except Exception as exc:  # noqa: BLE001 — never strand futures
+                exc = classify(exc, seam="kernel_launch")
                 for op in hll_ops:
                     if not op.future.done():
                         op.future.set_exception(exc)
@@ -745,6 +762,7 @@ class TpuBackend:
             try:
                 getattr(self, "_op_" + kind)(tname, tops)
             except Exception as exc:  # noqa: BLE001 — per-target isolation
+                exc = classify(exc, seam="kernel_launch")
                 for op in tops:
                     if not op.future.done():
                         op.future.set_exception(exc)
@@ -759,6 +777,8 @@ class TpuBackend:
             try:
                 plane, spec = self._delta_fold_group(tname, kind, tops)
             except Exception as exc:  # noqa: BLE001 — per-target isolation
+                # Host fold failure: nothing reached the device — retryable.
+                exc = classify(exc, seam="stage_h2d")
                 for op in tops:
                     if not op.future.done():
                         op.future.set_exception(exc)
@@ -795,6 +815,7 @@ class TpuBackend:
                 self._delta_merge_chunk([planes[i] for i in chunk],
                                         [specs[i] for i in chunk])
             except Exception as exc:  # noqa: BLE001
+                exc = classify(exc, seam="kernel_launch")
                 for i in chunk:
                     for op in specs[i]["ops"]:
                         if not op.future.done():
@@ -938,11 +959,14 @@ class TpuBackend:
 
         def run():
             try:
+                fault_inject.fire("d2h_complete", kind="delta",
+                                  target=planes[0].target if planes else "")
                 host_changed = np.asarray(flag)
                 host_old = {i: np.asarray(spec["old_packed"])
                             for i, p, spec in chunk_specs
                             if p.kind == "bitset_set"}
             except Exception as exc:  # noqa: BLE001
+                exc = classify(exc, seam="d2h_complete")
                 for _i, _p, spec in chunk_specs:
                     for op in spec["ops"]:
                         if not op.future.done():
@@ -1159,8 +1183,12 @@ class TpuBackend:
 
         def run():
             try:
+                fault_inject.fire("d2h_complete",
+                                  kind=ops[0].kind if ops else "",
+                                  target=ops[0].target if ops else "")
                 host = np.asarray(flag)
             except Exception as exc:  # noqa: BLE001
+                exc = classify(exc, seam="d2h_complete")
                 for op in ops:
                     if not op.future.done():
                         op.future.set_exception(exc)
@@ -1517,11 +1545,15 @@ class TpuBackend:
 
         def run():
             try:
+                fault_inject.fire("d2h_complete",
+                                  kind=ops[0].kind if ops else "",
+                                  target=ops[0].target if ops else "")
                 parts = [np.asarray(o)[:n] for o, n in zip(outs, spans)]
                 flat = np.concatenate(parts) if parts else np.zeros((0,), np.uint8)
                 if post is not None:
                     flat = post(flat)
             except Exception as exc:  # noqa: BLE001
+                exc = classify(exc, seam="d2h_complete")
                 for op in ops:
                     if not op.future.done():
                         op.future.set_exception(exc)

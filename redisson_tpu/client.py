@@ -133,6 +133,21 @@ class RedissonTPU:
             except Exception:
                 self.shutdown()
                 raise
+        # Fault subsystem (fault/): taxonomy is always active (the backends
+        # classify unconditionally); injection / watchdog / self-healing
+        # rebuild only attach when Config.use_faults() was called. Wired
+        # after persist so the rebuild path sees a recovered journal.
+        self._fault = None
+        fcfg = self.config.faults
+        if fcfg is not None:
+            from redisson_tpu.fault import FaultManager
+
+            self._fault = FaultManager(self, fcfg)
+            try:
+                self._fault.start()
+            except Exception:
+                self.shutdown()
+                raise
         if self.config.redis is not None and mode != "redis":
             try:
                 self._connect_durability()
@@ -391,6 +406,11 @@ class RedissonTPU:
     def persist(self):
         """The PersistenceManager when Config.persist is set, else None."""
         return getattr(self, "_persist", None)
+
+    @property
+    def fault(self):
+        """The FaultManager when Config.faults is set, else None."""
+        return getattr(self, "_fault", None)
 
     def snapshot_now(self) -> str:
         """On-demand persistent snapshot (BGSAVE analogue): cuts through
@@ -787,6 +807,15 @@ class RedissonTPU:
             self._is_shutdown = True
 
     def _shutdown_inner(self):
+        if getattr(self, "_fault", None) is not None:
+            # First: stop the watchdog (it reads executor internals) and
+            # wait out in-flight rebuilds while the executor still accepts
+            # the replay traffic they submit.
+            try:
+                self._fault.stop()
+            except Exception:
+                pass
+            self._fault = None
         if getattr(self, "_persist", None) is not None:
             # Phase 1: stop the snapshotter before the executor drains (a
             # barrier cut submitted after shutdown would never dispatch);
@@ -832,8 +861,8 @@ class RedissonTPU:
         if self._watchdog is not None:
             self._watchdog.shutdown()
         if getattr(self, "serve", None) is not None:
-            # Closes the retry timer first (pending retries resolve through
-            # the executor's drain-then-reject), then the executor itself.
+            # Closes the retry timer first (pending retries resolve their
+            # outer futures with CancelledError), then the executor itself.
             self.serve.shutdown()
         else:
             self._executor.shutdown()

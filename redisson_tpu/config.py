@@ -188,6 +188,29 @@ class PersistConfig:
 
 
 @dataclass
+class FaultConfig:
+    """Fault subsystem (redisson_tpu/fault/): classification is always on
+    (the classify boundary has no knob — raw device errors never reach
+    futures); this section controls injection, the run watchdog, and the
+    self-healing rebuild path."""
+
+    # Declarative injection schedule: list of FaultRule dicts
+    # ({"seam": ..., "fault": ..., "nth": ..., "times": ..., "kind": ...,
+    # "target": ...}) — empty = no injection (production default).
+    plan: List[Dict[str, Any]] = field(default_factory=list)
+    seed: int = 0  # documents how a random plan was generated
+    # Run watchdog over the executor's in-flight window.
+    watchdog: bool = False
+    watchdog_margin: float = 8.0  # x the cost model's EWMA estimate
+    watchdog_floor_s: float = 2.0  # never trip faster than this
+    watchdog_poll_s: float = 0.05
+    # Self-healing HBM rebuild on StateUncertain/DeviceLost retirement.
+    # Needs Config.persist for host truth; without it, faulted targets
+    # degrade to read-only immediately.
+    rebuild: bool = True
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -197,6 +220,8 @@ class Config:
     serve: Optional[ServeConfig] = None
     # Durability subsystem (None = no journal/snapshots, the seed behavior).
     persist: Optional[PersistConfig] = None
+    # Fault subsystem (None = classify-only; no injection/watchdog/rebuild).
+    faults: Optional[FaultConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -249,6 +274,10 @@ class Config:
             self.persist.dir = dir
         return self.persist
 
+    def use_faults(self) -> "FaultConfig":
+        self.faults = self.faults or FaultConfig()
+        return self.faults
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -280,6 +309,7 @@ class Config:
             "redis": RedisConfig,
             "serve": ServeConfig,
             "persist": PersistConfig,
+            "faults": FaultConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

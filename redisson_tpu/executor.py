@@ -57,6 +57,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from redisson_tpu.fault import inject as fault_inject
+from redisson_tpu.fault.taxonomy import StateUncertainFault, classify
 from redisson_tpu.serve.errors import DeadlineExceeded
 
 # Op kinds that may coalesce with the previous op of the same kind+target.
@@ -126,7 +128,8 @@ class _InflightRun:
 
     __slots__ = ("kind", "target", "targets", "is_global", "nops", "nkeys",
                  "t0", "queue_delay_s", "stage_s", "pending", "failed",
-                 "op_failed", "overlapped", "depth", "gates_held", "lock")
+                 "op_failed", "overlapped", "depth", "gates_held", "lock",
+                 "ops", "fault_exc")
 
     def __init__(self, kind: str, target: str, targets: frozenset,
                  is_global: bool):
@@ -146,6 +149,8 @@ class _InflightRun:
         self.depth = 1
         self.gates_held = True
         self.lock = threading.Lock()
+        self.ops: Sequence[Op] = ()  # live ops (watchdog trip / diagnostics)
+        self.fault_exc = None  # first StateUncertainFault among the ops
 
 
 class CommandExecutor:
@@ -195,6 +200,13 @@ class CommandExecutor:
         self._queues: Dict[str, deque] = {}
         self._ready: deque = deque()  # round-robin of object names with work
         self._shutdown = False
+        # Fault subsystem hooks (fault/manager.py installs; None = off):
+        # fault_guard(kind, target) -> Optional[Exception] runs at enqueue
+        # time (quarantine/degraded write rejection); fault_listener(kind,
+        # targets, fault) fires when a run retires with StateUncertainFault
+        # (the rebuild coordinator's trigger).
+        self.fault_guard = None
+        self.fault_listener = None
         self._thread = threading.Thread(
             target=self._loop, name="redisson-tpu-dispatcher", daemon=True
         )
@@ -261,6 +273,14 @@ class CommandExecutor:
             # `MasterSlaveConnectionManager.java:651-662`).
             op.future.set_exception(RuntimeError("executor is shut down"))
             return
+        guard = self.fault_guard
+        if guard is not None:
+            # Quarantined/degraded target rejection (set lookups only —
+            # the guard must stay cheap under the executor lock).
+            exc = guard(op.kind, op.target)
+            if exc is not None:
+                op.future.set_exception(exc)
+                return
         q = self._queues.get(op.target)
         if q is None:
             q = self._queues[op.target] = deque()
@@ -468,11 +488,15 @@ class CommandExecutor:
                 try:
                     op.future.set_result(op.payload())
                 except Exception as exc:
-                    op.future.set_exception(exc)
+                    # Barrier callables (snapshot cuts, state swaps) can
+                    # fail on device/IO errors too — classify so the fault
+                    # counters and any retry wrapper see a decision.
+                    op.future.set_exception(classify(exc, seam="snapshot_io"))
             self._retire(token, completed=False)
             return
         token.nops = len(live)
         token.nkeys = sum(op.nkeys for op in live)
+        token.ops = live
         t0 = token.t0 = self._clock()
         token.queue_delay_s = t0 - min(op.enqueued_at for op in live)
         token.pending = len(live)
@@ -500,7 +524,10 @@ class CommandExecutor:
             except Exception as exc:
                 # A journal that cannot accept the record must fail the
                 # ops — applying an unjournaled mutation would silently
-                # break the recovery contract.
+                # break the recovery contract. Nothing has committed yet,
+                # so classification lands on the retryable side and the
+                # serve layer re-dispatches after backoff.
+                exc = classify(exc, seam="journal_fsync")
                 token.failed = True
                 if m:
                     m.record_error(kind)
@@ -509,6 +536,7 @@ class CommandExecutor:
                         op.future.set_exception(exc)
                 return
         try:
+            fault_inject.fire("kernel_launch", kind=kind, target=target)
             self._backend.run(kind, target, live)
             token.stage_s = self._clock() - t0
             od = getattr(self._policy, "observe_dispatch", None)
@@ -523,6 +551,12 @@ class CommandExecutor:
                 # still bounds depth.
                 self._release_gates(token)
         except Exception as exc:  # complete, never kill the loop
+            # The staging boundary: H2D copies, jit dispatch, and the
+            # injected kernel_launch seam all surface here. classify()
+            # decides whether the serve layer may re-dispatch (RetryableFault
+            # — nothing committed) or the rebuild path must re-materialize
+            # (StateUncertainFault, noted by _op_done below).
+            exc = classify(exc, seam="kernel_launch")
             token.failed = True
             token.stage_s = self._clock() - t0
             if m:
@@ -548,6 +582,13 @@ class CommandExecutor:
             # window) completes futures with exceptions instead of raising
             # out of run() — the error metric must still see the run.
             token.op_failed = True
+            exc = fut.exception()
+            if token.fault_exc is None and isinstance(exc, StateUncertainFault):
+                # State-uncertain retirement (device loss, watchdog trip,
+                # post-dispatch transfer death): remember the first such
+                # fault so _run_completed can hand the run's targets to
+                # the rebuild listener.
+                token.fault_exc = exc
         with token.lock:
             token.pending -= 1
             if token.pending > 0:
@@ -574,6 +615,13 @@ class CommandExecutor:
                     cap=self._max_batch_keys,
                     stage_s=token.stage_s)
         self._retire(token, completed=True)
+        listener = self.fault_listener
+        if listener is not None and token.fault_exc is not None:
+            try:
+                listener(token.kind, token.targets, token.fault_exc)
+            except Exception:
+                # graftlint: allow-bare(the rebuild listener is best-effort; a listener bug must not poison the completion path that just resolved the futures)
+                pass
 
     def _release_gates_locked(self, token: _InflightRun) -> None:
         if not token.gates_held:
@@ -614,6 +662,50 @@ class CommandExecutor:
                 "runs_overlapped": self._runs_overlapped,
                 "overlap_ratio": (self._runs_overlapped / done) if done else 0.0,
             }
+
+    # -- fault-subsystem surface -------------------------------------------
+
+    def fail_inflight(self, token: _InflightRun, exc: BaseException) -> int:
+        """Resolve a stuck run's still-pending futures with `exc` (the
+        watchdog's trip action). Completion flows through the normal
+        done-callback path, so the run retires and its gates release; a
+        late device completion finds the futures done and is dropped by
+        the backend's `future.done()` guards. Returns how many futures
+        this call resolved."""
+        failed = 0
+        for op in token.ops:
+            if op.future.done():
+                continue
+            try:
+                op.future.set_exception(exc)
+                failed += 1
+            except Exception:
+                # graftlint: allow-bare(InvalidStateError race: the completer resolved this future between the done() check and here — exactly the outcome we wanted)
+                pass
+        return failed
+
+    def sweep_queued(self, targets, exc_factory) -> int:
+        """Complete every QUEUED (undispatched) op for `targets` with
+        `exc_factory(op)` — the rebuild path cancels dependents of a
+        quarantined target this way (they were never dispatched, so a
+        retryable rejection is safe and the serve layer re-lands them
+        after the rebuild). Returns the number of swept ops."""
+        targets = set(targets)
+        with self._cv:
+            swept: List[Op] = []
+            for t in targets:
+                q = self._queues.get(t)
+                if not q:
+                    continue
+                swept.extend(q)
+                q.clear()
+                del self._queues[t]
+                if t in self._ready:
+                    self._ready.remove(t)
+        for op in swept:
+            if not op.future.done():
+                op.future.set_exception(exc_factory(op))
+        return len(swept)
 
     def _cancel_remaining(self) -> None:
         """Drain every queue and cancel the stranded ops' futures, so

@@ -1,0 +1,51 @@
+"""Fault subsystem: error taxonomy, seeded injection, run watchdog, and
+self-healing HBM rebuild.
+
+The reference survives connection loss and node failure through
+`ConnectionWatchdog` reconnect, `CommandAsyncService` retryAttempts /
+retryInterval, and master/slave failover (`failedSlaveCheckInterval`). The
+TPU-native analogue of "the connection died" is "the device run died" —
+a failed staging transfer, a kernel launch error, a wedged run, or lost
+HBM state. This package closes that loop:
+
+  * taxonomy.py — the classification boundary (`RetryableFault`,
+    `StateUncertainFault`, `DeviceLostFault`, `FatalFault`) and
+    `classify()`, which maps raw JAX/XLA/IO exceptions into it at every
+    seam that completes futures. `RetryableFault` subclasses the serve
+    layer's `RetryableError`, so the PR 3 retry/breaker machinery fires
+    on genuine device faults with no serve-side changes;
+  * inject.py — deterministic seeded fault injection at named seams
+    (`FaultPlan` -> `FaultInjector`; `fire()` is a no-op costing one
+    global read when no injector is installed);
+  * watchdog.py — per-run deadlines over the PR 4 in-flight window
+    (cost-model EWMA x margin); a stuck run trips `StateUncertainFault`;
+  * rebuild.py — quarantine + re-materialize lost HBM planes from host
+    truth (newest snapshot + journal-suffix replay), or degrade targets
+    to read-only when rebuild is impossible.
+
+`FaultManager` (manager.py) wires all four into a client from
+`Config.use_faults()`.
+"""
+
+from redisson_tpu.fault.taxonomy import (  # noqa: F401
+    DeviceLostFault,
+    Fault,
+    FatalFault,
+    RetryableFault,
+    StateUncertainFault,
+    TargetDegradedError,
+    TargetQuarantinedError,
+    classify,
+)
+from redisson_tpu.fault.inject import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+    fire,
+    install,
+    installed,
+    uninstall,
+)
+from redisson_tpu.fault.watchdog import RunWatchdog  # noqa: F401
+from redisson_tpu.fault.rebuild import RebuildCoordinator  # noqa: F401
+from redisson_tpu.fault.manager import FaultManager  # noqa: F401

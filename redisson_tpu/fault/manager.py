@@ -1,0 +1,86 @@
+"""FaultManager: wires the fault subsystem into one client.
+
+Config.use_faults() -> client.__init__ constructs a FaultManager after
+the executor, serving layer and persistence are up (the rebuild path
+needs all three), and tears it down first in shutdown (the watchdog and
+rebuild threads must stop before the executor they poll does).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from redisson_tpu.fault import inject, taxonomy
+from redisson_tpu.fault.inject import FaultInjector, FaultPlan
+from redisson_tpu.fault.rebuild import RebuildCoordinator
+from redisson_tpu.fault.watchdog import RunWatchdog
+
+
+class FaultManager:
+    def __init__(self, client, cfg):
+        self._client = client
+        self.cfg = cfg
+        self.injector: Optional[FaultInjector] = None
+        self.watchdog: Optional[RunWatchdog] = None
+        self.rebuild: Optional[RebuildCoordinator] = None
+        self._started = False
+
+    def start(self) -> None:
+        client = self._client
+        cfg = self.cfg
+        executor = client._executor
+        serve = getattr(client, "serve", None)
+        breakers = getattr(serve, "_breakers", None) if serve else None
+        if cfg.plan:
+            self.injector = FaultInjector(
+                FaultPlan.from_dicts(cfg.plan, seed=cfg.seed))
+            inject.install(self.injector)
+        if cfg.rebuild:
+            self.rebuild = RebuildCoordinator(client, breakers=breakers)
+            executor.fault_guard = self.rebuild.guard
+            executor.fault_listener = self.rebuild.on_fault
+        if cfg.watchdog:
+            cost_model = getattr(serve, "cost_model", None) if serve else None
+            estimate = cost_model.estimate if cost_model is not None else None
+            self.watchdog = RunWatchdog(
+                executor,
+                estimate=estimate,
+                margin=cfg.watchdog_margin,
+                floor_s=cfg.watchdog_floor_s,
+                poll_s=cfg.watchdog_poll_s,
+                breakers=breakers,
+                on_trip=self.rebuild.on_fault if self.rebuild else None,
+            )
+            self.watchdog.start()
+        from redisson_tpu.observability import register_fault
+
+        register_fault(client.metrics, self)
+        self._started = True
+
+    def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        executor = getattr(self._client, "_executor", None)
+        if executor is not None:
+            executor.fault_listener = None
+        if self.rebuild is not None:
+            self.rebuild.close()
+        # Leave fault_guard installed until after close(): a rebuild that
+        # raced shutdown keeps its degraded/quarantine semantics to the end.
+        if executor is not None:
+            executor.fault_guard = None
+        if self.injector is not None and inject.installed() is self.injector:
+            inject.uninstall()
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"taxonomy": taxonomy.stats()}
+        if self.injector is not None:
+            out["injector"] = self.injector.snapshot()
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.snapshot()
+        if self.rebuild is not None:
+            out["rebuild"] = self.rebuild.snapshot()
+        return out

@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
+from redisson_tpu.fault import inject as fault_inject
+
 TraceEvent = Tuple[str, int, float]
 
 _STOP = object()
@@ -65,6 +67,12 @@ class StagingPipeline:
                     if stop.is_set():
                         return
                     self._mark("stage_start", i)
+                    # Fault seam: an injected (or real) H2D failure raises
+                    # out of the worker and re-raises on the caller's
+                    # thread below — i.e. inside the dispatcher's staging
+                    # try, where fault.classify maps it to RetryableFault
+                    # (nothing committed yet).
+                    fault_inject.fire("stage_h2d")
                     staged = stage(chunk)
                     self._mark("stage_end", i)
                     q.put((i, staged))

@@ -386,6 +386,43 @@ def register_persist(registry: MetricsRegistry, manager) -> None:
         lambda: (manager.last_recovery or {}).get("replayed", 0))
 
 
+def register_fault(registry: MetricsRegistry, manager) -> None:
+    """Expose the fault subsystem (fault/) as fault.* gauges: injection
+    volume, classification outcomes, retry pressure attributable to
+    device faults, and the rebuild loop's progress. `manager` is a
+    fault.manager.FaultManager; its injector/watchdog/rebuild members may
+    each be None (gauges then read 0)."""
+    from redisson_tpu.fault import taxonomy
+
+    registry.gauge(
+        "fault.injected",
+        lambda: manager.injector.injected if manager.injector else 0)
+    registry.gauge(
+        "fault.classified", lambda: taxonomy.stats()["classified"])
+    # Serve-layer retries fire on RetryableError, whose device-fault
+    # subclass is RetryableFault — the retry counter is the observable
+    # "faults the retry machinery absorbed" signal.
+    registry.gauge(
+        "fault.retried", lambda: registry.counter("serve.retries_total"))
+    registry.gauge(
+        "fault.rebuilt",
+        lambda: manager.rebuild.rebuilt_total if manager.rebuild else 0)
+    registry.gauge(
+        "fault.quarantined",
+        lambda: (manager.rebuild.quarantined_total
+                 if manager.rebuild else 0))
+    registry.gauge(
+        "fault.degraded",
+        lambda: (len(manager.rebuild.snapshot()["degraded"])
+                 if manager.rebuild else 0))
+    registry.gauge(
+        "fault.rebuild_s",
+        lambda: manager.rebuild.last_rebuild_s if manager.rebuild else 0.0)
+    registry.gauge(
+        "fault.watchdog_trips",
+        lambda: manager.watchdog.trips if manager.watchdog else 0)
+
+
 def register_follower(registry: MetricsRegistry, follower) -> None:
     """Bounded-lag gauge for a warm standby (persist/follower.py)."""
     registry.gauge("persist.follower_lag", follower.lag)

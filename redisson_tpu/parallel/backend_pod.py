@@ -28,6 +28,7 @@ from redisson_tpu.backend_tpu import (
 )
 from redisson_tpu.store import ObjectType, WrongTypeError
 from redisson_tpu.executor import Op
+from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.ingest.pipeline import StagingPipeline
 from redisson_tpu.ops import bloom as bloom_ops
 from redisson_tpu.ops import bloom_math
@@ -175,9 +176,21 @@ class PodBackend:
     def run(self, kind: str, target: str, ops: List[Op]) -> None:
         handler = getattr(self, "_op_" + kind, None)
         if handler is not None:
+            # Fault seam: mesh-sharded dispatch (bank insert/merge, sharded
+            # bits). Raises out of run() into the executor's staging try,
+            # which classifies; kinds served by the single-chip delegate
+            # keep its own seams instead.
+            fault_inject.fire("mesh_collective", kind=kind, target=target)
             handler(target, ops)
             return
         self._delegate.run(kind, target, ops)
+
+    def notify_restored(self, name: str) -> None:
+        """Checkpoint/rebuild restore hook: forward to the delegate so its
+        bloom mirrors and epoch-stamped read cache drop state the restore
+        swapped in under them (bank rows carry no host mirrors — the
+        import path bumps their versions itself)."""
+        self._delegate.notify_restored(name)
 
     def handles(self, kind: str) -> bool:
         """Op kinds served here or by the single-chip delegate (the

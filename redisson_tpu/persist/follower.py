@@ -123,6 +123,7 @@ class JournalFollower:
                 try:
                     fut.result(timeout=120)
                 except Exception:
+                    # graftlint: allow-bare(standby replay mirrors recover.py: a record may fail exactly as it failed live; counted in apply_errors, never kills the follower)
                     self._apply_errors += 1
             futures.clear()
 

@@ -43,6 +43,7 @@ from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tu
 from zlib import crc32
 
 from redisson_tpu.commands import OP_TABLE
+from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.persist.codec import decode_payload, encode_payload
 
 MAGIC = b"RTPUWAL1"
@@ -379,6 +380,15 @@ class Journal:
         except ValueError:
             pass
 
+    @property
+    def last_seq(self) -> int:
+        """Highest sequence number appended so far (0 = empty journal).
+        The rebuild path snapshots this to bound its suffix replay: records
+        it appends itself (zeroing deletes, re-journaled replays) get
+        higher seqs and must not feed back into the same replay."""
+        with self._io:
+            return self._last_seq
+
     # -- durability ---------------------------------------------------------
 
     def sync(self) -> None:
@@ -386,6 +396,10 @@ class Journal:
         with self._io:
             if not self._dirty or self._closed:
                 return
+            # Fault seam: a failed fsync propagates to the caller — the
+            # executor's journal-append path classifies it RetryableFault
+            # (write-ahead: no state committed for the unsynced records).
+            fault_inject.fire("journal_fsync")
             self._f.flush()
             os.fsync(self._f.fileno())
             self._fsyncs += 1
@@ -413,7 +427,11 @@ class Journal:
                     return
                 if self._dirty:
                     time.sleep(linger)
-                    self.sync()
+                    try:
+                        self.sync()
+                    except Exception:
+                        # graftlint: allow-bare(background backstop fsync: a failure here retries next wake, and the inline group-commit path surfaces the same error through the executor's classify boundary)
+                        pass
                 continue
             self._wake.wait(self._interval_s)
             self._wake.clear()
@@ -422,7 +440,11 @@ class Journal:
             if self._fsync == "off":
                 self._flush_only()
             elif self._dirty:
-                self.sync()
+                try:
+                    self.sync()
+                except Exception:
+                    # graftlint: allow-bare(everysec fsync failure: durability lag grows one period and the next tick retries; killing the sync thread would silently stop fsyncs forever)
+                    pass
 
     # -- rotation / truncation (snapshotter) --------------------------------
 

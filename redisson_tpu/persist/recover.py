@@ -76,9 +76,7 @@ def recover(client, path: str, replay_window: int = REPLAY_WINDOW) -> Dict[str, 
             try:
                 fut.result(timeout=120)
             except Exception:
-                # A journaled op may fail on replay exactly like it failed
-                # live (write-ahead ordering journals the attempt, e.g. a
-                # WRONGTYPE probe) — count it, keep going.
+                # graftlint: allow-bare(a journaled op may fail on replay exactly like it failed live — write-ahead ordering journals the attempt, e.g. a WRONGTYPE probe; counted, kept going)
                 failed += 1
         return failed
 

@@ -28,6 +28,8 @@ import numpy as np
 
 from redisson_tpu import checkpoint
 from redisson_tpu.executor import Op
+from redisson_tpu.fault import inject as fault_inject
+from redisson_tpu.fault.taxonomy import classify
 
 SNAPSHOT_PREFIX = "snap-"
 STRUCTURES_FILE = "structures.bin"
@@ -99,6 +101,7 @@ class Snapshotter:
             try:
                 self.snapshot_now()
             except Exception as exc:  # keep the period alive; surface via stats
+                exc = classify(exc, seam="snapshot_io")
                 self.last_error = f"{type(exc).__name__}: {exc}"
 
     # -- the snapshot itself ------------------------------------------------
@@ -165,6 +168,9 @@ class Snapshotter:
         snapshot is durable and superseded journal segments are deleted."""
         with self._lock:
             t0 = time.monotonic()
+            # Fault seam: snapshot I/O failures are pre-commit for callers
+            # (the previous snapshot + journal remain authoritative).
+            fault_inject.fire("snapshot_io")
             fut = self._client._executor.execute_barrier(self._cut)
             seq, objs, blob = fut.result(timeout=self._cut_timeout_s)
             # Off the dispatcher now: materialize host copies and write.

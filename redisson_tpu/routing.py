@@ -109,9 +109,17 @@ class RoutingBackend:
                 except Exception as exc:  # noqa: BLE001
                     op.future.set_exception(exc)
             else:
-                self._sketch_side("delete", new)
-                # graftlint: allow-journal(same fan-out: the journaled rename op is forwarded to the structures tier below the commit point)
-                self.structures.run("rename", target, [op])
+                try:
+                    self._sketch_side("delete", new)
+                    # graftlint: allow-journal(same fan-out: the journaled rename op is forwarded to the structures tier below the commit point)
+                    self.structures.run("rename", target, [op])
+                except Exception as exc:  # noqa: BLE001
+                    # Mirror the sketch branch: a raising tier must not
+                    # strand the caller's future (the executor only fails
+                    # futures for exceptions that escape backend.run, and
+                    # an earlier op in this batch may already be resolved).
+                    if not op.future.done():
+                        op.future.set_exception(exc)
 
     def _both_keys(self, target: str, ops: List[Op]) -> None:
         """KEYS across both tiers, serialized on the dispatcher thread."""

@@ -108,6 +108,30 @@ class CircuitBreaker:
             if self._state == HALF_OPEN and self._probes_in_flight > 0:
                 self._probes_in_flight -= 1
 
+    def force_open(self, now: float = None) -> None:
+        """Open the circuit immediately, bypassing the failure counter.
+
+        Used by the fault subsystem: a watchdog trip or a quarantined
+        target means the backend is known-sick for this kind — waiting
+        for `failure_threshold` more casualties would just create them.
+        """
+        now = self._now(now)
+        with self._lock:
+            if self._state != OPEN:
+                self._state = OPEN
+                self._opens_total += 1
+            self._opened_at = now
+
+    def force_close(self) -> None:
+        """Close the circuit immediately (e.g. after a successful HBM
+        rebuild): the backend was repaired out-of-band, so the normal
+        half-open probe dance would only delay recovery."""
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive_failures = 0
+            self._probes_in_flight = 0
+            self._probes_succeeded = 0
+
     def on_success(self, now: float = None) -> None:
         with self._lock:
             self._consecutive_failures = 0

@@ -44,24 +44,28 @@ from redisson_tpu.serve.policy import CostModel
 
 class _Timer:
     """Minimal timer wheel for retry backoff: one daemon thread, a heap of
-    (when, seq, fn). `close()` fires everything still pending immediately —
-    a dropped retry would strand its caller's outer future forever."""
+    (when, seq, fn, cancel). `close()` runs each pending entry's `cancel`
+    callback — a dropped retry would strand its caller's outer future
+    forever, and *firing* fn at shutdown would resubmit into an executor
+    that is already rejecting, turning a clean cancel into a raced error."""
 
     def __init__(self):
         self._cv = threading.Condition()
-        self._heap: List[Tuple[float, int, Callable[[], None]]] = []
+        self._heap: List[Tuple[float, int, Callable[[], None],
+                               Optional[Callable[[], None]]]] = []
         self._seq = itertools.count()
         self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="redisson-tpu-serve-timer", daemon=True)
         self._thread.start()
 
-    def call_later(self, delay_s: float, fn: Callable[[], None]) -> bool:
+    def call_later(self, delay_s: float, fn: Callable[[], None],
+                   cancel: Optional[Callable[[], None]] = None) -> bool:
         when = time.monotonic() + max(0.0, delay_s)
         with self._cv:
             if self._closed:
                 return False
-            heapq.heappush(self._heap, (when, next(self._seq), fn))
+            heapq.heappush(self._heap, (when, next(self._seq), fn, cancel))
             self._cv.notify()
         return True
 
@@ -78,7 +82,7 @@ class _Timer:
                     self._cv.wait(wait)
                 if self._closed:
                     return
-                _, _, fn = heapq.heappop(self._heap)
+                _, _, fn, _ = heapq.heappop(self._heap)
             try:
                 fn()
             except Exception:
@@ -87,12 +91,15 @@ class _Timer:
     def close(self) -> None:
         with self._cv:
             self._closed = True
-            pending = [fn for _, _, fn in self._heap]
+            pending = [(fn, cancel) for _, _, fn, cancel in self._heap]
             self._heap.clear()
             self._cv.notify_all()
-        for fn in pending:  # fire now: the resubmission resolves the outer
+        for fn, cancel in pending:
+            # Cancel resolves the outer with CancelledError right here;
+            # entries without a cancel hook fall back to firing fn so no
+            # caller is ever stranded.
             try:
-                fn()
+                (cancel or fn)()
             except Exception:
                 pass
 
@@ -267,8 +274,9 @@ class ServingLayer:
         return self._executor.queue_depth()
 
     def shutdown(self, wait: bool = True, timeout: float = 30.0) -> None:
-        # Timer first: pending retries fire now, resubmit, and get the
-        # executor's drain-then-reject semantics instead of hanging.
+        # Timer first: pending retries resolve their outer futures with
+        # CancelledError now instead of resubmitting into an executor
+        # that is about to reject everything.
         self._timer.close()
         self._executor.shutdown(wait=wait, timeout=timeout)
 
@@ -345,9 +353,17 @@ class ServingLayer:
                                  tenant, deadline, attempt + 1,
                                  charge_tokens=False)
 
-                if self._timer.call_later(delay, _resubmit):
+                def _cancel_outer() -> None:
+                    # Shutdown reached the wheel before this retry fired:
+                    # the op is abandoned, same contract as the executor's
+                    # cancellation sweep for queued ops.
+                    if not outer.done() and outer.cancel():
+                        outer.set_running_or_notify_cancel()
+
+                if self._timer.call_later(delay, _resubmit,
+                                          cancel=_cancel_outer):
                     return
-                _resubmit()  # timer closed (shutdown): resubmit inline
+                _cancel_outer()  # timer already closed (shutdown)
                 return
         if isinstance(exc, RetryableError):
             self._registry.inc("serve.retry_exhausted_total")

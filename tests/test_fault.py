@@ -1,0 +1,714 @@
+"""fault/ — taxonomy, seeded injection, watchdog, self-healing rebuild.
+
+Layers:
+
+1. classify() unit tests — the raw-exception -> taxonomy mapping, seam
+   sensitivity (pre-commit retryable vs post-dispatch uncertain), and
+   semantic-error passthrough.
+2. Injection units — FaultRule/FaultPlan determinism, nth/times firing,
+   kind/target matching, disabled-path no-op.
+3. Seam integration — each fire() site through the real client: serve
+   retries absorb pre-commit faults bit-identically; d2h faults trigger
+   quarantine + HBM rebuild; snapshot_io and stage_h2d surface typed.
+4. Watchdog — deadline math and a real wedged-run trip through the
+   executor (gates release, futures complete, breaker opens).
+5. Rebuild — end-to-end self-heal from snapshot+journal, zero-lost-rows
+   for acked writes, degraded-write rejection when rebuild is impossible.
+6. The chaos property — randomized seeded FaultPlans over an
+   hll/bloom/bitset workload: every future completes, and the surviving
+   state is bit-identical to the fault-free oracle (retryable plans) or
+   to a fresh recovery of the committed journal (uncertain plans).
+7. PR-8 satellites — serve timer shutdown cancels pending retries'
+   outers; routing rename structures-branch failure resolves the future;
+   executor shutdown sweeps staged-but-undispatched ops.
+"""
+
+import threading
+import time
+from concurrent.futures import CancelledError
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config, FaultConfig
+from redisson_tpu.executor import CommandExecutor
+from redisson_tpu.fault import inject, taxonomy
+from redisson_tpu.fault.inject import FaultInjector, FaultPlan, FaultRule
+from redisson_tpu.fault.taxonomy import (
+    DeviceLostFault,
+    Fault,
+    FatalFault,
+    RetryableFault,
+    StateUncertainFault,
+    TargetDegradedError,
+    TargetQuarantinedError,
+    classify,
+)
+from redisson_tpu.fault.watchdog import RunWatchdog
+from redisson_tpu.serve.breaker import BreakerBoard
+from redisson_tpu.serve.errors import RetryableError
+
+from tests.test_persist import engine_digest
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_globals():
+    """Every test starts with no injector and zeroed taxonomy counters."""
+    inject.uninstall()
+    taxonomy._reset_stats()
+    yield
+    inject.uninstall()
+
+
+def make_client(tmp_path=None, serve=True, plan=None, seed=0,
+                watchdog=False, rebuild=True, retry_interval_ms=5,
+                **fault_kw):
+    cfg = Config()
+    cfg.use_local()
+    if tmp_path is not None:
+        pc = cfg.use_persist(str(tmp_path))
+        pc.fsync = "always"
+    if serve:
+        sc = cfg.use_serve()
+        sc.retry_interval_ms = retry_interval_ms
+    fc = cfg.use_faults()
+    fc.plan = plan or []
+    fc.seed = seed
+    fc.watchdog = watchdog
+    fc.rebuild = rebuild
+    for k, v in fault_kw.items():
+        setattr(fc, k, v)
+    return RedissonTPU.create(cfg)
+
+
+# ---------------------------------------------------------------------------
+# 1. classify()
+# ---------------------------------------------------------------------------
+
+class FakeXlaRuntimeError(Exception):
+    """Stands in for jaxlib.xla_extension.XlaRuntimeError (matched by
+    type NAME, so the stand-in exercises the same code path)."""
+
+
+# classify keys on the type name; rename the class the way jaxlib spells it
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+class TestClassify:
+    def test_semantic_errors_pass_through(self):
+        for exc in (KeyError("k"), ValueError("bad payload"),
+                    TypeError("no")):
+            assert classify(exc, seam="kernel_launch") is exc
+        assert taxonomy.stats()["passthrough"] == 3
+        assert taxonomy.stats()["classified"] == 0
+
+    def test_cancelled_and_faults_pass_through(self):
+        c = CancelledError()
+        assert classify(c, seam="d2h_complete") is c
+        f = RetryableFault("x", seam="stage_h2d")
+        assert classify(f, seam="d2h_complete") is f
+
+    def test_transient_precommit_is_retryable(self):
+        for seam in ("stage_h2d", "kernel_launch", "journal_fsync",
+                     "snapshot_io"):
+            out = classify(FakeXlaRuntimeError("RESOURCE_EXHAUSTED: oom"),
+                           seam=seam)
+            assert isinstance(out, RetryableFault), seam
+            assert isinstance(out, RetryableError)  # serve retry fires
+            assert out.seam == seam
+            assert isinstance(out.cause, FakeXlaRuntimeError)
+
+    def test_transient_postdispatch_is_uncertain(self):
+        out = classify(FakeXlaRuntimeError("UNAVAILABLE: transfer failed"),
+                       seam="d2h_complete")
+        assert isinstance(out, StateUncertainFault)
+        assert not isinstance(out, RetryableFault)
+        out = classify(FakeXlaRuntimeError("ABORTED: preempted"),
+                       seam="mesh_collective")
+        assert isinstance(out, StateUncertainFault)
+
+    def test_device_lost(self):
+        out = classify(FakeXlaRuntimeError("DATA_LOSS: device lost"),
+                       seam="d2h_complete")
+        assert isinstance(out, DeviceLostFault)
+        assert isinstance(out, StateUncertainFault)  # rebuild path applies
+
+    def test_fatal(self):
+        out = classify(
+            FakeXlaRuntimeError("INVALID_ARGUMENT: shape mismatch"),
+            seam="kernel_launch")
+        assert isinstance(out, FatalFault)
+
+    def test_oserror_at_io_seam_is_retryable(self):
+        out = classify(OSError(28, "No space left on device"),
+                       seam="journal_fsync")
+        assert isinstance(out, RetryableFault)
+
+    def test_unmatched_runtimeerror_passes_through(self):
+        exc = RuntimeError("shape invariant violated: 3 != 4")
+        assert classify(exc, seam="kernel_launch") is exc
+
+    def test_stats_accumulate(self):
+        classify(FakeXlaRuntimeError("UNAVAILABLE: x"), seam="stage_h2d")
+        classify(FakeXlaRuntimeError("UNAVAILABLE: x"), seam="d2h_complete")
+        classify(FakeXlaRuntimeError("DATA_LOSS: device lost"), seam="")
+        s = taxonomy.stats()
+        assert s["classified"] == 3
+        assert s["retryable"] == 1
+        assert s["state_uncertain"] == 2
+        assert s["device_lost"] == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. injection
+# ---------------------------------------------------------------------------
+
+class TestInjection:
+    def test_rule_validation(self):
+        with pytest.raises(ValueError):
+            FaultRule(seam="nope")
+        with pytest.raises(ValueError):
+            FaultRule(seam="stage_h2d", fault="weird")
+        with pytest.raises(ValueError):
+            FaultRule(seam="stage_h2d", nth=0)
+
+    def test_nth_and_times(self):
+        inj = FaultInjector(FaultPlan(rules=[
+            FaultRule(seam="kernel_launch", nth=2, times=2)]))
+        inj.fire("kernel_launch")  # hit 1: clean
+        with pytest.raises(RetryableFault):
+            inj.fire("kernel_launch")  # hit 2: fires
+        with pytest.raises(RetryableFault):
+            inj.fire("kernel_launch")  # hit 3: still inside times=2
+        inj.fire("kernel_launch")  # hit 4: clean again
+        assert inj.injected == 2
+        assert [f["hit"] for f in inj.fired] == [2, 3]
+
+    def test_kind_target_matching(self):
+        inj = FaultInjector(FaultPlan(rules=[
+            FaultRule(seam="d2h_complete", kind="hll_add", target="h",
+                      nth=1)]))
+        inj.fire("d2h_complete", kind="bitset_set", target="h")  # kind miss
+        inj.fire("d2h_complete", kind="hll_add", target="g")     # target miss
+        with pytest.raises(RetryableFault):
+            inj.fire("d2h_complete", kind="hll_add", target="h")
+        # misses never advanced the hit counter
+        assert inj.snapshot()["hits"] == [1]
+
+    def test_random_plan_is_deterministic(self):
+        a, b = FaultPlan.random(seed=7), FaultPlan.random(seed=7)
+        assert a == b
+        assert FaultPlan.random(seed=8) != a
+        for rule in a.rules:
+            assert rule.seam in inject.SEAMS
+            assert rule.fault in inject.FAULT_CLASSES
+
+    def test_fire_disabled_is_noop(self):
+        inject.uninstall()
+        for _ in range(3):
+            inject.fire("kernel_launch", kind="hll_add", target="t")
+
+    def test_install_uninstall(self):
+        inj = FaultInjector(FaultPlan())
+        inject.install(inj)
+        assert inject.installed() is inj
+        inject.uninstall()
+        assert inject.installed() is None
+
+
+# ---------------------------------------------------------------------------
+# 3. seams through the real client
+# ---------------------------------------------------------------------------
+
+class TestSeams:
+    def test_kernel_launch_retryable_absorbed_by_serve(self):
+        c = make_client(plan=[{"seam": "kernel_launch", "fault": "retryable",
+                               "nth": 3, "times": 1}])
+        try:
+            h = c.get_hyper_log_log("h")
+            for i in range(10):
+                h.add(f"k{i}")  # one add trips the seam; retry absorbs it
+            assert h.count() == 10
+            assert c.fault.injector.injected == 1
+            assert c.metrics.counter("serve.retries_total") == 1
+        finally:
+            c.shutdown()
+
+    def test_journal_fsync_retryable_absorbed(self, tmp_path):
+        c = make_client(tmp_path, plan=[
+            {"seam": "journal_fsync", "fault": "retryable", "nth": 2,
+             "times": 1}])
+        try:
+            bits = c.get_bit_set("bits")
+            for i in range(8):
+                bits.set(i, True)
+            assert bits.cardinality() == 8
+            assert c.fault.injector.injected == 1
+        finally:
+            c.shutdown()
+
+    def test_stage_h2d_seam_in_pipeline(self):
+        """The ingest pipeline's worker-thread seam re-raises on the
+        dispatcher side of run()."""
+        from redisson_tpu.ingest.pipeline import StagingPipeline
+
+        inject.install(FaultInjector(FaultPlan(rules=[
+            FaultRule(seam="stage_h2d", nth=2)])))
+        pipe = StagingPipeline(depth=2)
+        with pytest.raises(RetryableFault):
+            pipe.run([1, 2, 3], stage=lambda x: x, dispatch=lambda i, s: s)
+
+    def test_snapshot_io_seam(self, tmp_path):
+        c = make_client(tmp_path, plan=[
+            {"seam": "snapshot_io", "fault": "retryable", "nth": 1,
+             "times": 1}])
+        try:
+            c.get_hyper_log_log("h").add("a")
+            with pytest.raises(RetryableFault):
+                c.snapshot_now()
+            # the next snapshot (hit 2) succeeds; state was never at risk
+            c.snapshot_now()
+        finally:
+            c.shutdown()
+
+    def test_d2h_uncertain_quarantines_and_rebuilds(self, tmp_path):
+        c = make_client(tmp_path, plan=[
+            {"seam": "d2h_complete", "fault": "state_uncertain", "nth": 3,
+             "times": 1, "kind": "hll_add"}])
+        try:
+            h = c.get_hyper_log_log("h")
+            outcomes = []
+            for i in range(30):
+                try:
+                    h.add(f"k{i}")
+                    outcomes.append("ok")
+                except Exception as exc:  # noqa: BLE001 - audit the types
+                    outcomes.append(type(exc).__name__)
+            assert c.fault.rebuild.wait_idle(timeout=30)
+            snap = c.fault.rebuild.snapshot()
+            assert snap["rebuilt_total"] >= 1
+            assert snap["degraded"] == [] and snap["quarantined"] == []
+            # every acked add (and the uncertain-but-committed one: DTS
+            # backends commit at stage time) survived the rebuild
+            n_acked = outcomes.count("ok")
+            assert h.count() >= n_acked
+            # post-rebuild the target accepts writes again
+            h.add("after-rebuild")
+        finally:
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. watchdog
+# ---------------------------------------------------------------------------
+
+class WedgedBackend:
+    """run() blocks until released — a hung device call. Late completion
+    respects the executor contract (guards future.done())."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.entered = threading.Event()
+
+    def run(self, kind, target, ops):
+        self.entered.set()
+        self.release.wait(timeout=30)
+        for op in ops:
+            if not op.future.done():
+                op.future.set_result("late")
+
+
+class TestWatchdog:
+    def test_deadline_floor_and_margin(self):
+        ex = CommandExecutor(WedgedBackend())
+        try:
+            wd = RunWatchdog(ex, estimate=None, margin=8.0, floor_s=2.0)
+            assert wd.deadline_s("hll_add", 100) == 2.0
+            wd2 = RunWatchdog(ex, estimate=lambda k, n: 1.0, margin=8.0,
+                              floor_s=2.0)
+            assert wd2.deadline_s("hll_add", 100) == 8.0
+            wd3 = RunWatchdog(ex, estimate=lambda k, n: 1 / 0, margin=8.0,
+                              floor_s=2.0)
+            assert wd3.deadline_s("hll_add", 100) == 2.0  # estimate fault
+        finally:
+            ex.shutdown(wait=False)
+
+    def test_trip_completes_futures_and_opens_breaker(self):
+        backend = WedgedBackend()
+        ex = CommandExecutor(backend)
+        breakers = BreakerBoard(clock=time.monotonic)
+        trips = []
+        wd = RunWatchdog(ex, estimate=None, margin=1.0, floor_s=0.05,
+                         breakers=breakers,
+                         on_trip=lambda k, t, f: trips.append((k, set(t), f)))
+        try:
+            f = ex.execute_async("t", "noop", "v", nkeys=1)
+            assert backend.entered.wait(timeout=5)
+            time.sleep(0.1)  # age past the 0.05s floor
+            assert wd.check_once() == 1
+            with pytest.raises(StateUncertainFault):
+                f.result(timeout=5)
+            assert wd.check_once() == 0  # no double trip
+            assert wd.trips == 1
+            assert taxonomy.stats()["watchdog_trips"] == 1
+            assert breakers.get("noop").state == "open"
+            assert trips and trips[0][0] == "noop" and trips[0][1] == {"t"}
+            assert isinstance(trips[0][2], StateUncertainFault)
+            # gates released: the next run on the same target dispatches
+            # once the backend un-wedges
+            backend.release.set()
+            assert ex.execute_async("t", "noop", "w",
+                                    nkeys=1).result(timeout=10) == "late"
+        finally:
+            backend.release.set()
+            wd.stop()
+            ex.shutdown()
+
+    def test_healthy_runs_never_trip(self):
+        c = make_client(serve=True, watchdog=True)
+        try:
+            h = c.get_hyper_log_log("h")
+            for i in range(20):
+                h.add(f"k{i}")
+            assert h.count() == 20
+            assert c.fault.watchdog.trips == 0
+        finally:
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 5. rebuild
+# ---------------------------------------------------------------------------
+
+class TestRebuild:
+    def test_guard_rejects_writes_only(self):
+        from redisson_tpu.fault.rebuild import RebuildCoordinator
+
+        rc = RebuildCoordinator(client=None)
+        rc._quarantined.add("q")
+        rc._degraded.add("d")
+        assert isinstance(rc.guard("hll_add", "q"), TargetQuarantinedError)
+        assert isinstance(rc.guard("hll_add", "d"), TargetDegradedError)
+        assert rc.guard("hll_count", "q") is None      # reads admitted
+        assert rc.guard("hll_add", "other") is None    # other targets fine
+        assert rc.guard("hll_add", "") is None         # no target, no guard
+        # quarantine rejection is retryable; degradation is not
+        assert isinstance(rc.guard("hll_add", "q"), RetryableError)
+        assert not isinstance(rc.guard("hll_add", "d"), RetryableError)
+
+    def test_rebuild_restores_snapshot_plus_suffix(self, tmp_path):
+        c = make_client(tmp_path, plan=[
+            {"seam": "d2h_complete", "fault": "device_lost", "nth": 6,
+             "times": 1, "kind": "hll_add"}])
+        try:
+            h = c.get_hyper_log_log("h")
+            for i in range(3):
+                h.add(f"pre{i}")
+            c.snapshot_now()  # targets now live in a snapshot
+            for i in range(20):
+                try:
+                    h.add(f"post{i}")
+                except Exception:  # noqa: BLE001 - chaos loop
+                    pass
+            assert c.fault.rebuild.wait_idle(timeout=30)
+            snap = c.fault.rebuild.snapshot()
+            assert snap["rebuilt_total"] >= 1 and snap["rebuild_failures"] == 0
+            # snapshot content + journal suffix both survived
+            assert h.count() >= 3
+            h.add("again")  # quarantine lifted
+        finally:
+            c.shutdown()
+
+    def test_no_persist_degrades_to_read_only(self):
+        c = make_client(tmp_path=None, plan=[
+            {"seam": "d2h_complete", "fault": "state_uncertain", "nth": 2,
+             "times": 1, "kind": "bitset_set"}])
+        try:
+            bits = c.get_bit_set("bits")
+            for i in range(10):
+                try:
+                    bits.set(i, True)
+                except Exception:  # noqa: BLE001 - chaos loop
+                    pass
+            c.fault.rebuild.wait_idle(timeout=30)
+            snap = c.fault.rebuild.snapshot()
+            assert snap["degraded"] == ["bits"]
+            assert snap["rebuild_failures"] == 1
+            # writes fail fast with the distinct non-retryable error...
+            with pytest.raises(TargetDegradedError):
+                c._executor.execute_async("bits", "bitset_set",
+                                          {"offset": 99, "value": 1},
+                                          nkeys=1).result(timeout=5)
+            # ...while reads keep serving best-effort device state
+            assert bits.cardinality() >= 1
+            # Other targets stay writable at the executor guard (the serve
+            # breaker still sheds the KIND until its reset timeout — per-
+            # kind load shedding is deliberate, the guard is per-target).
+            assert c.fault.rebuild.guard("bitset_set", "healthy") is None
+        finally:
+            c.shutdown()
+
+    def test_sweep_queued_rejects_with_factory(self):
+        backend = WedgedBackend()
+        ex = CommandExecutor(backend)
+        try:
+            blocker = ex.execute_async("t", "noop", 1, nkeys=1)
+            assert backend.entered.wait(timeout=5)
+            queued = [ex.execute_async("t", "noop", i, nkeys=1)
+                      for i in range(3)]
+            other = ex.execute_async("u", "noop", 9, nkeys=1)
+            n = ex.sweep_queued({"t"}, lambda op: TargetQuarantinedError(
+                f"{op.target} quarantined"))
+            assert n == 3
+            for f in queued:
+                with pytest.raises(TargetQuarantinedError):
+                    f.result(timeout=5)
+            backend.release.set()
+            assert blocker.result(timeout=5) == "late"
+            assert other.result(timeout=5) == "late"
+        finally:
+            backend.release.set()
+            ex.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 6. the chaos property
+# ---------------------------------------------------------------------------
+
+def _workload(client, rng_seed=0xC0FFEE, n=120):
+    """Deterministic hll/bloom/bitset mix; returns per-op outcomes."""
+    import random as _random
+
+    rng = _random.Random(rng_seed)
+    h = client.get_hyper_log_log("h")
+    bits = client.get_bit_set("bits")
+    bloom = client.get_bloom_filter("bloom")
+    bloom.try_init(4096, 0.01)
+    outcomes = []
+    for i in range(n):
+        op = rng.choice(("hll", "bits", "bloom"))
+        try:
+            if op == "hll":
+                h.add(f"u{i}")
+            elif op == "bits":
+                bits.set(rng.randint(0, 512), True)
+            else:
+                bloom.add(f"b{i}")
+            outcomes.append(("ok", op))
+        except Exception as exc:  # noqa: BLE001 - the property audits types
+            outcomes.append((type(exc).__name__, op))
+    return outcomes
+
+
+PRECOMMIT_SEAMS = ("stage_h2d", "kernel_launch", "journal_fsync")
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_chaos_retryable_plans_are_bit_identical_to_oracle(tmp_path, seed):
+    """Pre-commit retryable faults + serve retry: the caller never sees a
+    fault and the engine state is bit-identical to a fault-free run."""
+    oracle = make_client()
+    try:
+        assert all(o == "ok" for o, _ in _workload(oracle))
+        want = engine_digest(oracle)
+    finally:
+        oracle.shutdown()
+
+    plan = FaultPlan.random(seed=seed, seams=PRECOMMIT_SEAMS,
+                            n_rules=4, max_nth=40, faults=("retryable",))
+    c = make_client(tmp_path / "chaos", plan=[
+        {"seam": r.seam, "fault": r.fault, "nth": r.nth, "times": r.times}
+        for r in plan.rules])
+    try:
+        outcomes = _workload(c)
+        assert all(o == "ok" for o, _ in outcomes), outcomes
+        assert engine_digest(c) == want
+    finally:
+        c.shutdown()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_chaos_uncertain_plans_recover_committed_state(tmp_path, seed):
+    """State-uncertain/device-lost faults at the post-dispatch seam: every
+    future completes (success or a typed fault/serve error — never a
+    hang), rebuilds settle, and the surviving engine state equals a fresh
+    client's recovery of the committed journal bit-for-bit (no acked
+    write lost, no torn state)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    plan = [{"seam": "d2h_complete",
+             "fault": rng.choice(("state_uncertain", "device_lost")),
+             "nth": rng.randint(2, 25), "times": 1}
+            for _ in range(2)]
+    live_dir = tmp_path / "live"
+    c = make_client(live_dir, plan=plan)
+    try:
+        outcomes = _workload(c, rng_seed=seed)
+        allowed = {"ok", "StateUncertainFault", "DeviceLostFault",
+                   "CircuitOpenError", "TargetQuarantinedError",
+                   "DeadlineExceeded", "RetryableFault"}
+        assert {o for o, _ in outcomes} <= allowed, outcomes
+        assert c.fault.rebuild.wait_idle(timeout=60)
+        assert c.fault.rebuild.snapshot()["rebuild_failures"] == 0
+        c.persist.journal.sync()
+        live = engine_digest(c)
+    finally:
+        c.shutdown()
+
+    r = RedissonTPU.create(_recover_cfg(live_dir))
+    try:
+        assert engine_digest(r) == live
+    finally:
+        r.shutdown()
+
+
+def _recover_cfg(path):
+    cfg = Config()
+    cfg.use_local()
+    pc = cfg.use_persist(str(path))
+    pc.fsync = "always"
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# 7. PR-8 satellites
+# ---------------------------------------------------------------------------
+
+class FailNTimesBackend:
+    """Fails the first `n` runs with a RetryableError, then succeeds."""
+
+    def __init__(self, n):
+        self.n = n
+        self.runs = 0
+
+    def run(self, kind, target, ops):
+        self.runs += 1
+        for op in ops:
+            if self.runs <= self.n:
+                op.future.set_exception(RetryableError("transient"))
+            else:
+                op.future.set_result(op.payload)
+
+
+class TestServeShutdownCancelsRetries:
+    def test_pending_retry_outer_cancelled_at_shutdown(self):
+        from redisson_tpu.config import ServeConfig
+        from redisson_tpu.observability import MetricsRegistry
+        from redisson_tpu.serve import ServingLayer
+
+        backend = FailNTimesBackend(n=10)
+        ex = CommandExecutor(backend)
+        serve = ServingLayer(
+            ex, ServeConfig(retry_attempts=3, retry_interval_ms=60_000),
+            registry=MetricsRegistry())
+        # timeout_s=0 -> no deadline, so the 30-60s backoff IS scheduled
+        outer = serve.execute_async("t", "noop", "v", nkeys=1, timeout_s=0)
+        # first attempt failed; the retry sits in the timer wheel ~30s out
+        deadline = time.monotonic() + 5
+        while not serve._timer._heap and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert serve._timer._heap, "retry was never scheduled"
+        serve.shutdown()
+        assert outer.cancelled()
+        with pytest.raises(CancelledError):
+            outer.result(timeout=0)
+
+    def test_timer_closed_inline_fallback_cancels(self):
+        from redisson_tpu.config import ServeConfig
+        from redisson_tpu.observability import MetricsRegistry
+        from redisson_tpu.serve import ServingLayer
+
+        backend = FailNTimesBackend(n=10)
+        ex = CommandExecutor(backend)
+        serve = ServingLayer(
+            ex, ServeConfig(retry_attempts=3, retry_interval_ms=60_000),
+            registry=MetricsRegistry())
+        serve._timer.close()  # race shutdown ahead of the attempt
+        outer = serve.execute_async("t", "noop", "v", nkeys=1, timeout_s=0)
+        with pytest.raises(CancelledError):
+            outer.result(timeout=5)
+        ex.shutdown()
+
+    def test_entries_without_cancel_still_fire_at_close(self):
+        from redisson_tpu.serve.scheduler import _Timer
+
+        t = _Timer()
+        fired = []
+        t.call_later(60.0, lambda: fired.append("fn"))
+        t.close()
+        assert fired == ["fn"]  # legacy path: no cancel hook -> fire
+
+
+class TestRoutingRenameRegression:
+    def test_structures_branch_failure_resolves_future(self):
+        c = make_client(serve=False)
+        try:
+            c.get_bucket("src").set("v")  # structures-tier key
+
+            def boom(kind, target, ops):
+                raise RuntimeError("structures tier exploded")
+
+            c._routing.structures.run = boom
+            f = c._executor.execute_async(
+                "src", "rename", {"newkey": "dst"}, nkeys=1)
+            with pytest.raises(RuntimeError, match="exploded"):
+                f.result(timeout=5)  # resolved, not stranded
+        finally:
+            del c._routing.structures.run
+            c.shutdown()
+
+
+class TestShutdownSweep:
+    def test_staged_but_undispatched_ops_cancel_at_shutdown(self):
+        """A wedged in-flight run must not strand the ops queued behind
+        it: shutdown's sweep cancels them (delta windows queue the same
+        way — per-target FIFOs drained by the dispatcher)."""
+        backend = WedgedBackend()
+        ex = CommandExecutor(backend)
+        inflight = ex.execute_async("t", "hll_add", {"values": ["a"]}, nkeys=1)
+        assert backend.entered.wait(timeout=5)
+        queued = [ex.execute_async("t", "hll_add", {"values": [f"v{i}"]},
+                                   nkeys=1) for i in range(4)]
+        ex.shutdown(wait=True, timeout=0.3)  # dispatcher is wedged
+        for f in queued:
+            assert f.done()
+            with pytest.raises(CancelledError):
+                f.result(timeout=0)
+        backend.release.set()
+        assert inflight.result(timeout=10) == "late"
+
+
+# ---------------------------------------------------------------------------
+# config / observability plumbing
+# ---------------------------------------------------------------------------
+
+class TestPlumbing:
+    def test_config_roundtrip(self):
+        cfg = Config()
+        fc = cfg.use_faults()
+        fc.plan = [{"seam": "kernel_launch", "nth": 2}]
+        fc.watchdog = True
+        d = cfg.to_dict()
+        back = Config.from_dict(d)
+        assert isinstance(back.faults, FaultConfig)
+        assert back.faults.plan == fc.plan
+        assert back.faults.watchdog is True
+
+    def test_fault_gauges_registered(self):
+        c = make_client(watchdog=True)
+        try:
+            gauges = c.metrics.snapshot()["gauges"]
+            for name in ("fault.injected", "fault.classified",
+                         "fault.retried", "fault.rebuilt",
+                         "fault.quarantined", "fault.degraded",
+                         "fault.rebuild_s", "fault.watchdog_trips"):
+                assert name in gauges, name
+        finally:
+            c.shutdown()
+
+    def test_manager_stop_uninstalls_injector(self):
+        c = make_client(plan=[{"seam": "kernel_launch", "nth": 999}])
+        assert inject.installed() is c.fault.injector
+        c.shutdown()
+        assert inject.installed() is None

@@ -365,6 +365,111 @@ def test_g007_suppression_with_reason():
     assert "G007" not in rules_of(findings)
 
 
+def lint_scoped(src, filename="redisson_tpu/executor.py"):
+    """Lint an in-memory source under an in-repo relpath (G008 and the
+    other scope-gated rules key on the repo-relative location)."""
+    return FileLinter(os.path.join(REPO, filename), repo_root=REPO,
+                      source=textwrap.dedent(src)).run()
+
+
+def test_g008_broad_except_without_classify_flagged():
+    for handler in ("except Exception as exc:", "except BaseException:",
+                    "except:"):
+        findings = lint_scoped(f"""
+            def complete(ops):
+                try:
+                    launch(ops)
+                {handler}
+                    for op in ops:
+                        op.future.set_exception(ValueError("boom"))
+        """)
+        assert "G008" in rules_of(findings), handler
+
+
+def test_g008_classify_in_body_ok():
+    findings = lint_scoped("""
+        from redisson_tpu.fault.taxonomy import classify
+
+        def complete(ops):
+            try:
+                launch(ops)
+            except Exception as exc:
+                exc = classify(exc, seam="kernel_launch")
+                for op in ops:
+                    op.future.set_exception(exc)
+    """)
+    assert "G008" not in rules_of(findings)
+    # attribute form too (taxonomy.classify)
+    findings = lint_scoped("""
+        from redisson_tpu.fault import taxonomy
+
+        def complete(ops):
+            try:
+                launch(ops)
+            except Exception as exc:
+                raise taxonomy.classify(exc, seam="d2h_complete")
+    """, filename="redisson_tpu/backend_tpu.py")
+    assert "G008" not in rules_of(findings)
+
+
+def test_g008_narrow_except_not_flagged():
+    findings = lint_scoped("""
+        def load(path):
+            try:
+                return open(path).read()
+            except (OSError, ValueError):
+                return None
+    """, filename="redisson_tpu/persist/journal.py")
+    assert "G008" not in rules_of(findings)
+
+
+def test_g008_scoped_to_fault_boundaries():
+    src = """
+        def f(ops):
+            try:
+                g(ops)
+            except Exception:
+                pass
+    """
+    in_scope = [
+        os.path.join(REPO, "redisson_tpu", "executor.py"),
+        os.path.join(REPO, "redisson_tpu", "backend_tpu.py"),
+        os.path.join(REPO, "redisson_tpu", "persist", "journal.py"),
+        os.path.join(REPO, "redisson_tpu", "parallel", "backend_pod.py"),
+    ]
+    out_of_scope = [
+        os.path.join(REPO, "redisson_tpu", "models", "foo.py"),
+        os.path.join(REPO, "redisson_tpu", "serve", "scheduler.py"),
+        os.path.join(REPO, "redisson_tpu", "interop", "backend_redis.py"),
+    ]
+    for path in in_scope:
+        findings = FileLinter(path, repo_root=REPO,
+                              source=textwrap.dedent(src)).run()
+        assert "G008" in rules_of(findings), path
+    for path in out_of_scope:
+        findings = FileLinter(path, repo_root=REPO,
+                              source=textwrap.dedent(src)).run()
+        assert "G008" not in rules_of(findings), path
+    # `explicit` (a directly-named CLI target, e.g. bench.py) must NOT
+    # enable G008: outside the fault boundary a broad except is usually
+    # deliberate best-effort isolation, not a taxonomy leak.
+    findings = FileLinter(os.path.join(REPO, "bench.py"), repo_root=REPO,
+                          explicit=True, source=textwrap.dedent(src)).run()
+    assert "G008" not in rules_of(findings)
+
+
+def test_g008_suppression_with_reason():
+    findings = lint_scoped("""
+        def f(ops):
+            try:
+                g(ops)
+            except Exception:
+                # graftlint: allow-bare(thread-isolation backstop: closures own their futures)
+                pass
+    """)
+    assert "G008" not in rules_of(findings)
+
+
 def test_g007_registry_coverage():
     """Every OP_TABLE kind behaves per its write flag: all write kinds are
     flagged when dispatched as a literal `.run`, no read kind ever is. Pins

@@ -19,6 +19,16 @@ failure modes (see findings.RULES). Scope notes:
   ``redisson_tpu/`` (executor.py, routing.py, serve/) — unless the file
   was passed explicitly. The models' sync facades are the *documented*
   blocking API and stay out of scope.
+* G008 (bare) applies to the device/persist fault boundaries under
+  ``redisson_tpu/`` (top-level ``backend*`` files, ``parallel/backend*``,
+  executor.py, persist/) — unless the file was passed explicitly; the
+  interop shims (socket errors, not device errors) stay out. A broad handler
+  (bare ``except:``, ``except Exception``, ``except BaseException``)
+  there must route the exception through ``fault.classify()`` somewhere
+  in its body, so raw XLA/IO errors reach callers typed (retryable vs
+  state-uncertain) and the serve retry / HBM rebuild machinery can fire.
+  Handlers that deliberately swallow (completer isolation, background
+  fsync backstops) carry reasoned ``allow-bare`` suppressions.
 * G007 (journal) applies everywhere under ``redisson_tpu/`` except
   executor.py (the commit point that OWNS the journal hook). It flags
   ``anything.run("<kind>", ...)`` where the literal kind is a write op in
@@ -124,6 +134,10 @@ class FileLinter:
         self._g002_on = self.explicit or self._in_sync_scope()
         self._g006_on = self.explicit or self._in_block_scope()
         self._g007_on = self.explicit or self._in_journal_scope()
+        # G008 is scope-only (never `explicit`): outside the device/persist
+        # fault boundary a broad except is usually deliberate best-effort
+        # isolation (bench harnesses, CLI wrappers), not a leak.
+        self._g008_on = self._in_fault_scope()
         self._g004_on = not self.relpath.endswith("ops/u64.py")
         self._pallas_file = any(
             full == _PALLAS_MODULE for full in self.alias_modules.values()
@@ -133,6 +147,8 @@ class FileLinter:
                       const_exempt=False, fn_node=None, module_level=True)
         if self._pallas_file:
             self._check_pallas_dtypes(tree)
+        if self._g008_on:
+            self._check_bare_excepts(tree)
         # dedupe identical (rule, line) hits (e.g. two lane shifts on one line)
         seen, out = set(), []
         for f in self.findings:
@@ -185,6 +201,18 @@ class FileLinter:
         return (
             sub in ("executor.py", "routing.py")
             or sub.startswith("serve/")
+        )
+
+    def _in_fault_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        return (
+            sub == "executor.py"
+            or sub.startswith("persist/")
+            or sub.startswith("backend")
+            or sub.startswith("parallel/backend")
         )
 
     def _in_journal_scope(self) -> bool:
@@ -720,6 +748,54 @@ class FileLinter:
         if grid is not None and not isinstance(grid, ast.Tuple):
             return None, nsp  # unresolvable expression — don't guess
         return None, nsp
+
+    # -- G008: broad excepts bypassing the fault taxonomy ---------------------
+
+    @staticmethod
+    def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True  # bare `except:`
+        names = t.elts if isinstance(t, ast.Tuple) else [t]
+        return any(
+            isinstance(n, ast.Name) and n.id in ("Exception", "BaseException")
+            for n in names
+        )
+
+    @staticmethod
+    def _body_classifies(handler: ast.ExceptHandler) -> bool:
+        """Does the handler body route the exception through classify()?
+        Accepts `classify(...)`, `taxonomy.classify(...)`, etc."""
+        for stmt in handler.body:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if isinstance(f, ast.Name) and f.id == "classify":
+                    return True
+                if isinstance(f, ast.Attribute) and f.attr == "classify":
+                    return True
+        return False
+
+    def _check_bare_excepts(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad_handler(node):
+                continue
+            if self._body_classifies(node):
+                continue
+            self._emit(
+                "G008", node,
+                "broad except in a device/persist fault boundary without "
+                "fault.classify() — the raw exception reaches callers "
+                "untyped, so serve retries and the HBM rebuild path never "
+                "see a decision",
+                "wrap the exception: `exc = classify(exc, seam=...)` before "
+                "completing futures / re-raising; if swallowing here is the "
+                "contract (thread-isolation backstop, benign race), add "
+                "`# graftlint: allow-bare(reason)`",
+            )
 
     def _check_pallas_dtypes(self, tree: ast.AST) -> None:
         for n in ast.walk(tree):

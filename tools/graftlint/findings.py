@@ -76,6 +76,13 @@ RULES = {
         '.run("<kind>") whose kind is write=True in the OP_TABLE, outside '
         "the executor commit point — persistence/replication never sees it",
     ),
+    "G008": (
+        "bare",
+        "broad except (bare / Exception / BaseException) in a device or "
+        "persist fault boundary (backend*, executor.py, persist/) not "
+        "routed through fault.classify() — raw XLA/IO errors leak to "
+        "callers untyped, so the serve retry and rebuild paths never fire",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
