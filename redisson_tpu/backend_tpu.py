@@ -133,6 +133,17 @@ def _start_d2h(x):
     return x
 
 
+def _trace_cache(ops, hit: bool) -> None:
+    """Stamp read-cache hit/miss on any sampled trace spans riding `ops`
+    (executor.Op.span; replayed/synthetic ops may lack the attribute)."""
+    name = "cache_hit" if hit else "cache_miss"
+    for op in ops:
+        span = getattr(op, "span", None)
+        if span is not None and span.t1 is None:
+            span.event(name)
+            span.annotations.setdefault("read_cache", "hit" if hit else "miss")
+
+
 def _complete_all(ops: List[Op], materialize: Callable[[], object]) -> Callable:
     """Closure completing every op with materialize()'s value (or error)."""
 
@@ -1354,8 +1365,10 @@ class TpuBackend:
         if self.read_cache.is_hit(cached):
             # No kernel, no D2H — but still resolve via the completer so
             # per-target results stay FIFO behind reads already in flight.
+            _trace_cache(ops, hit=True)
             self.completer.submit(_complete_all(ops, lambda v=cached: v))
             return
+        _trace_cache(ops, hit=False)
         # async dispatch; D2H starts now, sync happens off-thread
         est = _start_d2h(engine.hll_bank_count(self.bank, np.int32(row)))
 
@@ -1617,8 +1630,10 @@ class TpuBackend:
         epoch = self._epoch(target)
         cached = self.read_cache.get(target, "bitset_cardinality", epoch)
         if self.read_cache.is_hit(cached):
+            _trace_cache(ops, hit=True)
             self.completer.submit(_complete_all(ops, lambda v=cached: v))
             return
+        _trace_cache(ops, hit=False)
         # Partials go D2H async; the 64-bit-exact combine happens at
         # completion (an int32 total wraps negative past 2^31 set bits).
         v = _start_d2h(engine.bitset_cardinality_partials(obj.state))
@@ -1640,8 +1655,10 @@ class TpuBackend:
         epoch = self._epoch(target)
         cached = self.read_cache.get(target, "bitset_length", epoch)
         if self.read_cache.is_hit(cached):
+            _trace_cache(ops, hit=True)
             self.completer.submit(_complete_all(ops, lambda v=cached: v))
             return
+        _trace_cache(ops, hit=False)
         # Same async shape as BITCOUNT: int32 local offsets go D2H, the
         # absolute position is assembled in 64-bit host ints at completion
         # (positions past 2^31 bits wrap an int32 device scalar).
@@ -2024,10 +2041,12 @@ class TpuBackend:
                 if self.read_cache.is_hit(hit):
                     # Serve a copy via the completer so per-target resolution
                     # order matches submission order even on a hit.
+                    _trace_cache([op], hit=True)
                     self.completer.submit(
                         _complete_all([op], lambda v=hit: v.copy()))
                     continue
                 digests[id(op)] = dig
+            _trace_cache([op], hit=False)
             pending.append(op)
         if not pending:
             return
@@ -2102,9 +2121,11 @@ class TpuBackend:
         epoch = self._epoch(target)
         cached = self.read_cache.get(target, "bloom_count", epoch)
         if self.read_cache.is_hit(cached):
+            _trace_cache(ops, hit=True)
             for op in ops:
                 op.future.set_result(cached)
             return
+        _trace_cache(ops, hit=False)
         if use_mirror:
             # Valid mirror holds every bit: host popcount, zero link traffic.
             bc = native_mod.popcount(mir["bits"])

@@ -133,6 +133,12 @@ class RedissonTPU:
             except Exception:
                 self.shutdown()
                 raise
+            if self.trace is not None:
+                # Fsync durations feed LATENCY HISTORY + the fsync
+                # histogram even for unsampled ops.
+                journal = self._executor.journal
+                if journal is not None:
+                    journal.set_trace(self.trace)
         # Fault subsystem (fault/): taxonomy is always active (the backends
         # classify unconditionally); injection / watchdog / self-healing
         # rebuild only attach when Config.use_faults() was called. Wired
@@ -179,12 +185,25 @@ class RedissonTPU:
                 target_batch_service_s=scfg.target_batch_service_s,
                 min_batch_keys=scfg.min_batch_keys,
             )
+        # Trace subsystem (trace/): built before the executor so every op —
+        # including maintenance traffic — flows through the sampling hook;
+        # the serving layer (below) picks it up off the executor for the
+        # admission/retry annotations.
+        self.trace = None
+        trcfg = getattr(self.config, "trace", None)
+        if trcfg is not None:
+            from redisson_tpu.observability import register_trace
+            from redisson_tpu.trace import TraceManager
+
+            self.trace = TraceManager(trcfg, registry=self.metrics)
+            register_trace(self.metrics, self.trace)
         kwargs = {}
         if max_batch_keys is not None:
             kwargs["max_batch_keys"] = max_batch_keys
         self._executor = CommandExecutor(
             backend, metrics=ExecutorMetrics(self.metrics), policy=policy,
             inflight_runs=getattr(self.config, "inflight_runs", 2),
+            trace=self.trace,
             **kwargs)
         self.metrics.gauge("executor.queue_depth", self._executor.queue_depth)
         self.metrics.gauge(

@@ -211,6 +211,30 @@ class FaultConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Trace subsystem (redisson_tpu/trace/): sampled per-op spans,
+    quantile latency histograms, SLOWLOG/MONITOR/LATENCY parity surfaces
+    and Chrome-trace export. Orthogonal to the backend mode; the <1%
+    overhead budget holds at the default sampling stride."""
+
+    # Sample 1 op in `sample_every` (deterministic counter stride seeded
+    # by `seed`); 1 = trace everything (tests/debugging only).
+    sample_every: int = 128
+    seed: int = 0
+    # Bounded ring of finished spans kept for chrome_trace() export.
+    ring: int = 4096
+    # SLOWLOG analogue: ops slower than this land in a bounded ring with
+    # their per-stage breakdown (redis slowlog-log-slower-than is 10ms).
+    slowlog_threshold_ms: float = 10.0
+    slowlog_max_len: int = 128
+    # Per-subscriber MONITOR queue bound; full queues drop-and-count.
+    monitor_queue: int = 1024
+    # LATENCY HISTORY analogue: per-stage spikes above this threshold.
+    latency_threshold_ms: float = 100.0
+    latency_history_len: int = 160
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -222,6 +246,8 @@ class Config:
     persist: Optional[PersistConfig] = None
     # Fault subsystem (None = classify-only; no injection/watchdog/rebuild).
     faults: Optional[FaultConfig] = None
+    # Trace subsystem (None = no spans/slowlog/monitor, the seed behavior).
+    trace: Optional[TraceConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -278,6 +304,10 @@ class Config:
         self.faults = self.faults or FaultConfig()
         return self.faults
 
+    def use_trace(self) -> "TraceConfig":
+        self.trace = self.trace or TraceConfig()
+        return self.trace
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -310,6 +340,7 @@ class Config:
             "serve": ServeConfig,
             "persist": PersistConfig,
             "faults": FaultConfig,
+            "trace": TraceConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

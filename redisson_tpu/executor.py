@@ -99,6 +99,10 @@ class Op:
     tenant: str = ""  # admission identity ("" = the default tenant)
     deadline: Optional[float] = None  # absolute executor-clock time, or None
     enqueued_at: float = 0.0  # executor-clock time of enqueue (QoS delay)
+    # Sampled trace span (trace/spans.py) or None. None for the vast
+    # majority of ops at the default sampling stride; every stamp below
+    # guards on it so disabled tracing costs one attribute read.
+    span: Any = None
 
 
 class GreedyBatchPolicy:
@@ -129,7 +133,7 @@ class _InflightRun:
     __slots__ = ("kind", "target", "targets", "is_global", "nops", "nkeys",
                  "t0", "queue_delay_s", "stage_s", "pending", "failed",
                  "op_failed", "overlapped", "depth", "gates_held", "lock",
-                 "ops", "fault_exc")
+                 "ops", "fault_exc", "run_span")
 
     def __init__(self, kind: str, target: str, targets: frozenset,
                  is_global: bool):
@@ -151,6 +155,7 @@ class _InflightRun:
         self.lock = threading.Lock()
         self.ops: Sequence[Op] = ()  # live ops (watchdog trip / diagnostics)
         self.fault_exc = None  # first StateUncertainFault among the ops
+        self.run_span = None  # parent trace span for this pipeline window
 
 
 class CommandExecutor:
@@ -163,8 +168,12 @@ class CommandExecutor:
 
     def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None,
                  policy=None, clock: Callable[[], float] = None,
-                 inflight_runs: int = 2, journal=None):
+                 inflight_runs: int = 2, journal=None, trace=None):
         self._backend = backend
+        # Trace subsystem (trace/manager.py TraceManager) or None. The
+        # manager must share this executor's clock so span timestamps and
+        # deadlines live on one timeline.
+        self._trace = trace
         # Write-ahead op journal (persist/journal.py) or None. Appended on
         # the dispatcher thread before each run stages; installed late by
         # the client (after recovery replay) via set_journal().
@@ -229,6 +238,17 @@ class CommandExecutor:
         """The attached write-ahead journal, or None (journaling off)."""
         return self._journal
 
+    @property
+    def trace(self):
+        """The attached TraceManager, or None (tracing off)."""
+        return self._trace
+
+    def set_trace(self, trace) -> None:
+        """Attach/detach the trace manager; lock-ordered with enqueue so
+        no op is half-stamped across the transition."""
+        with self._cv:
+            self._trace = trace
+
     def set_journal(self, journal) -> None:
         """Attach/detach the write-ahead journal. The client installs it
         AFTER recovery replay (replayed ops must not re-journal) and
@@ -287,6 +307,11 @@ class CommandExecutor:
         if not q:
             self._ready.append(op.target)
         op.enqueued_at = self._clock()
+        trace = self._trace
+        if trace is not None and op.kind != BARRIER_KIND:
+            # Sampling decision + "queued" stamp; begin_op returns None for
+            # the unsampled majority (one counter stride per op).
+            op.span = trace.begin_op(op.kind, op.target, op.tenant, op.nkeys)
         q.append(op)
 
     def execute_sync(self, target: str, kind: str, payload: Any, nkeys: int = 0):
@@ -422,6 +447,8 @@ class CommandExecutor:
                 ):
                     op = oq.popleft()
                     keys += op.nkeys
+                    if op.span is not None:
+                        op.span.event("stolen")
                     run.append(op)
                 if not oq:
                     emptied.append(other)
@@ -473,6 +500,11 @@ class CommandExecutor:
                     op.future.set_exception(DeadlineExceeded(
                         f"op {kind}@{op.target or target}: deadline passed "
                         f"{now - op.deadline:.6f}s before dispatch"))
+                if op.span is not None:
+                    # Pre-dispatch expiry never attaches a done-callback,
+                    # so the span must be finished here or it leaks.
+                    op.span.event("expired", now)
+                    op.span.finish(error="DeadlineExceeded")
             else:
                 live.append(op)
         if n_expired and m:
@@ -500,6 +532,15 @@ class CommandExecutor:
         t0 = token.t0 = self._clock()
         token.queue_delay_s = t0 - min(op.enqueued_at for op in live)
         token.pending = len(live)
+        # Sampled spans riding this run (usually empty). The run span links
+        # them to the pipeline window they shared.
+        spans = [op.span for op in live if op.span is not None]
+        if spans and self._trace is not None:
+            run_span = token.run_span = self._trace.begin_run(
+                kind, target, len(live), token.nkeys)
+            for s in spans:
+                s.run_id = run_span.span_id
+                s.event("dispatched", t0)
         parked = kind in PARKED_KINDS
         if not parked:
             # Attach completion accounting BEFORE the backend sees the ops: a
@@ -510,7 +551,8 @@ class CommandExecutor:
             # nor the cost model's service EWMA.
             for op in live:
                 op.future.add_done_callback(
-                    lambda fut, token=token: self._op_done(token, fut))
+                    lambda fut, token=token, op=op: self._op_done(
+                        token, fut, op))
         journal = self._journal
         if journal is not None and not parked:
             # Write-ahead ordering: the record reaches the journal before
@@ -521,6 +563,12 @@ class CommandExecutor:
             # the pipeline window instead of paying one per run.
             try:
                 journal.append_run(kind, live, defer=bool(self._ready))
+                if spans:
+                    t_j = self._clock()
+                    for s in spans:
+                        s.event("journaled", t_j)
+                    if token.run_span is not None:
+                        token.run_span.event("journaled", t_j)
             except Exception as exc:
                 # A journal that cannot accept the record must fail the
                 # ops — applying an unjournaled mutation would silently
@@ -531,6 +579,12 @@ class CommandExecutor:
                 token.failed = True
                 if m:
                     m.record_error(kind)
+                for s in spans:
+                    # Annotate BEFORE resolving futures: the done-callback
+                    # finishes the span, and the slowlog entry must carry
+                    # the injected seam.
+                    s.annotate(fault=type(exc).__name__,
+                               seam=getattr(exc, "seam", "journal_fsync"))
                 for op in live:
                     if not op.future.done():
                         op.future.set_exception(exc)
@@ -538,7 +592,18 @@ class CommandExecutor:
         try:
             fault_inject.fire("kernel_launch", kind=kind, target=target)
             self._backend.run(kind, target, live)
-            token.stage_s = self._clock() - t0
+            t_staged = self._clock()
+            token.stage_s = t_staged - t0
+            if spans:
+                # A synchronous backend resolves futures inside run(), so a
+                # span may already be finished here — don't stamp those (its
+                # device stage then absorbs run(), which is the truth for an
+                # inline backend).
+                for s in spans:
+                    if s.t1 is None:
+                        s.event("staged", t_staged)
+                if token.run_span is not None:
+                    token.run_span.event("staged", t_staged)
             od = getattr(self._policy, "observe_dispatch", None)
             if od is not None:
                 # Staging-side cost signal (host prep only — NOT service
@@ -561,6 +626,10 @@ class CommandExecutor:
             token.stage_s = self._clock() - t0
             if m:
                 m.record_error(kind)
+            for s in spans:
+                if s.t1 is None:
+                    s.annotate(fault=type(exc).__name__,
+                               seam=getattr(exc, "seam", "kernel_launch"))
             for op in live:
                 if not op.future.done():
                     op.future.set_exception(exc)
@@ -568,14 +637,33 @@ class CommandExecutor:
             # The waiter is parked (or was served/failed inline); drop the
             # gates and the window slot now — the fulfilling op must be able
             # to dispatch against this same target.
+            for s in spans:
+                # Parked kinds attach no done-callback — their latency is
+                # wait time. Close the span at park so it measures dispatch,
+                # not how long the waiter chose to wait.
+                if s.t1 is None:
+                    s.annotate(parked=True)
+                    s.finish()
             self._retire(token, completed=False)
 
     # -- completion path ----------------------------------------------------
 
-    def _op_done(self, token: _InflightRun, fut=None) -> None:
+    def _op_done(self, token: _InflightRun, fut=None, op: Optional[Op] = None) -> None:
         """Done-callback on each live op future; runs on whichever thread
         resolves it (the backend completer, or the dispatcher itself for
         synchronous backends)."""
+        if op is not None and op.span is not None and op.span.t1 is None:
+            span = op.span
+            span.event("completed")
+            err = None
+            if fut is not None and not fut.cancelled():
+                exc = fut.exception()
+                if exc is not None:
+                    err = type(exc).__name__
+                    seam = getattr(exc, "seam", None)
+                    if seam is not None:
+                        span.annotations.setdefault("seam", seam)
+            span.finish(error=err)
         if fut is not None and not fut.cancelled() and \
                 fut.exception() is not None:
             # A backend that isolates failures per op/group (the delta
@@ -637,6 +725,13 @@ class CommandExecutor:
             self._cv.notify_all()
 
     def _retire(self, token: _InflightRun, completed: bool) -> None:
+        run_span = token.run_span
+        if run_span is not None:
+            token.run_span = None
+            run_span.event("completed")
+            run_span.finish(
+                error=type(token.fault_exc).__name__
+                if token.fault_exc is not None else None)
         with self._cv:
             self._release_gates_locked(token)
             self._inflight.discard(token)
@@ -705,6 +800,9 @@ class CommandExecutor:
         for op in swept:
             if not op.future.done():
                 op.future.set_exception(exc_factory(op))
+            if op.span is not None and op.span.t1 is None:
+                op.span.annotate(swept=True)
+                op.span.finish(error="swept")
         return len(swept)
 
     def _cancel_remaining(self) -> None:
@@ -720,6 +818,8 @@ class CommandExecutor:
             if op.future.cancel():
                 op.future.set_running_or_notify_cancel()
                 cancelled += 1
+            if op.span is not None and op.span.t1 is None:
+                op.span.finish(error="CancelledError")
         if cancelled and self._metrics:
             self._metrics.record_cancelled(cancelled)
 

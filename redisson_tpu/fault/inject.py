@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -57,19 +58,27 @@ class FaultRule:
     (so a rule can model a fault that persists across retries)."""
 
     seam: str
-    fault: str = "retryable"  # key into FAULT_CLASSES
+    fault: str = "retryable"  # key into FAULT_CLASSES, or "stall"
     nth: int = 1
     times: int = 1
     kind: str = ""    # "" matches any op kind
     target: str = ""  # "" matches any target
+    # For fault="stall": sleep this long at the seam instead of raising —
+    # models a slow fsync / stuck transfer rather than a failed one (the
+    # trace smoke gate uses a journal_fsync stall to pin slowlog stage
+    # attribution).
+    delay_s: float = 0.0
 
     def __post_init__(self):
         if self.seam not in SEAMS:
             raise ValueError(f"unknown seam {self.seam!r}; one of {SEAMS}")
-        if self.fault not in FAULT_CLASSES:
+        if self.fault == "stall":
+            if self.delay_s <= 0.0:
+                raise ValueError("stall rules need delay_s > 0")
+        elif self.fault not in FAULT_CLASSES:
             raise ValueError(
                 f"unknown fault class {self.fault!r}; "
-                f"one of {tuple(FAULT_CLASSES)}")
+                f"one of {tuple(FAULT_CLASSES) + ('stall',)}")
         if self.nth < 1 or self.times < 1:
             raise ValueError("nth and times are 1-based and positive")
 
@@ -137,6 +146,7 @@ class FaultInjector:
         self.fired: List[Dict[str, Any]] = []  # audit log for tests
 
     def fire(self, seam: str, kind: str = "", target: str = "") -> None:
+        fired_rule: Optional[FaultRule] = None
         with self._lock:
             for i, rule in enumerate(self.plan.rules):
                 if not rule.matches(seam, kind, target):
@@ -149,14 +159,27 @@ class FaultInjector:
                         "seam": seam, "kind": kind, "target": target,
                         "rule": i, "hit": n, "fault": rule.fault,
                     })
-                    raise rule.make(seam, kind, target)
+                    fired_rule = rule
+                    break
+        if fired_rule is None:
+            return
+        if fired_rule.fault == "stall":
+            # Act OUTSIDE the lock: a stall models a slow (not failed)
+            # operation, and sleeping under the injector lock would
+            # serialize unrelated seams behind it.
+            time.sleep(fired_rule.delay_s)
+            return
+        raise fired_rule.make(seam, kind, target)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             return {
                 "injected": self.injected,
                 "hits": list(self._hits),
-                "fired": list(self.fired),
+                # Copy the entries too, not just the list: handing callers
+                # references to the live audit dicts lets a mutated snapshot
+                # corrupt the injector's own log.
+                "fired": [dict(e) for e in self.fired],
             }
 
 

@@ -116,12 +116,22 @@ class MetricsRegistry:
         return out
 
     def render_prometheus(self) -> str:
-        """Text exposition format (counters + gauges + histogram buckets)."""
+        """Text exposition format: counters, gauges, and *scrapeable*
+        histogram families — cumulative `_bucket{le=...}` series in
+        ascending bound order ending with the mandatory `le="+Inf"`
+        (Prometheus spells infinity that way, not `inf`), plus `_sum`
+        and `_count`. `_count` always equals the `+Inf` bucket."""
         snap = self.snapshot()
         lines: List[str] = []
 
         def sanitize(name: str) -> str:
             return name.replace(".", "_").replace("-", "_")
+
+        def fmt_bound(v: float) -> str:
+            if v == float("inf"):
+                return "+Inf"
+            s = repr(float(v))
+            return s[:-2] if s.endswith(".0") else s
 
         for k, v in sorted(snap["counters"].items()):
             lines.append(f"# TYPE {sanitize(k)} counter")
@@ -133,9 +143,19 @@ class MetricsRegistry:
             base = sanitize(k)
             lines.append(f"# TYPE {base} histogram")
             cumulative = 0
+            saw_inf = False
             for le, c in h["buckets"].items():
+                bound = float(le)
                 cumulative += c
-                lines.append(f'{base}_bucket{{le="{le}"}} {cumulative}')
+                saw_inf = saw_inf or bound == float("inf")
+                lines.append(
+                    f'{base}_bucket{{le="{fmt_bound(bound)}"}} {cumulative}')
+            if not saw_inf:
+                # Custom bucket ladders without an explicit inf bound still
+                # need the +Inf series (scrapers reject histograms without
+                # it); overflow observations were clamped into the last
+                # bucket, so the running cumulative == count here.
+                lines.append(f'{base}_bucket{{le="+Inf"}} {h["count"]}')
             lines.append(f"{base}_sum {h['sum']}")
             lines.append(f"{base}_count {h['count']}")
         return "\n".join(lines) + "\n"
@@ -421,6 +441,23 @@ def register_fault(registry: MetricsRegistry, manager) -> None:
     registry.gauge(
         "fault.watchdog_trips",
         lambda: manager.watchdog.trips if manager.watchdog else 0)
+
+
+def register_trace(registry: MetricsRegistry, manager) -> None:
+    """Expose the trace subsystem (trace/) as trace.* gauges: sampling
+    volume, span throughput, slowlog pressure and monitor fan-out health.
+    `manager` is a trace.manager.TraceManager."""
+    tracer = manager.tracer
+    registry.gauge("trace.sampled", lambda: tracer.sampled)
+    registry.gauge("trace.skipped", lambda: tracer.skipped)
+    registry.gauge("trace.spans_finished", lambda: tracer.finished)
+    registry.gauge("trace.slowlog_len", lambda: len(manager.slowlog))
+    registry.gauge("trace.slowlog_total",
+                   lambda: manager.slowlog.total_logged)
+    registry.gauge("trace.monitor_subscribers",
+                   lambda: manager.monitor.active())
+    registry.gauge("trace.monitor_dropped", lambda: manager.monitor.dropped())
+    registry.gauge("trace.retries", lambda: manager.retries)
 
 
 def register_follower(registry: MetricsRegistry, follower) -> None:

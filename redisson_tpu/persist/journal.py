@@ -223,6 +223,10 @@ class Journal:
         self._segment_max = max(1 << 16, int(segment_max_bytes))
         os.makedirs(self.path, exist_ok=True)
         self._io = threading.RLock()
+        # Trace manager (trace/manager.py) or None: every fsync's duration
+        # is reported so slow durability shows up in LATENCY HISTORY /
+        # the fsync histogram even for unsampled ops.
+        self._trace = None
         self._listeners: List[Callable[[List[JournalRecord]], None]] = []
         self._dirty = False
         self._unsynced_runs = 0
@@ -391,17 +395,27 @@ class Journal:
 
     # -- durability ---------------------------------------------------------
 
+    def set_trace(self, trace) -> None:
+        """Attach/detach the trace manager's fsync-duration hook."""
+        with self._io:
+            self._trace = trace
+
     def sync(self) -> None:
         """Flush + fsync everything appended so far (group commit point)."""
         with self._io:
             if not self._dirty or self._closed:
                 return
+            trace = self._trace
+            t0 = time.monotonic() if trace is not None else 0.0
             # Fault seam: a failed fsync propagates to the caller — the
             # executor's journal-append path classifies it RetryableFault
-            # (write-ahead: no state committed for the unsynced records).
+            # (write-ahead: no state committed for the unsynced records);
+            # a "stall" rule sleeps here and is measured as fsync time.
             fault_inject.fire("journal_fsync")
             self._f.flush()
             os.fsync(self._f.fileno())
+            if trace is not None:
+                trace.record_fsync(time.monotonic() - t0)
             self._fsyncs += 1
             self._group_sum += self._unsynced_runs
             self._unsynced_runs = 0

@@ -147,6 +147,9 @@ class ServingLayer:
             half_open_probes=self._cfg.breaker_half_open_probes,
             clock=self._clock)
         self._timer = _Timer()
+        # Trace manager, when the executor carries one: admission stamps
+        # and retry-attempt annotations ride the sampled spans.
+        self._trace = getattr(executor, "trace", None)
         # Deterministic jitter source (seeded: replayable backoff in tests).
         self._rand = random.Random(0x5EED)
         self._tls = threading.local()
@@ -307,6 +310,15 @@ class ServingLayer:
             self._finish(outer, exc)
             return
         self._registry.inc("serve.admitted_total")
+        trace = self._trace
+        if trace is not None:
+            # Same-thread handoff: execute_async enqueues synchronously, so
+            # the executor-created span (if this op is sampled) inherits the
+            # admission timestamp and, on retries, the attempt number.
+            if attempt:
+                trace.tracer.annotate_next(admitted_at=now, attempt=attempt)
+            else:
+                trace.tracer.annotate_next(admitted_at=now)
         inner = self._executor.execute_async(target, kind, payload, nkeys,
                                              tenant=tenant, deadline=deadline)
         inner.add_done_callback(
@@ -345,6 +357,9 @@ class ServingLayer:
             delay *= 0.5 + self._rand.random() * 0.5  # jitter in [0.5x, 1x)
             if deadline is None or now + delay < deadline:
                 self._registry.inc("serve.retries_total")
+                if self._trace is not None:
+                    self._trace.retry_event(kind, target, tenant,
+                                            attempt + 1, delay)
 
                 def _resubmit() -> None:
                     # Retries never re-charge tenant tokens: the op was
@@ -428,6 +443,11 @@ class ServingLayer:
             # al.): is the write path actually shipping planes, and how
             # many fused launches is each window costing?
             "ingest": ingest_stats() if callable(ingest_stats) else None,
+            # Trace block: sampling counters, slowlog/monitor state, and
+            # per-(kind, tenant) latency quantiles — the "where did the
+            # 40 ms go" view next to the queue/journal gauges above.
+            "trace": (self._trace.snapshot()
+                      if self._trace is not None else None),
             "counters": {
                 k: v for k, v in
                 self._registry.snapshot()["counters"].items()

@@ -48,6 +48,51 @@ def test_prometheus_rendering():
     assert 'lat_bucket{le="0.01"}' in text
 
 
+def test_prometheus_histogram_exposition():
+    r = MetricsRegistry()
+    r.observe("lat", 0.005)
+    r.observe("lat", 2.0)
+    text = r.render_prometheus()
+    assert "# TYPE lat histogram" in text
+    # The overflow bucket must be spelled +Inf, never Python's "inf".
+    assert 'lat_bucket{le="+Inf"} 2' in text
+    assert 'le="inf"' not in text
+    counts = [int(line.rsplit(" ", 1)[1])
+              for line in text.splitlines() if line.startswith("lat_bucket")]
+    assert counts == sorted(counts)  # cumulative => non-decreasing
+    assert counts[-1] == 2  # +Inf bucket equals _count
+    assert "lat_count 2" in text
+    assert "lat_sum" in text
+
+
+def test_registry_snapshot_mutation_safe():
+    r = MetricsRegistry()
+    r.inc("ops.total", 3)
+    r.observe("lat", 0.005)
+    snap = r.snapshot()
+    snap["counters"]["ops.total"] = 999
+    snap["histograms"]["lat"]["buckets"].clear()
+    snap["histograms"]["lat"]["count"] = 0
+    snap2 = r.snapshot()
+    assert snap2["counters"]["ops.total"] == 3
+    assert snap2["histograms"]["lat"]["count"] == 1
+    assert sum(snap2["histograms"]["lat"]["buckets"].values()) == 1
+
+
+def test_fault_injector_snapshot_mutation_safe():
+    from redisson_tpu.fault.inject import FaultInjector, FaultPlan, FaultRule
+
+    inj = FaultInjector(FaultPlan(rules=[FaultRule(seam="journal_fsync")]))
+    with pytest.raises(Exception):
+        inj.fire("journal_fsync")
+    snap = inj.snapshot()
+    snap["fired"][0]["seam"] = "corrupted"
+    snap["hits"][0] = 999
+    snap2 = inj.snapshot()
+    assert snap2["fired"][0]["seam"] == "journal_fsync"
+    assert snap2["hits"][0] == 1
+
+
 def test_executor_metrics_flow(client):
     h = client.get_hyper_log_log("obs:h")
     h.add_all([b"k%d" % i for i in range(1000)])

@@ -470,6 +470,77 @@ def test_g008_suppression_with_reason():
     assert "G008" not in rules_of(findings)
 
 
+def test_g009_wallclock_in_latency_path_flagged():
+    findings = lint_src("""
+        import time
+
+        def measure(op):
+            t0 = time.time()
+            op()
+            return time.time() - t0
+    """)
+    assert "G009" in rules_of(findings)
+
+
+def test_g009_from_import_alias_flagged():
+    findings = lint_src("""
+        from time import time as now
+
+        def measure(op):
+            t0 = now()
+            op()
+            return now() - t0
+    """)
+    assert "G009" in rules_of(findings)
+
+
+def test_g009_monotonic_ok():
+    findings = lint_src("""
+        import time
+
+        def measure(op):
+            t0 = time.monotonic()
+            op()
+            return time.monotonic() - t0
+    """)
+    assert "G009" not in rules_of(findings)
+
+
+def test_g009_scoped_to_latency_paths():
+    src = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    trace = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "trace", "spans.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    persist = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "persist", "journal.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    serve = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "serve", "scheduler.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    cold = FileLinter(
+        os.path.join(REPO, "redisson_tpu", "models", "foo.py"),
+        repo_root=REPO, source=textwrap.dedent(src)).run()
+    assert "G009" in rules_of(trace)
+    assert "G009" in rules_of(persist)
+    assert "G009" in rules_of(serve)
+    assert "G009" not in rules_of(cold)
+
+
+def test_g009_suppression_with_reason():
+    findings = lint_src("""
+        import time
+
+        def stamp():
+            return time.time()  # graftlint: allow-wallclock(display-only entry timestamp)
+    """)
+    assert "G009" not in rules_of(findings)
+
+
 def test_g007_registry_coverage():
     """Every OP_TABLE kind behaves per its write flag: all write kinds are
     flagged when dispatched as a literal `.run`, no read kind ever is. Pins

@@ -29,6 +29,12 @@ failure modes (see findings.RULES). Scope notes:
   state-uncertain) and the serve retry / HBM rebuild machinery can fire.
   Handlers that deliberately swallow (completer isolation, background
   fsync backstops) carry reasoned ``allow-bare`` suppressions.
+* G009 (wallclock) applies to the latency-measuring paths under
+  ``redisson_tpu/`` (executor.py, serve/, persist/, trace/) — unless the
+  file was passed explicitly. ``time.time()`` there poisons duration math
+  (NTP steps, slew); durations must come from ``time.monotonic()``.
+  Display-only wall timestamps (e.g. the slowlog's human-readable entry
+  time) carry reasoned ``allow-wallclock`` suppressions.
 * G007 (journal) applies everywhere under ``redisson_tpu/`` except
   executor.py (the commit point that OWNS the journal hook). It flags
   ``anything.run("<kind>", ...)`` where the literal kind is a write op in
@@ -134,6 +140,7 @@ class FileLinter:
         self._g002_on = self.explicit or self._in_sync_scope()
         self._g006_on = self.explicit or self._in_block_scope()
         self._g007_on = self.explicit or self._in_journal_scope()
+        self._g009_on = self.explicit or self._in_wallclock_scope()
         # G008 is scope-only (never `explicit`): outside the device/persist
         # fault boundary a broad except is usually deliberate best-effort
         # isolation (bench harnesses, CLI wrappers), not a leak.
@@ -213,6 +220,18 @@ class FileLinter:
             or sub.startswith("persist/")
             or sub.startswith("backend")
             or sub.startswith("parallel/backend")
+        )
+
+    def _in_wallclock_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        return (
+            sub == "executor.py"
+            or sub.startswith("serve/")
+            or sub.startswith("persist/")
+            or sub.startswith("trace/")
         )
 
     def _in_journal_scope(self) -> bool:
@@ -305,6 +324,8 @@ class FileLinter:
                 self._check_g006(node)
             if self._g007_on:
                 self._check_g007(node)
+            if self._g009_on:
+                self._check_g009(node)
             self._check_jit_construction(node, in_func, in_loop)
             if self._pallas_file:
                 self._check_pallas_call(node, fn_node)
@@ -510,6 +531,28 @@ class FileLinter:
             "delegation (already downstream of the hook) or deliberately "
             "unjournaled maintenance, add "
             "`# graftlint: allow-journal(reason)`",
+        )
+
+    # -- G009: wall-clock timing in latency code ------------------------------
+
+    def _check_g009(self, call: ast.Call) -> None:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if not (f.attr == "time" and self._is_alias(f.value, "time")):
+                return
+        elif isinstance(f, ast.Name):
+            if self._full(f.id) != "time.time":
+                return
+        else:
+            return
+        self._emit(
+            "G009", call,
+            "`time.time()` in a latency-measuring path — wall clocks step "
+            "and slew (NTP), so durations computed from them are wrong "
+            "exactly when operators are debugging an incident",
+            "use time.monotonic() for anything subtracted; if this value is "
+            "a display-only wall timestamp (never differenced), add "
+            "`# graftlint: allow-wallclock(reason)`",
         )
 
     # -- G003: recompilation hazards ----------------------------------------

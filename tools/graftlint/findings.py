@@ -83,6 +83,12 @@ RULES = {
         "routed through fault.classify() — raw XLA/IO errors leak to "
         "callers untyped, so the serve retry and rebuild paths never fire",
     ),
+    "G009": (
+        "wallclock",
+        "wall-clock timing in latency code: time.time() in a dispatch/"
+        "serve/persist/trace path — NTP steps and clock slew corrupt "
+        "durations; latency math must use time.monotonic()",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
