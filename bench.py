@@ -509,6 +509,45 @@ def bench_read_cache(n, reps=20):
         client.shutdown()
 
 
+def bench_memstat(n, sketches=64):
+    """HBM byte accounting (memstat tentpole): run a mixed ingest
+    workload, then read the always-on ledger — live device bytes,
+    scratch/staging overhead, and bytes per addressable key — and check
+    the exact invariant (ledger == sum of live Array.nbytes) held."""
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    client = RedissonTPU.create(Config())
+    try:
+        rng = np.random.default_rng(29)
+        per = max(1, n // sketches)
+        for i in range(sketches):
+            h = client.get_hyper_log_log(f"bench:mem:h{i}")
+            h.add_ints(rng.integers(0, 2**63, size=per, dtype=np.uint64))
+        bits = client.get_bit_set("bench:mem:bits")
+        bits.set(n % 65536, True)
+        stats = client.memory_stats()
+        verify = client.memory_verify()
+        totals = client.memstat.meter_totals()
+        out = {
+            "hbm_live_bytes": stats["dataset.bytes"],
+            "hbm_scratch_bytes": totals["scratch"] + totals["staging"],
+            "bytes_per_key": stats["keys.bytes-per-key"],
+            "hbm_peak_bytes": stats["peak.allocated"],
+            "drift_bytes": verify["drift_bytes"],
+        }
+        print(
+            f"# memstat: {out['hbm_live_bytes']} live HBM bytes across "
+            f"{stats['keys.count']} keys ({out['bytes_per_key']} B/key, "
+            f"scratch {out['hbm_scratch_bytes']}), drift "
+            f"{out['drift_bytes']}",
+            file=sys.stderr,
+        )
+        return out
+    finally:
+        client.shutdown()
+
+
 def bench_journal_overhead(rounds=200, reps=3):
     """Write-ahead journal tax (PR 6): the batched-insert path with the
     everysec journal hooked into the dispatcher vs the same client without
@@ -810,6 +849,14 @@ def main():
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)
     except Exception as exc:  # noqa: BLE001
         print(f"# pfmerge bench failed: {exc!r}", file=sys.stderr)
+    try:
+        mem = bench_memstat(1 << 12 if quick else 1 << 18)
+        result["hbm_live_bytes"] = mem["hbm_live_bytes"]
+        result["hbm_scratch_bytes"] = mem["hbm_scratch_bytes"]
+        result["bytes_per_key"] = mem["bytes_per_key"]
+        result["memstat"] = mem
+    except Exception as exc:  # noqa: BLE001
+        print(f"# memstat bench failed: {exc!r}", file=sys.stderr)
     try:
         from redisson_tpu.ingest.planner import default_planner
 

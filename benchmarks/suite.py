@@ -1337,6 +1337,152 @@ def trace_smoke():
     return ok
 
 
+def mem_smoke():
+    """memstat acceptance smoke (the CPU-only CI contract for the byte-
+    accounting tentpole). Three gates:
+
+      (a) CHURN: randomized create/grow/delete/rename/flushall churn —
+          verify() must report zero drift (ledger == sum of live
+          Array.nbytes) at the end, and flushall must return the ledger
+          to exactly zero bytes;
+      (b) OVERHEAD: the ingest workload with the always-on ledger
+          attached must cost < 1% wall over the same client with the
+          accounting seams detached — every hook is one dict update
+          under a lock the store already holds;
+      (c) WATERMARK: with a 1-byte high-watermark, a memory-growing
+          write must shed with RejectedError (retry-after hinted) while
+          a concurrent read on the same client succeeds — graceful
+          degradation, not device OOM.
+    """
+    import random
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.serve.errors import RejectedError
+
+    rounds = 120 if _TINY else 400
+    batch = 4096
+    rng = np.random.default_rng(23)
+    pool = rng.integers(0, 2**63, size=(32, batch), dtype=np.uint64)
+
+    def make_cfg(serve=False, watermark=0):
+        cfg = Config()
+        cfg.use_local()
+        if serve:
+            cfg.use_serve()
+        if watermark:
+            mc = cfg.use_memstat()
+            mc.high_watermark_bytes = watermark
+            mc.retry_after_s = 0.5
+        return cfg
+
+    def run_workload(c):
+        h = c.get_hyper_log_log("ms:hll")
+        bits = c.get_bit_set("ms:bits")
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            h.add_ints(pool[i % 32])
+            bits.set(i % 1999, True)
+        h.count()
+        return time.perf_counter() - t0
+
+    ok = True
+
+    # -- (a) zero drift under churn ------------------------------------
+    c = RedissonTPU.create(make_cfg())
+    try:
+        prng = random.Random(0x4D454D)
+        live = set()
+        for i in range(rounds):
+            roll = prng.random()
+            if roll < 0.4:
+                c.get_hyper_log_log("ms:h%d" % prng.randrange(8)).add(
+                    b"v%d" % i)
+            elif roll < 0.7:
+                name = "ms:b%d" % prng.randrange(8)
+                c.get_bit_set(name).set(prng.randrange(8192))
+                live.add(name)
+            elif roll < 0.85 and live:
+                c.delete(live.pop())
+            elif live:
+                src = live.pop()
+                dst = "ms:rn%d" % prng.randrange(4)
+                if c._store.exists(src):
+                    c._store.rename(src, dst)
+                    live.add(dst)
+        v = c.memory_verify()
+        st = c.memory_stats()
+        print(f"# mem-smoke[churn]: {c.memstat.events()} ledger events, "
+              f"{st['keys.count']} keys, {st['dataset.bytes']} live bytes "
+              f"(peak {st['peak.allocated']}), drift {v['drift_bytes']}")
+        if not v["ok"]:
+            print(f"#   ledger drift after churn: {v}", file=sys.stderr)
+            ok = False
+        c.flushall()
+        after = c.memstat.live_bytes()
+        if after != 0 or not c.memory_verify()["ok"]:
+            print(f"#   post-flushall ledger at {after} bytes, not 0",
+                  file=sys.stderr)
+            ok = False
+    finally:
+        c.shutdown()
+
+    # -- (b) always-on accounting overhead -----------------------------
+    def best_wall(detach):
+        c = RedissonTPU.create(make_cfg())
+        try:
+            if detach:
+                c._store.accounting = None
+                sketch = getattr(c._routing, "sketch", None)
+                if sketch is not None and hasattr(sketch, "accounting"):
+                    sketch.accounting = None
+            run_workload(c)  # warm compile/caches
+            c.flushall()
+            best = float("inf")
+            for _ in range(3 if _TINY else 2):
+                best = min(best, run_workload(c))
+                c.flushall()
+            return best
+        finally:
+            c.shutdown()
+
+    bare = best_wall(detach=True)
+    wired = best_wall(detach=False)
+    over = 100.0 * (wired / bare - 1.0)
+    print(f"# mem-smoke[overhead]: {bare * 1e3:.1f} ms detached -> "
+          f"{wired * 1e3:.1f} ms ledgered ({over:+.2f}%)")
+    if over >= 1.0:
+        print(f"#   ledger overhead {over:.2f}% >= 1% budget",
+              file=sys.stderr)
+        ok = False
+
+    # -- (c) watermark shedding, reads flow ----------------------------
+    c = RedissonTPU.create(make_cfg(serve=True, watermark=1))
+    try:
+        bits = c.get_bit_set("ms:wm")
+        bits.set(7, True)  # admitted: the gate saw an empty ledger
+        shed = None
+        try:
+            bits.set(8, True)  # live bytes now >= 1 -> must shed
+        except RejectedError as exc:
+            shed = exc
+        read_ok = bits.get(7) is True and bits.cardinality() == 1
+        hint = getattr(shed, "retry_after_s", 0.0)
+        print(f"# mem-smoke[watermark]: write "
+              f"{'shed (retry-after %.1fs)' % hint if shed else 'ADMITTED'},"
+              f" concurrent read {'ok' if read_ok else 'FAILED'}")
+        if shed is None or shed.reason != "memory" or hint <= 0:
+            print("#   write above the watermark was not shed with a "
+                  "retry-after hint", file=sys.stderr)
+            ok = False
+        if not read_ok:
+            print("#   read failed while writes shed", file=sys.stderr)
+            ok = False
+    finally:
+        c.shutdown()
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -1373,6 +1519,12 @@ def main():
                     help="fsync-policy sweep {none,off,everysec,always}: "
                          "journal overhead per policy + kill-and-recover "
                          "digest identity, then exit")
+    ap.add_argument("--mem-smoke", action="store_true",
+                    help="memstat acceptance: zero ledger drift after "
+                         "randomized churn (and after flushall), < 1% "
+                         "always-on accounting overhead vs detached "
+                         "seams, and watermark write-shedding with a "
+                         "retry-after hint while reads flow, then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -1394,6 +1546,9 @@ def main():
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)
+
+    if args.mem_smoke:
+        sys.exit(0 if mem_smoke() else 1)
 
     if args.trace_smoke:
         sys.exit(0 if trace_smoke() else 1)

@@ -283,6 +283,19 @@ class EpochReadCache:
         with self._lock:
             self._data.clear()
 
+    def content_bytes(self) -> int:
+        """Approximate bytes held by cached values (memstat 'cache'
+        meter): array results report nbytes, scalars their host size."""
+        import sys
+
+        with self._lock:
+            vals = [ent[1] for ent in self._data.values()]
+        total = 0
+        for v in vals:
+            nb = getattr(v, "nbytes", None)
+            total += int(nb) if nb is not None else sys.getsizeof(v)
+        return total
+
     def stats(self) -> dict:
         with self._lock:
             total = self.hits + self.misses
@@ -543,7 +556,11 @@ class TpuBackend:
             "merge_launches": 0,  # fused delta_merge_stack launches
             "delta_runs": 0,      # executor runs retired via the delta path
             "delta_keys": 0,      # keys folded into delta planes
+            "delta_scratch_bytes": 0,  # in-flight delta plane bytes (meter)
         }
+        self._scratch_lock = threading.Lock()
+        # memstat ledger (MemLedger-shaped); bank lifecycle hooks feed it.
+        self.accounting = None
 
     # row-map views (tests and the durability duck type read these)
     @property
@@ -565,7 +582,16 @@ class TpuBackend:
     def _grow_bank(self, new_cap: int) -> int:
         """RowAllocator grow hook: double the device bank in place."""
         self.bank = engine.hll_bank_grow(self._ensure_bank(), new_cap)
+        self._account_bank()
         return new_cap
+
+    def _account_bank(self) -> None:
+        """Report the shared HLL bank's device bytes to the memstat
+        ledger (create/grow/flushall are the only size changes)."""
+        acct = self.accounting
+        if acct is not None:
+            acct.set_bank_bytes(
+                int(self.bank.nbytes) if self.bank is not None else 0)
 
     def _plan_ingest(self, nkeys: int, allow_delta: bool = False) -> str:
         """Resolve one run's HLL insert path: 'delta', 'hostfold' or a
@@ -1004,7 +1030,21 @@ class TpuBackend:
                         if not op.future.done():
                             op.future.set_result(bits)
 
-        self.completer.submit(run)
+        # In-flight delta plane bytes (memstat scratch meter): charged for
+        # the window between launch and completion, released even when the
+        # completer path fails an op.
+        scratch_inflight = sum(int(p.plane_bytes) for p in planes)
+        with self._scratch_lock:
+            self.counters["delta_scratch_bytes"] += scratch_inflight
+
+        def run_and_release():
+            try:
+                run()
+            finally:
+                with self._scratch_lock:
+                    self.counters["delta_scratch_bytes"] -= scratch_inflight
+
+        self.completer.submit(run_and_release)
 
     def ingest_stats(self) -> dict:
         """Cumulative delta-ingest counters + the derived per-key link
@@ -1016,6 +1056,17 @@ class TpuBackend:
             / max(self.counters["delta_keys"], 1))
         return out
 
+    def scratch_bytes(self) -> dict:
+        """Host-side scratch byte meters (memstat 'scratch' category):
+        bloom mirror replicas + delta planes currently in flight."""
+        mirrors = 0
+        for m in list(self._bloom_mirrors.values()):
+            bits = m.get("bits") if isinstance(m, dict) else None
+            mirrors += int(getattr(bits, "nbytes", 0) or 0)
+        with self._scratch_lock:
+            delta = self.counters.get("delta_scratch_bytes", 0)
+        return {"bloom_mirrors": mirrors, "delta_scratch": delta}
+
     # -- HLL (bank-backed) --------------------------------------------------
 
     def _ensure_bank(self):
@@ -1025,6 +1076,7 @@ class TpuBackend:
             self.bank = jax.device_put(
                 engine.hll_bank_make(self.bank_capacity), self.store.device
             )
+            self._account_bank()
         return self.bank
 
     def _hll_row(self, name: str, create: bool = True):
@@ -2248,5 +2300,6 @@ class TpuBackend:
         self._epochs.clear()
         self.read_cache.clear()
         self.store.flushall()
+        self._account_bank()
         for op in ops:
             op.future.set_result(None)

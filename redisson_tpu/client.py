@@ -98,12 +98,50 @@ class RedissonTPU:
         from redisson_tpu.observability import MetricsRegistry
 
         self.metrics = MetricsRegistry()
+        # memstat (memstat/): the exact byte ledger is ALWAYS on and must
+        # be wired before any traffic can flow — persist recovery below
+        # replays ops through the store seam, and those bytes must land
+        # in the ledger like live traffic.
+        from redisson_tpu.memstat import (MemLedger, MemoryReport,
+                                          PressureMonitor)
+        from redisson_tpu.observability import register_memstat
+
+        self.memstat = MemLedger()
+        self._store.accounting = self.memstat
+        if hasattr(sketch, "_account_bank"):
+            # Single-chip tier: bank lifecycle hooks + scratch meters.
+            sketch.accounting = self.memstat
+            sketch._account_bank()
+            self.memstat.register_meter(
+                "backend.bloom_mirrors",
+                lambda s=sketch: s.scratch_bytes()["bloom_mirrors"],
+                "scratch")
+            self.memstat.register_meter(
+                "backend.delta_scratch",
+                lambda s=sketch: s.scratch_bytes()["delta_scratch"],
+                "scratch")
         self._build_executor(self._routing, max_batch_keys=tcfg.max_batch_keys)
+        self.memstat.register_meter(
+            "executor.staging", self._executor.staging_bytes, "staging")
+        mcfg = self.config.memory
+        self._pressure = None
+        if mcfg is not None and mcfg.high_watermark_bytes > 0:
+            self._pressure = PressureMonitor(self.memstat, mcfg)
+        self._memreport = MemoryReport(
+            self.memstat, store=self._store, backend=sketch,
+            pressure=self._pressure)
+        register_memstat(self.metrics, self.memstat, self._pressure)
+        if self.serve is not None:
+            self.serve.attach_memstat(self.memstat, self._pressure)
+        if self.trace is not None:
+            self.trace.attach_memstat(self.memstat)
         cache = getattr(sketch, "read_cache", None)
         if cache is not None:
             from redisson_tpu.observability import register_read_cache
 
             register_read_cache(self.metrics, cache)
+            self.memstat.register_meter(
+                "backend.read_cache", cache.content_bytes, "cache")
         if callable(getattr(sketch, "ingest_stats", None)):
             from redisson_tpu.observability import register_delta_ingest
 
@@ -139,6 +177,16 @@ class RedissonTPU:
                 journal = self._executor.journal
                 if journal is not None:
                     journal.set_trace(self.trace)
+            # On-disk byte meters (memstat 'disk' category): journal
+            # segments + kept snapshot directories.
+            journal = self._executor.journal
+            if journal is not None:
+                self.memstat.register_meter(
+                    "persist.journal", journal.disk_bytes, "disk")
+            if self._persist.snapshotter is not None:
+                self.memstat.register_meter(
+                    "persist.snapshots",
+                    self._persist.snapshotter.disk_bytes, "disk")
         # Fault subsystem (fault/): taxonomy is always active (the backends
         # classify unconditionally); injection / watchdog / self-healing
         # rebuild only attach when Config.use_faults() was called. Wired
@@ -336,6 +384,11 @@ class RedissonTPU:
             self._resp, hash_seed=getattr(self.config.redis, "hash_seed", 0))
         self._store = None
         self._widths = (16, 32, 64, 128, 256)
+        # Passthrough mode holds no device state: the server owns memory
+        # introspection (MEMORY USAGE et al. against the real server).
+        self.memstat = None
+        self._pressure = None
+        self._memreport = None
         self.metrics = MetricsRegistry()
         self._build_executor(self._backend)
         # Observability for the blocking-pop silent-loss window (reply
@@ -812,6 +865,53 @@ class RedissonTPU:
 
     def delete(self, name: str) -> bool:
         return self._dispatch.execute_sync(name, "delete", None)
+
+    # -- memory facade (MEMORY command family; memstat/) ---------------------
+
+    def _require_memreport(self, command: str):
+        if self._memreport is None:
+            raise RuntimeError(
+                f"{command} requires a device-backed mode; in redis "
+                "passthrough the server owns memory introspection")
+        return self._memreport
+
+    def memory_usage(self, name: str) -> Optional[int]:
+        """MEMORY USAGE analogue: exact device bytes + metadata overhead
+        for one key, or None when the key doesn't exist."""
+        return self._require_memreport("MEMORY USAGE").memory_usage(name)
+
+    def memory_stats(self):
+        """MEMORY STATS analogue over the byte ledger."""
+        return self._require_memreport("MEMORY STATS").memory_stats()
+
+    def memory_doctor(self):
+        """MEMORY DOCTOR analogue: rule-based findings dict."""
+        return self._require_memreport("MEMORY DOCTOR").memory_doctor()
+
+    def memory_verify(self):
+        """Ledger invariant check: ledger totals vs. the sum of live
+        Array.nbytes (zero drift when healthy)."""
+        if self.memstat is None or self._store is None:
+            raise RuntimeError("memory_verify requires a device-backed mode")
+        sketch = getattr(self._routing, "sketch", self._routing)
+        return self.memstat.verify(self._store, sketch)
+
+    def info(self, section: Optional[str] = None):
+        """INFO analogue: dict of section dicts (server, memory,
+        persistence). `section` filters to one block, like INFO MEMORY."""
+        sections = {
+            "server": {"mode": self._mode, "client_id": str(self.id)},
+        }
+        if self._memreport is not None:
+            sections["memory"] = self._memreport.info_memory()
+        if getattr(self, "_persist", None) is not None:
+            sections["persistence"] = self._persist.stats()
+        if section is not None:
+            key = section.lower()
+            if key not in sections:
+                raise ValueError(f"unknown INFO section '{section}'")
+            return {key: sections[key]}
+        return sections
 
     # -- lifecycle ----------------------------------------------------------
 

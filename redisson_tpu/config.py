@@ -235,6 +235,32 @@ class TraceConfig:
 
 
 @dataclass
+class MemConfig:
+    """Memory watermarks and pressure behavior (memstat/).
+
+    The byte ledger itself is always on; this section only configures
+    the pressure gate (maxmemory analogue). high_watermark_bytes == 0
+    disables shedding entirely."""
+
+    # Shed memory-growing writes at/above this total (0 = never shed).
+    high_watermark_bytes: int = 0
+    # Hysteresis: once shedding, resume writes only below this (0 =>
+    # same as high_watermark_bytes, i.e. no hysteresis band).
+    low_watermark_bytes: int = 0
+    # Count cache/scratch/staging meters toward the watermark total.
+    include_overhead: bool = True
+    # Growth-rate EWMA halflife for the time-to-watermark forecast.
+    ewma_halflife_s: float = 30.0
+    # retry-after hint attached to shed RejectedErrors.
+    retry_after_s: float = 1.0
+    # Meter sampling throttle on the admission path (seconds).
+    meter_refresh_s: float = 0.05
+    # MEMORY DOCTOR warns when usage exceeds this fraction of the
+    # high-watermark.
+    doctor_watermark_ratio: float = 0.9
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -248,6 +274,8 @@ class Config:
     faults: Optional[FaultConfig] = None
     # Trace subsystem (None = no spans/slowlog/monitor, the seed behavior).
     trace: Optional[TraceConfig] = None
+    # Memory watermarks/pressure (None = ledger only, never shed).
+    memory: Optional[MemConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -308,6 +336,10 @@ class Config:
         self.trace = self.trace or TraceConfig()
         return self.trace
 
+    def use_memstat(self) -> "MemConfig":
+        self.memory = self.memory or MemConfig()
+        return self.memory
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -341,6 +373,7 @@ class Config:
             "persist": PersistConfig,
             "faults": FaultConfig,
             "trace": TraceConfig,
+            "memory": MemConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

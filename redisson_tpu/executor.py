@@ -105,6 +105,30 @@ class Op:
     span: Any = None
 
 
+def _op_payload_nbytes(op: Op) -> int:
+    """Best-effort payload byte size for the staging meter: arrays report
+    nbytes (metadata read, no sync); dict payloads sum their array
+    members; everything else (scalars, callables) is uncounted."""
+    p = op.payload
+    nb = getattr(p, "nbytes", None)
+    if nb is not None:
+        try:
+            return int(nb)
+        except (TypeError, ValueError):
+            return 0
+    if isinstance(p, dict):
+        total = 0
+        for v in p.values():
+            vnb = getattr(v, "nbytes", None)
+            if vnb is not None:
+                try:
+                    total += int(vnb)
+                except (TypeError, ValueError):
+                    pass
+        return total
+    return 0
+
+
 class GreedyBatchPolicy:
     """The seed dispatch behavior as a policy object: drain whatever is
     queued up to the key cap, never hold a batch open. The serving layer
@@ -133,7 +157,7 @@ class _InflightRun:
     __slots__ = ("kind", "target", "targets", "is_global", "nops", "nkeys",
                  "t0", "queue_delay_s", "stage_s", "pending", "failed",
                  "op_failed", "overlapped", "depth", "gates_held", "lock",
-                 "ops", "fault_exc", "run_span")
+                 "ops", "fault_exc", "run_span", "staged_bytes")
 
     def __init__(self, kind: str, target: str, targets: frozenset,
                  is_global: bool):
@@ -156,6 +180,7 @@ class _InflightRun:
         self.ops: Sequence[Op] = ()  # live ops (watchdog trip / diagnostics)
         self.fault_exc = None  # first StateUncertainFault among the ops
         self.run_span = None  # parent trace span for this pipeline window
+        self.staged_bytes = 0  # payload bytes charged to the staging meter
 
 
 class CommandExecutor:
@@ -202,6 +227,7 @@ class CommandExecutor:
         self._inflight: set = set()  # _InflightRun tokens
         self._inflight_targets: set = set()  # gated object names
         self._inflight_kinds: set = set()  # gated GLOBAL_COALESCE kinds
+        self._staging_bytes = 0  # in-flight payload bytes (memstat meter)
         self._runs_completed = 0
         self._runs_overlapped = 0
         self._lock = threading.Lock()
@@ -529,6 +555,14 @@ class CommandExecutor:
         token.nops = len(live)
         token.nkeys = sum(op.nkeys for op in live)
         token.ops = live
+        # Staging meter (memstat): payload bytes held host-side while this
+        # run is in flight; released at retire. nbytes reads are
+        # aval/host-array metadata — no device sync on the hot path.
+        staged = sum(_op_payload_nbytes(op) for op in live)
+        if staged:
+            token.staged_bytes = staged
+            with self._cv:
+                self._staging_bytes += staged
         t0 = token.t0 = self._clock()
         token.queue_delay_s = t0 - min(op.enqueued_at for op in live)
         token.pending = len(live)
@@ -735,6 +769,8 @@ class CommandExecutor:
         with self._cv:
             self._release_gates_locked(token)
             self._inflight.discard(token)
+            self._staging_bytes -= token.staged_bytes
+            token.staged_bytes = 0
             if completed:
                 self._runs_completed += 1
                 if token.overlapped:
@@ -756,7 +792,13 @@ class CommandExecutor:
                 "runs_completed": done,
                 "runs_overlapped": self._runs_overlapped,
                 "overlap_ratio": (self._runs_overlapped / done) if done else 0.0,
+                "staging_bytes": self._staging_bytes,
             }
+
+    def staging_bytes(self) -> int:
+        """In-flight payload bytes (memstat 'staging' meter)."""
+        with self._lock:
+            return self._staging_bytes
 
     # -- fault-subsystem surface -------------------------------------------
 

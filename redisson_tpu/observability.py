@@ -102,17 +102,23 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            counters = dict(self._counters)
             gauges = {k: fn for k, fn in self._gauges.items()}
             hists = dict(self._histograms)
-        out: Dict[str, Any] = {"counters": counters, "gauges": {}, "histograms": {}}
+        out: Dict[str, Any] = {"gauges": {}, "histograms": {}}
         for k, fn in gauges.items():
+            # A raising gauge callback is dropped from this snapshot and
+            # counted, never poisons the rest (one broken subsystem must
+            # not take down the whole observability surface).
             try:
                 out["gauges"][k] = fn()
             except Exception:
-                out["gauges"][k] = None
+                self.inc("metrics.callback_errors")
         for k, h in hists.items():
             out["histograms"][k] = h.snapshot()
+        # Counters copied after gauge evaluation so callback_errors bumps
+        # from THIS snapshot are already visible in it.
+        with self._lock:
+            out["counters"] = dict(self._counters)
         return out
 
     def render_prometheus(self) -> str:
@@ -458,6 +464,30 @@ def register_trace(registry: MetricsRegistry, manager) -> None:
                    lambda: manager.monitor.active())
     registry.gauge("trace.monitor_dropped", lambda: manager.monitor.dropped())
     registry.gauge("trace.retries", lambda: manager.retries)
+
+
+def register_memstat(registry: MetricsRegistry, ledger,
+                     pressure=None) -> None:
+    """Expose the memstat ledger as memstat.* gauges: exact live/peak
+    device bytes, per-kind totals, sampled meter categories, and (when a
+    watermark is configured) the pressure gate's shed count. `ledger` is
+    a memstat.MemLedger; scrapes ride render_prometheus like every other
+    subsystem."""
+    registry.gauge("memstat.live_bytes", ledger.live_bytes)
+    registry.gauge("memstat.peak_bytes", ledger.peak_bytes)
+    registry.gauge("memstat.keys", ledger.keys_count)
+    registry.gauge("memstat.bank_bytes", ledger.bank_bytes)
+    registry.gauge("memstat.meter_errors", lambda: ledger.meter_errors)
+    for kind in ("hll", "bitset", "bloom"):
+        registry.gauge(f"memstat.{kind}_bytes",
+                       lambda k=kind: ledger.kind_bytes().get(k, 0))
+    for cat in ("cache", "scratch", "staging", "disk"):
+        registry.gauge(f"memstat.{cat}_bytes",
+                       lambda c=cat: ledger.meter_totals()[c])
+    if pressure is not None:
+        registry.gauge("memstat.shed_total", lambda: pressure.shed_total)
+        registry.gauge("memstat.high_watermark_bytes",
+                       lambda: pressure.config.high_watermark_bytes)
 
 
 def register_follower(registry: MetricsRegistry, follower) -> None:

@@ -521,6 +521,20 @@ class Journal:
         with self._io:
             return len(self._segments)
 
+    def disk_bytes(self) -> int:
+        """On-disk bytes across live segments (memstat 'disk' meter).
+        Sampled at report time only; a racing segment rotation/prune
+        tolerates the missing file."""
+        with self._io:
+            paths = [p for _, p in self._segments]
+        total = 0
+        for p in paths:
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
+
     def stats(self) -> Dict[str, Any]:
         with self._io:
             return {

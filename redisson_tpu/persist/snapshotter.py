@@ -200,6 +200,19 @@ class Snapshotter:
             shutil.rmtree(snap_path, ignore_errors=True)
             shutil.rmtree(snap_path + ".old", ignore_errors=True)
 
+    def disk_bytes(self) -> int:
+        """On-disk bytes across kept snapshot directories (memstat 'disk'
+        meter); tolerant of a concurrent prune removing files mid-walk."""
+        total = 0
+        for _, snap_path in find_snapshots(self.path):
+            for root, _dirs, files in os.walk(snap_path):
+                for f in files:
+                    try:
+                        total += os.path.getsize(os.path.join(root, f))
+                    except OSError:
+                        pass
+        return total
+
     def stats(self) -> Dict[str, Any]:
         return {
             "snapshots_taken": self.snapshots_taken,

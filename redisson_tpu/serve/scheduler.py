@@ -157,6 +157,18 @@ class ServingLayer:
                        lambda: self._admission.queue_stats()["queued_ops"])
         registry.gauge("serve.queued_keys",
                        lambda: self._admission.queue_stats()["queued_keys"])
+        # Memory-pressure gate (memstat/pressure.py) + ledger, installed
+        # by the client via attach_memstat. None = no watermark shedding.
+        self._pressure = None
+        self._memstat = None
+
+    def attach_memstat(self, ledger, pressure=None) -> None:
+        """Wire the byte ledger (snapshot 'memory' block) and, when a
+        high-watermark is configured, the pressure gate that sheds
+        memory-growing writes with RejectedError(reason='memory') while
+        reads keep flowing."""
+        self._memstat = ledger
+        self._pressure = pressure
 
     # -- tenant context -----------------------------------------------------
 
@@ -238,6 +250,15 @@ class ServingLayer:
             self._registry.inc("serve.deadline_expired_total", len(staged))
             return _fail_all(DeadlineExceeded(
                 "batch deadline passed before submission"))
+        if self._pressure is not None:
+            # One admission decision per batch: any memory-growing write
+            # kind above the watermark sheds the whole pipeline.
+            try:
+                for kind in {k for (_, k, _, _) in staged}:
+                    self._pressure.check_write(kind, now)
+            except RejectedError as exc:
+                self._count_shed(exc)
+                return _fail_all(exc)
         for kind in {k for (_, k, _, _) in staged}:
             wait = self._breakers.get(kind).peek(now)
             if wait > 0.0:
@@ -294,6 +315,17 @@ class ServingLayer:
             self._finish(outer, DeadlineExceeded(
                 f"op {kind}@{target}: deadline passed before submission"))
             return
+        if self._pressure is not None:
+            # Above the high-watermark, memory-growing writes shed with a
+            # retry-after; reads and reclaiming writes (DEL/FLUSHALL/
+            # RENAME) always pass. Checked before the breaker so no probe
+            # slot is consumed by a shed op.
+            try:
+                self._pressure.check_write(kind, now)
+            except RejectedError as exc:
+                self._count_shed(exc)
+                self._finish(outer, exc)
+                return
         breaker = self._breakers.get(kind)
         try:
             breaker.allow(now)
@@ -448,6 +480,16 @@ class ServingLayer:
             # 40 ms go" view next to the queue/journal gauges above.
             "trace": (self._trace.snapshot()
                       if self._trace is not None else None),
+            # Memory block: exact live/peak device bytes plus the pressure
+            # gate's watermark/forecast state (None until attach_memstat).
+            "memory": (dict(
+                live_bytes=self._memstat.live_bytes(),
+                peak_bytes=self._memstat.peak_bytes(),
+                kind_bytes=self._memstat.kind_bytes(),
+                meters=self._memstat.meter_totals(),
+                pressure=(self._pressure.snapshot()
+                          if self._pressure is not None else None),
+            ) if self._memstat is not None else None),
             "counters": {
                 k: v for k, v in
                 self._registry.snapshot()["counters"].items()

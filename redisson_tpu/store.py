@@ -62,6 +62,9 @@ class SketchStore:
         self._lock = threading.RLock()
         self._objects: Dict[str, StoredObject] = {}
         self.device = device if device is not None else jax.devices()[0]
+        # memstat ledger (MemLedger-shaped). Lifecycle events fire inside
+        # the registry lock so ledger ordering matches mutation ordering.
+        self.accounting: Optional[Any] = None
 
     @staticmethod
     def slot_of(name: str) -> int:
@@ -95,6 +98,10 @@ class SketchStore:
                     meta=dict(meta or {}),
                 )
                 self._objects[name] = obj
+                if self.accounting is not None:
+                    self.accounting.on_create(
+                        name, otype, int(state.nbytes), slot=obj.slot,
+                        tenant=str(obj.meta.get("tenant", "")))
         if obj.otype != otype:
             raise WrongTypeError(
                 f"key '{name}' holds {obj.otype}, operation needs {otype}"
@@ -112,11 +119,16 @@ class SketchStore:
                 return False
             obj.state = new_state
             obj.version += 1
+            if self.accounting is not None:
+                self.accounting.on_resize(name, int(new_state.nbytes))
             return True
 
     def delete(self, name: str) -> bool:
         with self._lock:
-            return self._objects.pop(name, None) is not None
+            gone = self._objects.pop(name, None) is not None
+            if gone and self.accounting is not None:
+                self.accounting.on_delete(name)
+            return gone
 
     def rename(self, name: str, new_name: str) -> bool:
         """Move an object under a new key (RENAME: destination overwritten)."""
@@ -127,6 +139,10 @@ class SketchStore:
             obj.name = new_name
             obj.slot = self.slot_of(new_name)
             self._objects[new_name] = obj
+            if self.accounting is not None:
+                # Ledger debits a clobbered destination (RENAME
+                # overwrites; Redis frees the old value).
+                self.accounting.on_rename(name, new_name, slot=obj.slot)
             return True
 
     def exists(self, name: str) -> bool:
@@ -145,6 +161,15 @@ class SketchStore:
     def flushall(self) -> None:
         with self._lock:
             self._objects.clear()
+            if self.accounting is not None:
+                self.accounting.on_flushall()
+
+    def live_nbytes(self) -> Dict[str, int]:
+        """Name -> device bytes for every live object (memstat verify
+        walks this; Array.nbytes is aval-derived, no device sync)."""
+        with self._lock:
+            return {n: int(o.state.nbytes)
+                    for n, o in self._objects.items()}
 
     def snapshot(self, name: str) -> Optional[jax.Array]:
         """Consistent read handle (immutability = free double buffering)."""

@@ -27,12 +27,18 @@ def _us(t: float) -> float:
 
 
 def chrome_trace(spans: Iterable[Span], t0: Optional[float] = None,
-                 t1: Optional[float] = None, pid: int = 1) -> Dict[str, Any]:
+                 t1: Optional[float] = None, pid: int = 1,
+                 counters: Optional[Iterable[Sequence]] = None
+                 ) -> Dict[str, Any]:
     """Build a Chrome trace-event dict from finished spans.
 
     ``t0``/``t1`` (tracer-clock seconds) clip to a time window.  Each op
     target gets its own ``tid`` row; runs go on a shared "runs" row so
     the pipeline window structure is visible above the ops it carries.
+    ``counters`` is an optional iterable of ``(name, t_seconds, value)``
+    samples rendered as Counter ("C") events — the memstat byte series
+    (live/scratch/staging) plot as filled area tracks above the spans
+    (see ``memstat_counters``).
     """
     events: List[Dict[str, Any]] = []
     tids: Dict[str, int] = {}
@@ -109,8 +115,34 @@ def chrome_trace(spans: Iterable[Span], t0: Optional[float] = None,
                     "tid": tid,
                     "args": {"span_id": span.span_id},
                 })
+    for name, t, value in (counters or ()):
+        if t0 is not None and t < t0:
+            continue
+        if t1 is not None and t > t1:
+            continue
+        events.append({
+            "name": name,
+            "cat": "memstat",
+            "ph": "C",
+            "ts": _us(t),
+            "pid": pid,
+            "args": {"bytes": value},
+        })
     events.sort(key=lambda e: e["ts"])
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def memstat_counters(ledger, now: float) -> List[tuple]:
+    """One Chrome-trace counter sample per memstat byte series at `now`
+    (tracer-clock seconds): feed accumulated samples into
+    ``chrome_trace(counters=...)`` to plot HBM usage over the window."""
+    totals = ledger.meter_totals()
+    return [
+        ("memstat.live_bytes", now, ledger.live_bytes()),
+        ("memstat.cache_bytes", now, totals["cache"]),
+        ("memstat.scratch_bytes", now, totals["scratch"]),
+        ("memstat.staging_bytes", now, totals["staging"]),
+    ]
 
 
 def _fmt(v: float) -> str:

@@ -14,6 +14,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from redisson_tpu.trace.export import (DEFAULT_BOUNDS_S, chrome_trace,
+                                       memstat_counters,
                                        prometheus_exposition)
 from redisson_tpu.trace.hist import HistogramSet
 from redisson_tpu.trace.monitor import Monitor
@@ -114,6 +115,12 @@ class TraceManager:
         self.registry = registry
         self.fsync_hist = HistogramSet()
         self.retries = 0
+        # memstat ledger (attach_memstat): finished spans stamp byte
+        # counter samples into a bounded ring, exported as Chrome-trace
+        # "C" events so HBM usage plots above the span tracks.
+        self.memstat = None
+        self._mem_samples: List[tuple] = []
+        self._mem_last_sample = -1.0
         self.tracer.add_sink(self._on_finish)
         # Pre-bound hot-path callables: begin_op runs for every enqueued
         # op, so shave the attribute hops off its fast path.
@@ -155,10 +162,22 @@ class TraceManager:
                          "kind": kind, "target": target, "tenant": tenant,
                          "attempt": attempt, "delay_s": delay_s})
 
+    def attach_memstat(self, ledger) -> None:
+        """Start sampling the byte ledger at span-finish time (throttled
+        to one sample per 50 ms of tracer clock, ring bounded)."""
+        self.memstat = ledger
+
     # -- span-finish fan-out ----------------------------------------------
     def _on_finish(self, span: Span) -> None:
         if span.span_type != "op":
             return
+        ledger = self.memstat
+        if ledger is not None and span.t1 is not None:
+            if span.t1 - self._mem_last_sample >= 0.050:
+                self._mem_last_sample = span.t1
+                self._mem_samples.extend(memstat_counters(ledger, span.t1))
+                if len(self._mem_samples) > 2048:
+                    del self._mem_samples[:len(self._mem_samples) - 2048]
         duration = span.duration_s
         self.hist.record(span.kind, span.tenant, duration)
         self.slowlog.offer(span)
@@ -176,7 +195,14 @@ class TraceManager:
     # -- parity / export surfaces -----------------------------------------
     def chrome_trace(self, t0: Optional[float] = None,
                      t1: Optional[float] = None) -> Dict[str, Any]:
-        return chrome_trace(self.tracer.ring(), t0=t0, t1=t1)
+        counters = list(self._mem_samples)
+        if self.memstat is not None:
+            # Close the counter track at "now" so the last plotted value
+            # reflects the current ledger, not the last finished span.
+            counters.extend(
+                memstat_counters(self.memstat, self.tracer.clock()))
+        return chrome_trace(self.tracer.ring(), t0=t0, t1=t1,
+                            counters=counters)
 
     def export_chrome(self, path: str, t0: Optional[float] = None,
                       t1: Optional[float] = None) -> int:

@@ -1,0 +1,578 @@
+"""memstat subsystem tests: the byte ledger, pressure gate, and MEMORY
+command-family parity.
+
+Layers:
+
+1. Unit — MemLedger lifecycle events on synthetic entries (create/
+   resize/delete/rename-clobber/flushall), peak monotonicity, meter
+   isolation, and verify() drift detection against a fake store.
+2. Seam — a real SketchStore with the ledger attached: every store
+   mutation keeps the invariant (ledger == sum of live Array.nbytes);
+   plus the keys(pattern) / rename-overwrites-dest store semantics the
+   ledger's clobber debit depends on.
+3. Pressure — EWMA forecasting on a fake clock, watermark shedding with
+   hysteresis, reclaim/read kinds always admitted.
+4. Integration — a real client: MEMORY USAGE/STATS/DOCTOR parity,
+   INFO folding, zero-drift verify after randomized churn on both HLL
+   engine tiers, end-to-end write shedding under a tiny watermark while
+   reads keep flowing, trace counter export, and registry gauges.
+"""
+
+import random
+
+import jax.numpy as jnp
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config, MemConfig
+from redisson_tpu.memstat import MemLedger, MemoryReport, PressureMonitor
+from redisson_tpu.memstat.accounting import BANK_ENTRY
+from redisson_tpu.observability import MetricsRegistry
+from redisson_tpu.serve.errors import RejectedError
+from redisson_tpu.store import SketchStore
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class FakeStore:
+    """Just enough store for verify(): a name -> nbytes mapping."""
+
+    def __init__(self, sizes):
+        self.sizes = dict(sizes)
+
+    def live_nbytes(self):
+        return dict(self.sizes)
+
+
+# ---------------------------------------------------------------------------
+# 1. ledger unit tests
+# ---------------------------------------------------------------------------
+
+def test_ledger_lifecycle_and_totals():
+    led = MemLedger()
+    led.on_create("a", "bitset", 1024, slot=3, tenant="t1")
+    led.on_create("b", "hll", 4096, slot=7)
+    assert led.live_bytes() == 5120
+    assert led.keys_count() == 2
+    assert led.kind_bytes() == {"bitset": 1024, "hll": 4096}
+    led.on_resize("a", 2048)
+    assert led.live_bytes() == 6144
+    led.on_delete("b")
+    assert led.live_bytes() == 2048
+    assert led.kind_bytes() == {"bitset": 2048}
+    e = led.entry("a")
+    assert e == {"kind": "bitset", "tenant": "t1", "slot": 3,
+                 "nbytes": 2048}
+    # events are counted; unknown-name resize/delete are no-ops
+    n = led.events()
+    led.on_resize("ghost", 512)
+    led.on_delete("ghost")
+    assert led.events() == n and led.live_bytes() == 2048
+
+
+def test_ledger_recreate_is_idempotent():
+    led = MemLedger()
+    led.on_create("a", "bitset", 1024)
+    led.on_create("a", "bloom", 4096)  # re-create: debit old, credit new
+    assert led.live_bytes() == 4096
+    assert led.kind_bytes() == {"bloom": 4096}
+    assert led.keys_count() == 1
+
+
+def test_ledger_rename_clobbers_destination():
+    led = MemLedger()
+    led.on_create("src", "bitset", 1000, slot=1)
+    led.on_create("dst", "bitset", 2000, slot=2)
+    led.on_rename("src", "dst", slot=2)
+    # dest bytes debited (Redis RENAME overwrites), source entry moved
+    assert led.live_bytes() == 1000
+    assert led.keys_count() == 1
+    assert led.entry("dst")["nbytes"] == 1000
+    assert led.entry("dst")["slot"] == 2
+    assert led.entry("src") is None
+
+
+def test_ledger_flushall_and_peak_monotone():
+    led = MemLedger()
+    peaks = []
+    led.on_create("a", "bitset", 10_000)
+    peaks.append(led.peak_bytes())
+    led.on_create("b", "hll", 50_000)
+    peaks.append(led.peak_bytes())
+    led.on_delete("b")
+    peaks.append(led.peak_bytes())
+    led.on_flushall()
+    peaks.append(led.peak_bytes())
+    assert led.live_bytes() == 0 and led.keys_count() == 0
+    assert led.kind_bytes() == {}
+    assert peaks == sorted(peaks)  # never decreases
+    assert led.peak_bytes() == 60_000
+
+
+def test_ledger_bank_entry_tracking():
+    led = MemLedger()
+    led.set_bank_bytes(1 << 20)
+    assert led.bank_bytes() == 1 << 20
+    assert led.live_bytes() == 1 << 20
+    assert led.kind_bytes() == {"hll": 1 << 20}
+    led.set_bank_bytes(1 << 21)  # grow
+    assert led.live_bytes() == 1 << 21
+    led.set_bank_bytes(0)  # dropped at flushall
+    assert led.bank_bytes() == 0 and led.live_bytes() == 0
+    assert led.keys_count() == 0
+
+
+def test_ledger_attribution_rollups():
+    led = MemLedger()
+    led.on_create("a", "bitset", 100, slot=1, tenant="t1")
+    led.on_create("b", "bitset", 200, slot=1, tenant="t2")
+    led.on_create("c", "hll", 400, slot=2)  # empty tenant -> "-"
+    attr = led.attribution()
+    assert attr["by_kind"] == {"bitset": 300, "hll": 400}
+    assert attr["by_tenant"] == {"t1": 100, "t2": 200, "-": 400}
+    assert attr["by_slot"] == {"1": 300, "2": 400}
+
+
+def test_ledger_meters_isolate_failures():
+    led = MemLedger()
+    led.register_meter("good", lambda: 4096, "cache")
+    led.register_meter("boom", lambda: 1 // 0, "scratch")
+    led.register_meter("disk", lambda: 1 << 30, "disk")
+    with pytest.raises(ValueError):
+        led.register_meter("bad", lambda: 0, "no-such-category")
+    m = led.meters()
+    assert m["good"] == {"bytes": 4096, "category": "cache"}
+    assert m["boom"]["bytes"] == 0  # broken meter reads 0, never raises
+    assert led.meter_errors >= 1
+    totals = led.meter_totals()
+    assert totals == {"cache": 4096, "scratch": 0, "staging": 0,
+                      "disk": 1 << 30}
+    # disk never counts toward device-adjacent overhead
+    assert led.overhead_bytes() == 4096
+    led.unregister_meter("disk")
+    assert led.meter_totals()["disk"] == 0
+
+
+def test_ledger_verify_detects_drift():
+    led = MemLedger()
+    led.on_create("a", "bitset", 100)
+    led.on_create("stale", "bitset", 50)
+    store = FakeStore({"a": 100, "missing": 70})
+    v = led.verify(store)
+    assert not v["ok"]
+    assert v["missing"] == ["missing"]
+    assert v["stale"] == ["stale"]
+    assert v["drift_bytes"] == 170 - 150
+    # mismatched byte count on a shared name
+    led2 = MemLedger()
+    led2.on_create("a", "bitset", 100)
+    v2 = led2.verify(FakeStore({"a": 120}))
+    assert v2["mismatched"] == {"a": {"ledger": 100, "actual": 120}}
+    # and the healthy case
+    v3 = led2.verify(FakeStore({"a": 100}))
+    assert v3["ok"] and v3["drift_bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. store seam
+# ---------------------------------------------------------------------------
+
+def _mk(nbytes: int):
+    return jnp.zeros(nbytes // 4, dtype=jnp.uint32)
+
+
+def test_store_seam_keeps_invariant():
+    store = SketchStore()
+    led = MemLedger()
+    store.accounting = led
+    store.get_or_create("s:a", "bitset", lambda: _mk(1024))
+    store.get_or_create("s:b", "bitset", lambda: _mk(2048))
+    assert led.live_bytes() == 3072
+    # get_or_create on an existing name does NOT double-count
+    store.get_or_create("s:a", "bitset", lambda: _mk(1024))
+    assert led.live_bytes() == 3072
+    # swap resizes
+    obj = store.get("s:a")
+    assert store.swap("s:a", _mk(4096), expected_version=obj.version)
+    assert led.entry("s:a")["nbytes"] == 4096
+    # delete debits
+    assert store.delete("s:b")
+    assert led.live_bytes() == 4096
+    v = led.verify(store)
+    assert v["ok"], v
+    store.flushall()
+    assert led.live_bytes() == 0
+    assert led.verify(store)["ok"]
+
+
+def test_store_rename_overwrites_dest_and_ledger_debits():
+    """Redis RENAME semantics pinned at the store level: an existing
+    destination is silently replaced, and the ledger debits its bytes."""
+    store = SketchStore()
+    led = MemLedger()
+    store.accounting = led
+    store.get_or_create("r:src", "bitset", lambda: _mk(1024))
+    store.get_or_create("r:dst", "bitset", lambda: _mk(8192))
+    assert store.rename("r:src", "r:dst") is True
+    assert not store.exists("r:src")
+    dst = store.get("r:dst")
+    assert int(dst.state.nbytes) == 1024  # source value won
+    assert led.live_bytes() == 1024
+    assert led.verify(store)["ok"]
+    # renaming a missing key is a no-op for both
+    assert store.rename("r:ghost", "r:dst") is False
+    assert led.live_bytes() == 1024
+
+
+def test_store_keys_pattern_glob():
+    store = SketchStore()
+    for name in ("user:1", "user:2", "sess:1", "user:10"):
+        store.get_or_create(name, "bitset", lambda: _mk(64))
+    assert sorted(store.keys("user:*")) == ["user:1", "user:10", "user:2"]
+    assert sorted(store.keys("user:?")) == ["user:1", "user:2"]
+    assert store.keys("nope*") == []
+    assert len(store.keys()) == 4
+
+
+# ---------------------------------------------------------------------------
+# 3. pressure
+# ---------------------------------------------------------------------------
+
+def _pressure(led, clk, high=0, low=0, **kw):
+    cfg = MemConfig(high_watermark_bytes=high, low_watermark_bytes=low,
+                    **kw)
+    return PressureMonitor(led, cfg, clock=clk)
+
+
+def test_pressure_no_watermark_never_sheds():
+    led = MemLedger()
+    led.on_create("a", "bitset", 1 << 30)
+    p = _pressure(led, FakeClock())
+    assert p.should_shed("bitset_set") is False
+    p.check_write("bitset_set")  # no raise
+
+
+def test_pressure_sheds_writes_not_reads_or_reclaims():
+    led = MemLedger()
+    led.on_create("a", "bitset", 2000)
+    p = _pressure(led, FakeClock(), high=1000)
+    with pytest.raises(RejectedError) as ei:
+        p.check_write("bitset_set")
+    assert ei.value.reason == "memory"
+    assert ei.value.retry_after_s > 0
+    assert p.shed_total == 1
+    # reads and reclaiming writes always flow
+    for kind in ("bitset_get", "hll_count", "exists",
+                 "delete", "flushall", "rename"):
+        p.check_write(kind)
+    assert p.shed_total == 1
+
+
+def test_pressure_hysteresis_band():
+    led = MemLedger()
+    clk = FakeClock()
+    p = _pressure(led, clk, high=1000, low=500)
+    led.on_create("a", "bitset", 1200)
+    assert p.should_shed("bitset_set") is True
+    # dipping below high but above low: still shedding (no flapping)
+    led.on_resize("a", 800)
+    assert p.should_shed("bitset_set") is True
+    # below the low watermark: recovered
+    led.on_resize("a", 400)
+    assert p.should_shed("bitset_set") is False
+    # and it re-arms at high again
+    led.on_resize("a", 1500)
+    assert p.should_shed("bitset_set") is True
+
+
+def test_pressure_forecast_eta():
+    led = MemLedger()
+    clk = FakeClock()
+    p = _pressure(led, clk, high=100_000, ewma_halflife_s=0.5)
+    led.on_create("a", "bitset", 0)
+    p.sample()
+    # steady growth: 1000 bytes/second for 10 seconds
+    for i in range(1, 11):
+        clk.advance(1.0)
+        led.on_resize("a", i * 1000)
+        p.sample()
+    fc = p.forecast()
+    rate = fc["rate_bytes_s"]["total"]
+    assert 500 < rate <= 1100  # EWMA converges toward 1000 B/s
+    eta = fc["seconds_to_watermark"]
+    assert eta is not None
+    # ~90k headroom at ~1k/s
+    assert 50 < eta < 200
+    # flat usage: rate decays toward zero, eta eventually None or huge
+    for _ in range(40):
+        clk.advance(1.0)
+        p.sample()
+    fc2 = p.forecast()
+    assert fc2["rate_bytes_s"]["total"] < rate / 4
+
+
+# ---------------------------------------------------------------------------
+# 4. report on a bare ledger
+# ---------------------------------------------------------------------------
+
+def test_report_stats_and_info_on_bare_ledger():
+    led = MemLedger()
+    led.on_create("a", "bitset", 1000, slot=1, tenant="t1")
+    led.on_create("b", "hll", 3000, slot=2)
+    led.register_meter("rc", lambda: 500, "cache")
+    rep = MemoryReport(led)
+    st = rep.memory_stats()
+    assert st["dataset.bytes"] == 4000
+    assert st["total.allocated"] == 4500
+    assert st["peak.allocated"] >= st["dataset.bytes"]
+    assert st["keys.count"] == 2
+    assert st["keys.bytes-per-key"] == 2000
+    assert st["bitset.bytes"] == 1000 and st["hll.bytes"] == 3000
+    assert st["by_tenant"]["t1"] == 1000
+    assert st["fragmentation"] == pytest.approx(4500 / 4000, rel=1e-3)
+    info = rep.info_memory()
+    assert info["used_memory"] == 4500
+    assert info["used_memory_dataset"] == 4000
+    assert info["used_memory_peak"] >= 4000
+    assert info["maxmemory_policy"] == "noeviction"
+    assert info["used_memory_human"].endswith("K")
+    # usage falls back to the ledger entry when no store is wired
+    assert rep.memory_usage("a") > 1000
+    assert rep.memory_usage("ghost") is None
+
+
+def test_report_doctor_rules():
+    # empty instance
+    led = MemLedger()
+    rep = MemoryReport(led)
+    doc = rep.memory_doctor()
+    assert doc["findings"] == [] and "empty" in doc["message"]
+    # orphaned scratch: meter bytes held with zero live state
+    led.register_meter("leak", lambda: 4096, "scratch")
+    doc = rep.memory_doctor()
+    rules = [f["rule"] for f in doc["findings"]]
+    assert "orphaned-scratch" in rules
+    # cache dominating the dataset
+    led2 = MemLedger()
+    led2.on_create("a", "bitset", 100)
+    led2.register_meter("rc", lambda: 10_000, "cache")
+    rules2 = [f["rule"] for f in MemoryReport(led2).memory_doctor()["findings"]]
+    assert "cache-dominates" in rules2
+    # near-watermark via an attached pressure monitor
+    led3 = MemLedger()
+    led3.on_create("a", "bitset", 950)
+    p = _pressure(led3, FakeClock(), high=1000)
+    rules3 = [f["rule"] for f in
+              MemoryReport(led3, pressure=p).memory_doctor()["findings"]]
+    assert "near-watermark" in rules3
+
+
+# ---------------------------------------------------------------------------
+# 5. metrics registry (poisoned gauge regression)
+# ---------------------------------------------------------------------------
+
+def test_snapshot_drops_poisoned_gauge_and_counts_error():
+    reg = MetricsRegistry()
+    reg.gauge("good", lambda: 42)
+    reg.gauge("poison", lambda: 1 // 0)
+    snap = reg.snapshot()
+    assert snap["gauges"]["good"] == 42
+    # the raising gauge is DROPPED (no None poisoning downstream sums)
+    assert "poison" not in snap["gauges"]
+    # and the failure is visible in the SAME snapshot's counters
+    assert snap["counters"]["metrics.callback_errors"] >= 1
+    # subsequent snapshots keep counting
+    reg.snapshot()
+    assert reg.snapshot()["counters"]["metrics.callback_errors"] >= 3
+
+
+# ---------------------------------------------------------------------------
+# 6. client integration
+# ---------------------------------------------------------------------------
+
+def test_client_memory_parity_end_to_end():
+    c = RedissonTPU.create(Config())
+    try:
+        h = c.get_hyper_log_log("mem:h")
+        h.add_all([b"k%d" % i for i in range(100)])
+        bs = c.get_bit_set("mem:b")
+        bs.set(100)
+        # MEMORY USAGE: one bank row per HLL name, exact bytes for bitset
+        hu = c.memory_usage("mem:h")
+        bu = c.memory_usage("mem:b")
+        assert hu is not None and bu is not None
+        obj = c._store.get("mem:b")
+        assert bu > int(obj.state.nbytes)  # value + metadata overhead
+        assert c.memory_usage("mem:ghost") is None
+        st = c.memory_stats()
+        assert st["dataset.bytes"] == c.memstat.live_bytes()
+        assert st["bank.bytes"] > 0
+        assert st["keys.count"] >= 2
+        doc = c.memory_doctor()
+        assert isinstance(doc["findings"], list)
+        v = c.memory_verify()
+        assert v["ok"], v
+        assert v["drift_bytes"] == 0
+        info = c.info("memory")["memory"]
+        assert info["used_memory_dataset"] == c.memstat.live_bytes()
+        full = c.info()
+        assert {"server", "memory"} <= set(full)
+        with pytest.raises(ValueError):
+            c.info("replication")
+        gauges = c.metrics.snapshot()["gauges"]
+        assert gauges["memstat.live_bytes"] == c.memstat.live_bytes()
+        assert gauges["memstat.keys"] == c.memstat.keys_count()
+    finally:
+        c.shutdown()
+
+
+def test_client_memory_facade_requires_device_mode():
+    c = RedissonTPU.create(Config())
+    try:
+        c._memreport = None  # what redis passthrough mode wires
+        c.memstat = None
+        with pytest.raises(RuntimeError, match="MEMORY USAGE"):
+            c.memory_usage("x")
+        with pytest.raises(RuntimeError):
+            c.memory_verify()
+    finally:
+        c._memreport = None
+        c.shutdown()
+
+
+@pytest.mark.parametrize("hll_impl", ["scatter", "sort"])
+def test_randomized_churn_zero_drift(hll_impl):
+    """The tentpole invariant under randomized churn, on both HLL engine
+    tiers: ledger == sum(live Array.nbytes) at every checkpoint, peak is
+    monotone, and flushall returns the ledger to exactly zero."""
+    cfg = Config()
+    cfg.hll_impl = hll_impl
+    c = RedissonTPU.create(cfg)
+    rng = random.Random(0xC0FFEE + hash(hll_impl) % 1000)
+    try:
+        live_bs = set()
+        peak_seen = 0
+        for step in range(60):
+            roll = rng.random()
+            if roll < 0.35:
+                name = "churn:h%d" % rng.randrange(8)
+                c.get_hyper_log_log(name).add(b"v%d" % step)
+            elif roll < 0.65:
+                name = "churn:b%d" % rng.randrange(8)
+                c.get_bit_set(name).set(rng.randrange(4096))
+                live_bs.add(name)
+            elif roll < 0.8 and live_bs:
+                name = live_bs.pop()
+                c.delete(name)
+            elif live_bs:
+                src = rng.choice(sorted(live_bs))
+                dst = "churn:rn%d" % rng.randrange(4)
+                if c._store.exists(src):
+                    c._store.rename(src, dst)
+                    live_bs.discard(src)
+                    live_bs.add(dst)
+            if step % 15 == 14:
+                v = c.memory_verify()
+                assert v["ok"], (hll_impl, step, v)
+                pk = c.memstat.peak_bytes()
+                assert pk >= peak_seen
+                assert pk >= c.memstat.live_bytes()
+                peak_seen = pk
+        v = c.memory_verify()
+        assert v["ok"] and v["drift_bytes"] == 0, v
+        c.flushall()
+        assert c.memstat.live_bytes() == 0
+        assert c.memory_verify()["ok"]
+        # seeded leak: scratch bytes with zero live state -> doctor flags
+        c.memstat.register_meter("seeded_leak", lambda: 8192, "scratch")
+        rules = [f["rule"] for f in c.memory_doctor()["findings"]]
+        assert "orphaned-scratch" in rules
+    finally:
+        c.shutdown()
+
+
+def test_client_watermark_sheds_writes_reads_flow():
+    cfg = Config()
+    cfg.use_serve()
+    mcfg = cfg.use_memstat()
+    mcfg.high_watermark_bytes = 1  # anything live trips the gate
+    mcfg.retry_after_s = 2.5
+    c = RedissonTPU.create(cfg)
+    try:
+        bs = c.get_bit_set("wm:b")
+        bs.set(7)  # admitted: ledger still empty at the gate
+        with pytest.raises(RejectedError) as ei:
+            bs.set(8)  # now live bytes >= 1 -> shed
+        assert ei.value.reason == "memory"
+        assert ei.value.retry_after_s == pytest.approx(2.5)
+        # reads keep flowing while writes shed
+        assert bs.get(7) is True
+        assert bs.cardinality() == 1
+        # and reclaiming writes are never shed
+        assert c.delete("wm:b") is True
+        snap = c.serve.snapshot()
+        assert snap["memory"]["pressure"]["shed_total"] >= 1
+        assert snap["memory"]["live_bytes"] == c.memstat.live_bytes()
+    finally:
+        c.shutdown()
+
+
+def test_client_trace_exports_memstat_counters():
+    cfg = Config()
+    tc = cfg.use_trace()
+    tc.sample_every = 1
+    c = RedissonTPU.create(cfg)
+    try:
+        bs = c.get_bit_set("tr:mem")
+        for i in range(8):
+            bs.set(i)
+        doc = c.trace.chrome_trace()
+        counters = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert counters, "no memstat counter events in the chrome trace"
+        names = {e["name"] for e in counters}
+        assert "memstat.live_bytes" in names
+        live = [e for e in counters if e["name"] == "memstat.live_bytes"]
+        # the closing sample reflects the current ledger
+        assert live[-1]["args"]["bytes"] == c.memstat.live_bytes()
+        assert all(e["cat"] == "memstat" for e in counters)
+    finally:
+        c.shutdown()
+
+
+def test_executor_staging_accounting_drains():
+    c = RedissonTPU.create(Config())
+    try:
+        bs = c.get_bit_set("stg:b")
+        for i in range(32):
+            bs.set(i)
+        assert bs.cardinality() == 32
+        # after the pipeline drains, no staged payload bytes remain held
+        stats = c._executor.pipeline_stats()
+        assert "staging_bytes" in stats
+        assert c._executor.staging_bytes() == 0
+    finally:
+        c.shutdown()
+
+
+def test_persist_disk_meter_reports_journal_bytes(tmp_path):
+    cfg = Config()
+    cfg.use_persist(str(tmp_path))
+    c = RedissonTPU.create(cfg)
+    try:
+        bs = c.get_bit_set("pd:b")
+        for i in range(16):
+            bs.set(i)
+        totals = c.memstat.meter_totals()
+        assert totals["disk"] > 0  # journal segments on disk
+        assert c.memory_stats()["disk.bytes"] == totals["disk"]
+    finally:
+        c.shutdown()

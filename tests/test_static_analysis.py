@@ -541,6 +541,110 @@ def test_g009_suppression_with_reason():
     assert "G009" not in rules_of(findings)
 
 
+def test_g010_objects_mutation_flagged():
+    """Every direct `._objects` mutation shape is a ledger bypass."""
+    shapes = [
+        'store._objects[name] = obj',
+        'del store._objects[name]',
+        'store._objects.pop(name, None)',
+        'store._objects.clear()',
+        'store._objects.update(other)',
+        'store._objects.setdefault(name, obj)',
+    ]
+    for stmt in shapes:
+        findings = lint_src(f"def f(store, name, obj, other):\n    {stmt}\n")
+        assert "G010" in rules_of(findings), stmt
+
+
+def test_g010_device_put_to_state_flagged():
+    findings = lint_src("""
+        import jax
+
+        def install(obj, host):
+            obj.state = jax.device_put(host)
+    """)
+    assert "G010" in rules_of(findings)
+    # nested inside an expression too
+    findings = lint_src("""
+        import jax
+
+        def install(obj, host, mask):
+            obj.state = jax.device_put(host) * mask
+    """)
+    assert "G010" in rules_of(findings)
+
+
+def test_g010_accounted_idioms_not_flagged():
+    # device_put routed through the store seam (the sanctioned shape)
+    findings = lint_src("""
+        import jax
+
+        def load(store, name, host):
+            arr = jax.device_put(host)
+            store.get_or_create(name, "hll", lambda: arr)
+    """)
+    assert "G010" not in rules_of(findings)
+    # host-side .state assignment (no device bytes involved)
+    findings = lint_src("""
+        def reset(self):
+            self.state = ClusterState()
+    """)
+    assert "G010" not in rules_of(findings)
+    # read access to ._objects is fine; only mutation is a bypass
+    findings = lint_src("""
+        def peek(store, name):
+            return store._objects.get(name)
+    """)
+    assert "G010" not in rules_of(findings)
+
+
+def test_g010_scoped_outside_accounted_seams():
+    src = """
+        import jax
+
+        def f(store, name, obj, host):
+            store._objects[name] = obj
+            obj.state = jax.device_put(host)
+    """
+    in_scope = [
+        os.path.join(REPO, "redisson_tpu", "client.py"),
+        os.path.join(REPO, "redisson_tpu", "serve", "scheduler.py"),
+        os.path.join(REPO, "redisson_tpu", "interop", "fake_server.py"),
+    ]
+    out_of_scope = [
+        os.path.join(REPO, "redisson_tpu", "store.py"),
+        os.path.join(REPO, "redisson_tpu", "backend_tpu.py"),
+        os.path.join(REPO, "redisson_tpu", "parallel", "backend_pod.py"),
+        os.path.join(REPO, "redisson_tpu", "memstat", "accounting.py"),
+        os.path.join(REPO, "benchmarks", "bench.py"),
+    ]
+    for path in in_scope:
+        findings = FileLinter(path, repo_root=REPO,
+                              source=textwrap.dedent(src)).run()
+        assert "G010" in rules_of(findings), path
+    for path in out_of_scope:
+        findings = FileLinter(path, repo_root=REPO,
+                              source=textwrap.dedent(src)).run()
+        assert "G010" not in rules_of(findings), path
+
+
+def test_g010_suppression_with_reason():
+    findings = lint_src("""
+        def evict(store, name):
+            # graftlint: allow-mem(recovery path: ledger rebuilt wholesale after replay)
+            store._objects.pop(name, None)
+    """)
+    assert "G010" not in rules_of(findings)
+
+
+def test_g010_registry_coverage():
+    assert "G010" in RULES
+    alias, _desc = RULES["G010"]
+    assert alias == "mem"
+    assert SUPPRESS_ALIASES["mem"] == "G010"
+    assert SUPPRESS_ALIASES["g010"] == "G010"
+
+
 def test_g007_registry_coverage():
     """Every OP_TABLE kind behaves per its write flag: all write kinds are
     flagged when dispatched as a literal `.run`, no read kind ever is. Pins

@@ -45,6 +45,16 @@ failure modes (see findings.RULES). Scope notes:
   ``allow-journal``/``allow-g007`` suppressions. The registry is imported
   lazily; if ``redisson_tpu.commands`` cannot be imported the rule is
   skipped rather than guessed.
+* G010 (mem) applies everywhere under ``redisson_tpu/`` except the
+  accounted seams themselves (store.py, backend_tpu.py, parallel/,
+  memstat/) — unless the file was passed explicitly. It flags direct
+  mutation of a ``._objects`` registry (subscript assign / ``del`` /
+  ``.pop/.clear/.update/.setdefault/.popitem``) and ``jax.device_put``
+  results installed as a persistent ``.state`` attribute: both put bytes
+  on device behind the memstat ledger's back, so MEMORY parity drifts
+  and the OOM watermark lies. Allocations must route through
+  ``store.get_or_create``/``swap`` or the backend bank seam; deliberate
+  out-of-ledger state carries reasoned ``allow-mem`` suppressions.
 
 Suppression: ``# graftlint: allow-<name>(reason)`` on the flagged line,
 anywhere within the flagged expression's line span, or on a standalone
@@ -141,6 +151,7 @@ class FileLinter:
         self._g006_on = self.explicit or self._in_block_scope()
         self._g007_on = self.explicit or self._in_journal_scope()
         self._g009_on = self.explicit or self._in_wallclock_scope()
+        self._g010_on = self.explicit or self._in_mem_scope()
         # G008 is scope-only (never `explicit`): outside the device/persist
         # fault boundary a broad except is usually deliberate best-effort
         # isolation (bench harnesses, CLI wrappers), not a leak.
@@ -241,6 +252,19 @@ class FileLinter:
         # executor.py is the commit point that owns the journal hook
         return rel != "redisson_tpu/executor.py"
 
+    def _in_mem_scope(self) -> bool:
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        # the accounted seams OWN the ledger hooks; everything else must
+        # route allocations through them
+        return not (
+            sub in ("store.py", "backend_tpu.py")
+            or sub.startswith("parallel/")
+            or sub.startswith("memstat/")
+        )
+
     # -- alias helpers -----------------------------------------------------
 
     def _full(self, name: str) -> str:
@@ -316,6 +340,9 @@ class FileLinter:
 
     def _rec(self, node, in_func, in_loop, const_exempt, fn_node,
              module_level=False):
+        if self._g010_on and isinstance(
+                node, (ast.Assign, ast.AugAssign, ast.Delete)):
+            self._check_g010_stmt(node)
         if isinstance(node, ast.Call):
             self._check_g001(node)
             if self._g002_on:
@@ -326,6 +353,8 @@ class FileLinter:
                 self._check_g007(node)
             if self._g009_on:
                 self._check_g009(node)
+            if self._g010_on:
+                self._check_g010_call(node)
             self._check_jit_construction(node, in_func, in_loop)
             if self._pallas_file:
                 self._check_pallas_call(node, fn_node)
@@ -444,6 +473,86 @@ class FileLinter:
                     if "partial" in f.id and f.id != "partial":
                         return True
         return False
+
+    # -- G010: unaccounted state mutation -----------------------------------
+
+    _G010_MUTATORS = frozenset(
+        {"pop", "clear", "update", "setdefault", "popitem"})
+    _G010_HINT = (
+        "route the bytes through the accounted seams — store.get_or_create/"
+        "swap/delete/rename for keyed state, the backend bank hooks for "
+        "shared planes — so the MemLedger sees the delta; deliberate "
+        "out-of-ledger state needs `# graftlint: allow-mem(reason)`"
+    )
+
+    @staticmethod
+    def _g010_objects_target(t: ast.AST) -> bool:
+        """``x._objects[...]`` as an assignment or ``del`` target."""
+        return (isinstance(t, ast.Subscript)
+                and isinstance(t.value, ast.Attribute)
+                and t.value.attr == "_objects")
+
+    def _g010_has_device_put(self, value: ast.AST) -> bool:
+        for n in ast.walk(value):
+            if not isinstance(n, ast.Call):
+                continue
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr == "device_put":
+                return True
+            if isinstance(f, ast.Name) and (
+                    f.id == "device_put"
+                    or self._full(f.id) == "jax.device_put"):
+                return True
+        return False
+
+    def _check_g010_call(self, call: ast.Call) -> None:
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in self._G010_MUTATORS
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "_objects"):
+            self._emit(
+                "G010", call,
+                f"direct `._objects.{f.attr}(...)` mutation bypasses the "
+                "store's ledger hooks — the memstat byte accounting never "
+                "sees this entry change",
+                self._G010_HINT,
+            )
+
+    def _check_g010_stmt(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if self._g010_objects_target(t):
+                    self._emit(
+                        "G010", node,
+                        "`del` on a `._objects[...]` entry bypasses "
+                        "store.delete — the memstat ledger never debits "
+                        "the freed bytes",
+                        self._G010_HINT,
+                    )
+            return
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        value = node.value
+        for t in targets:
+            if self._g010_objects_target(t):
+                self._emit(
+                    "G010", node,
+                    "subscript assignment into `._objects` bypasses "
+                    "store.get_or_create/swap — the memstat ledger never "
+                    "credits the new bytes",
+                    self._G010_HINT,
+                )
+            elif (isinstance(t, ast.Attribute) and t.attr == "state"
+                    and value is not None
+                    and self._g010_has_device_put(value)):
+                self._emit(
+                    "G010", node,
+                    "a jax.device_put result installed directly as a "
+                    "persistent `.state` — HBM bytes land behind the "
+                    "memstat ledger's back, so MEMORY parity drifts and "
+                    "the OOM watermark lies",
+                    self._G010_HINT,
+                )
 
     # -- G002: implicit host syncs ------------------------------------------
 

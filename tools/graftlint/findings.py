@@ -89,6 +89,14 @@ RULES = {
         "serve/persist/trace path — NTP steps and clock slew corrupt "
         "durations; latency math must use time.monotonic()",
     ),
+    "G010": (
+        "mem",
+        "unaccounted state mutation: direct `._objects` registry mutation "
+        "or a jax.device_put result installed as persistent `.state` "
+        "outside the accounted store/backend seams — the memstat ledger "
+        "never sees the byte delta, so MEMORY parity drifts and the OOM "
+        "watermark lies",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
