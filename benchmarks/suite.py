@@ -1483,6 +1483,186 @@ def mem_smoke():
     return ok
 
 
+def cluster_smoke():
+    """Cluster-tier acceptance (the CPU-only CI contract for the slot-
+    sharded namespace): an N=4-shard cluster on the virtual device pool,
+    randomized keyed traffic kept flowing through a LIVE slot migration.
+    Gates:
+
+      (a) LIVE MIGRATION: zero lost acks during the move, and the
+          post-migration keyspace digest is identical to a no-migration
+          oracle fed the same acked writes;
+      (b) MOVED RETRY: ops dispatched to the old owner after the flip are
+          redirected and land on the new owner — redirects observed > 0,
+          every ack still arrives;
+      (c) CROSS-SHARD PFMERGE: merging HLLs living on three different
+          shards matches a single-shard (hashtag co-located) oracle.
+    """
+    import hashlib
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.ops.crc16 import key_slot
+
+    n_keys = 40 if _TINY else 200
+    hll_n = 300 if _TINY else 2000
+    ok = True
+    tmp = tempfile.mkdtemp(prefix="rtpu-cluster-smoke-")
+    cfg = Config()
+    cfg.use_cluster(num_shards=4, dir=os.path.join(tmp, "cl"))
+    c = RedissonTPU.create(cfg)
+    try:
+        mgr = c.cluster
+        router = mgr.router
+        table = router.slot_table()
+
+        # Keys pinned to shard 0 so one migration covers them all.
+        keys, i = [], 0
+        while len(keys) < n_keys:
+            k = f"cs{i}"
+            if table[key_slot(k)] == 0:
+                keys.append(k)
+            i += 1
+        for k in keys:
+            c.get_bucket(k).set("v0")
+        move_slots = sorted({key_slot(k) for k in keys})
+
+        # -- (a) live migration under randomized traffic ----------------
+        rng = random.Random(11)
+        errs, acked = [], {}
+        stop = threading.Event()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                k = rng.choice(keys)
+                v = f"w{n}"
+                try:
+                    c.get_bucket(k).set(v)
+                    acked[k] = v
+                except Exception as exc:  # noqa: BLE001 — any lost ack fails the gate
+                    errs.append((k, repr(exc)))
+                n += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        time.sleep(0.3)
+        t0 = time.perf_counter()
+        stats = mgr.migrate_slots(move_slots, 2, timeout_s=120)
+        wall = time.perf_counter() - t0
+        time.sleep(0.3)
+        stop.set()
+        wt.join(10)
+
+        post = router.slot_table()
+        flipped = all(post[s] == 2 for s in move_slots)
+        # Oracle: the same acked writes on a keyspace with no migration is
+        # just last-write-wins per key — the acked map IS the oracle state.
+        def digest(kv):
+            h = hashlib.sha256()
+            for k in sorted(kv):
+                h.update(k.encode() + b"=" + str(kv[k]).encode() + b";")
+            return h.hexdigest()
+
+        want = dict(acked)
+        for k in keys:
+            want.setdefault(k, "v0")
+        got = {k: c.get_bucket(k).get() for k in keys}
+        same = digest(got) == digest(want)
+        print(f"# cluster-smoke[migrate]: {len(move_slots)} slots / "
+              f"{len(keys)} keys moved in {wall * 1e3:.0f} ms under "
+              f"{len(acked)} acked writes "
+              f"(catch-up {stats['caught_up_records']}, "
+              f"apply errors {stats['apply_errors']}); "
+              f"lost acks {len(errs)}, digest "
+              f"{'identical' if same else 'MISMATCH'}")
+        if errs or not same or not flipped or stats["apply_errors"]:
+            print("#   live migration gate failed", file=sys.stderr)
+            ok = False
+
+        # -- (b) deterministic MOVED retry ------------------------------
+        src, tgt = mgr.shards[1], mgr.shards[3]
+        mkeys, i = [], 0
+        while len(mkeys) < 8:
+            k = f"mr{i}"
+            if post[key_slot(k)] == 1:
+                mkeys.append(k)
+            i += 1
+        slots = sorted({key_slot(k) for k in mkeys})
+        entered, release = threading.Event(), threading.Event()
+
+        def hold():
+            entered.set()
+            release.wait(30)
+
+        redirects0 = router.redirects
+        bfut = src.executor.execute_barrier(hold)
+        entered.wait(10)
+        # Enqueued behind the barrier: the flip, then writes the router
+        # still resolves to shard 1 — they dispatch post-flip, reject with
+        # SlotMovedError, and the redirect worker re-lands them on shard 3.
+        fflip = src.executor.execute_async("", "migrate_flip",
+                                           {"slots": slots})
+        wfuts = [router.execute_async(k, "set", {"value": b"m%d" % j})
+                 for j, k in enumerate(mkeys)]
+        tgt.adopt(slots)
+        router.begin_cutover(slots)
+        release.set()
+        bfut.result(30)
+        fflip.result(30)
+        time.sleep(0.05)
+        router.commit_cutover(slots, tgt.shard_id)
+        moved_ok = True
+        for j, f in enumerate(wfuts):
+            try:
+                f.result(30)
+            except Exception:  # noqa: BLE001 — a lost ack fails the gate
+                moved_ok = False
+        redirected = router.redirects - redirects0
+        landed = all(
+            router.execute_sync(k, "get", None) == b"m%d" % j
+            for j, k in enumerate(mkeys))
+        print(f"# cluster-smoke[moved]: {redirected} redirects, "
+              f"{len(mkeys)} acks "
+              f"{'landed on the new owner' if moved_ok and landed else 'LOST'}")
+        if redirected <= 0 or not moved_ok or not landed:
+            print("#   MOVED retry gate failed", file=sys.stderr)
+            ok = False
+
+        # -- (c) cross-shard PFMERGE vs single-shard oracle --------------
+        names, i = [], 0
+        want_shards = [0, 1, 2]
+        while len(names) < 3:
+            k = f"pf{i}"
+            if router.slot_table()[key_slot(k)] == want_shards[len(names)]:
+                names.append(k)
+            i += 1
+        vals = [[b"%d:%d" % (j, v) for v in range(hll_n)] for j in range(3)]
+        vals[2] = vals[0][: hll_n // 2]  # overlap exercises the max-fold
+        for n, vs in zip(names, vals):
+            c.get_hyper_log_log(n).add_all(vs)
+        merged = c.get_hyper_log_log(names[0]).merge_with_and_count(
+            *names[1:])
+        oracle = c.get_hyper_log_log("{pforacle}")
+        for vs in vals:
+            oracle.add_all(vs)
+        oracle_count = oracle.count()
+        print(f"# cluster-smoke[pfmerge]: cross-shard {merged} vs "
+              f"single-shard oracle {oracle_count} "
+              f"({router.cross_shard_merges} register merges)")
+        if merged != oracle_count or router.cross_shard_merges <= 0:
+            print("#   cross-shard PFMERGE gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        _close(c)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -1525,6 +1705,14 @@ def main():
                          "always-on accounting overhead vs detached "
                          "seams, and watermark write-shedding with a "
                          "retry-after hint while reads flow, then exit")
+    ap.add_argument("--cluster-smoke", action="store_true",
+                    help="cluster-tier acceptance: N=4 shards, randomized "
+                         "keyed traffic during a live slot migration — "
+                         "zero lost acks + digest identical to a no-"
+                         "migration oracle, deterministic MOVED retry "
+                         "landing on the new owner, and cross-shard "
+                         "PFMERGE matching a single-shard oracle, then "
+                         "exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -1546,6 +1734,9 @@ def main():
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)
+
+    if args.cluster_smoke:
+        sys.exit(0 if cluster_smoke() else 1)
 
     if args.mem_smoke:
         sys.exit(0 if mem_smoke() else 1)

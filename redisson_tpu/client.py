@@ -53,7 +53,19 @@ class RedissonTPU:
         mode = self._mode = self.config.mode()
         self._codec = get_codec(self.config.codec)
         self.id = new_client_id()  # connection-manager UUID analogue
+        # Cluster tier handle (cluster/): the ClusterManager on a cluster
+        # facade client, None everywhere else (including shard members).
+        self.cluster = None
 
+        ccfg = self.config.cluster
+        if ccfg is not None and ccfg.shard_id < 0:
+            # Slot-sharded namespace: this client is the FACADE — it builds
+            # N shard clients (each one re-enters __init__ with shard_id
+            # >= 0) and dispatches through the ClusterRouter instead of its
+            # own executor. The compute section (local/tpu) configures the
+            # per-shard stacks, not this client.
+            self._init_cluster_mode()
+            return
         if mode == "redis":
             # Passthrough: every op translates to Redis commands over RESP —
             # the reference's own execution model (server executes, client
@@ -93,6 +105,14 @@ class RedissonTPU:
                 read_cache_entries=getattr(tcfg, "read_cache_entries", 1024),
             )
         self._routing = RoutingBackend(sketch)
+        if ccfg is not None and ccfg.shard_id >= 0:
+            # Shard member of a cluster: enforce slot ownership at the
+            # dispatch waist. Installed HERE — before the executor and
+            # before persist recovery — so replayed journal records cross
+            # the same accept/reject boundary live traffic did.
+            from redisson_tpu.cluster.shard import SlotOwnershipBackend
+
+            self._routing = SlotOwnershipBackend(self._routing, ccfg.shard_id)
         self._backend = self._routing
         self._widths = tuple(tcfg.key_width_buckets)
         from redisson_tpu.observability import MetricsRegistry
@@ -121,6 +141,10 @@ class RedissonTPU:
                 lambda s=sketch: s.scratch_bytes()["delta_scratch"],
                 "scratch")
         self._build_executor(self._routing, max_batch_keys=tcfg.max_batch_keys)
+        if ccfg is not None and ccfg.shard_id >= 0:
+            # Shard-tagged dispatch: pipeline_stats / traces carry which
+            # shard's executor did the work (per-shard attribution).
+            self._executor.shard_tag = ccfg.shard_id
         self.memstat.register_meter(
             "executor.staging", self._executor.staging_bytes, "staging")
         mcfg = self.config.memory
@@ -355,6 +379,61 @@ class RedissonTPU:
             return router
         pool = factory(u.hostname, u.port)
         return pool
+
+    def _init_cluster_mode(self):
+        from redisson_tpu.cluster import ClusterManager
+        from redisson_tpu.observability import MetricsRegistry
+
+        self._mode = "cluster"
+        self.cluster = ClusterManager(self.config)
+        # The router speaks the executor's narrow waist (execute_async /
+        # execute_many / execute_sync / batch), so every model getter binds
+        # to it unchanged — per-owner batch splitting and MOVED retries
+        # happen below the models, like the reference's CommandAsyncService
+        # hides slot routing from RBucket et al.
+        self._dispatch = self._routing = self.cluster.router
+        self._store = None
+        self.metrics = MetricsRegistry()
+        self.metrics.gauge("cluster.queue_depth",
+                           self.cluster.router.queue_depth)
+        # Per-shard subsystems (memstat / trace / serve / persist) live on
+        # the shard clients — see ClusterManager.stats() for the rollup.
+        self.memstat = None
+        self._pressure = None
+        self._memreport = None
+        self.serve = None
+        self.trace = None
+        self._widths = (16, 32, 64, 128, 256)
+        # Engine pub/sub and lock coordination are per-shard hubs; a
+        # keyspace-wide topic surface needs a fan-out hub (future work), so
+        # the facade declines rather than silently scoping to one shard.
+        self._pubsub = None
+        self._watchdog = None
+        self._eviction = EvictionScheduler(self.cluster.router)
+        self._remote_services = {}
+        self._durability = None
+        self._resp = None
+        self._persist = None
+        self._fault = None
+
+    # -- CLUSTER command facade (cluster/; CLUSTER INFO/SLOTS/KEYSLOT) -------
+
+    def _require_cluster(self, command: str):
+        if self.cluster is None:
+            raise RuntimeError(f"{command} requires Config.use_cluster()")
+        return self.cluster
+
+    def cluster_keyslot(self, key: str) -> int:
+        """CLUSTER KEYSLOT analogue (hashtag-aware CRC16 slot)."""
+        return self._require_cluster("CLUSTER KEYSLOT").cluster_keyslot(key)
+
+    def cluster_slots(self):
+        """CLUSTER SLOTS analogue: (start, end_inclusive, shard_id) ranges."""
+        return self._require_cluster("CLUSTER SLOTS").cluster_slots()
+
+    def cluster_info(self):
+        """CLUSTER INFO analogue (cluster_state, slots_assigned, ...)."""
+        return self._require_cluster("CLUSTER INFO").cluster_info()
 
     def _init_redis_mode(self):
         from redisson_tpu.interop.backend_redis import RedisBackend
@@ -906,6 +985,8 @@ class RedissonTPU:
             sections["memory"] = self._memreport.info_memory()
         if getattr(self, "_persist", None) is not None:
             sections["persistence"] = self._persist.stats()
+        if self.cluster is not None:
+            sections["cluster"] = self.cluster.cluster_info()
         if section is not None:
             key = section.lower()
             if key not in sections:
@@ -926,6 +1007,15 @@ class RedissonTPU:
             self._is_shutdown = True
 
     def _shutdown_inner(self):
+        if getattr(self, "cluster", None) is not None:
+            # Cluster facade: the shard clients own every background
+            # resource; the manager closes the router (its redirect worker)
+            # then shuts each shard down through this same path.
+            if self._eviction is not None:
+                self._eviction.shutdown()
+            self.cluster.shutdown()
+            self.cluster = None
+            return
         if getattr(self, "_fault", None) is not None:
             # First: stop the watchdog (it reads executor internals) and
             # wait out in-flight rebuilds while the executor still accepts

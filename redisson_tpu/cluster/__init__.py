@@ -1,0 +1,35 @@
+"""Slot-sharded cluster tier: N full engine stacks owning ranges of the
+16384 CRC16 slots, a router that splits batches per owner and retries
+MOVED/ASK redirects, and live slot migration over the persist follower
+protocol. See cluster/manager.py for the wiring and README "Cluster tier".
+"""
+
+from redisson_tpu.cluster.errors import (
+    ClusterCrossSlotError,
+    SlotAskError,
+    SlotMovedError,
+)
+from redisson_tpu.cluster.manager import ClusterManager
+from redisson_tpu.cluster.migrator import MigrationError, SlotMigrator
+from redisson_tpu.cluster.router import ClusterRouter
+from redisson_tpu.cluster.shard import ClusterShard, SlotOwnershipBackend
+from redisson_tpu.cluster.split import (
+    contiguous_assignment,
+    slot_ranges,
+    split_by_owner,
+)
+
+__all__ = [
+    "ClusterCrossSlotError",
+    "ClusterManager",
+    "ClusterRouter",
+    "ClusterShard",
+    "MigrationError",
+    "SlotAskError",
+    "SlotMigrator",
+    "SlotMovedError",
+    "SlotOwnershipBackend",
+    "contiguous_assignment",
+    "slot_ranges",
+    "split_by_owner",
+]

@@ -1,0 +1,342 @@
+"""ClusterManager — N shard engine stacks behind one ClusterRouter.
+
+The engine-owned analogue of `ClusterConnectionManager.java`: builds one
+full client per shard (executor + backend + store + optional persist +
+optional per-shard serve admission), hands each a contiguous range of the
+16384 CRC16 slots, and fronts them with the router. Responsibilities:
+
+  * **bootstrap** — derive per-shard Configs from the parent Config (tpu
+    shards round-robin over visible devices; `XLA_FLAGS=
+    --xla_force_host_platform_device_count=N` gives N virtual CPU devices
+    for single-process runs), journal a `migrate_adopt` on every shard so
+    the slot table is crash-recoverable;
+  * **recovery** — on restart the per-shard journals replay their
+    ownership history; the manager rebuilds the live slot table from the
+    guards instead of re-assuming the initial split;
+  * **resharding** — `migrate_slots` / `rebalance` / `add_shard` /
+    `remove_shard` drive SlotMigrator runs (live, never write-blocking);
+  * **healing** — a `parallel/topology.py` TopologyManager watches shard
+    pingers; node_down quarantines the shard and (auto_heal) drains its
+    slots onto the survivors — quarantine-then-migrate;
+  * **parity** — cluster_info / cluster_slots / cluster_keyslot back the
+    client's CLUSTER command facade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Tuple
+
+from redisson_tpu.cluster.migrator import SlotMigrator
+from redisson_tpu.cluster.router import ClusterRouter
+from redisson_tpu.cluster.shard import ClusterShard
+from redisson_tpu.cluster.split import MAX_SLOT, contiguous_assignment
+from redisson_tpu.ops.crc16 import key_slot
+from redisson_tpu.parallel.topology import TopologyManager
+
+
+class ClusterManager:
+    def __init__(self, config):
+        from redisson_tpu.client import RedissonTPU
+
+        cluster = config.cluster
+        if cluster is None:
+            raise ValueError("Config.cluster section required")
+        if config.pod is not None:
+            raise ValueError(
+                "cluster and pod modes are mutually exclusive: the cluster "
+                "tier shards the namespace over full engine stacks, pod "
+                "shards one engine over the mesh")
+        self.config = config
+        self._lock = threading.Lock()
+        self.migrations = 0
+        self.migration_stats: Dict[str, int] = {}
+        self._next_shard_id = 0
+
+        self.shards: Dict[int, ClusterShard] = {}
+        for _ in range(max(1, int(cluster.num_shards))):
+            shard_id = self._next_shard_id
+            self._next_shard_id += 1
+            self.shards[shard_id] = ClusterShard(
+                shard_id, RedissonTPU.create(self._shard_config(shard_id)))
+
+        table = self._recovered_table()
+        self.router = ClusterRouter(self.shards, table,
+                                    retry_depth=cluster.redirect_retries)
+        self._adopt_table(table)
+
+        # Failure plane: one pinger per shard (replaceable for drills /
+        # real health checks); node_down => quarantine-then-migrate.
+        self.topology = TopologyManager()
+        for shard_id in self.shards:
+            self.topology.add_node(self._ident(shard_id),
+                                   self._default_pinger(shard_id))
+        self.topology.add_listener(self._on_topology_event)
+
+    # -- bootstrap ------------------------------------------------------------
+
+    def _shard_config(self, shard_id: int):
+        from redisson_tpu.config import Config, PersistConfig
+
+        parent = self.config
+        cluster = parent.cluster
+        shard_cfg = Config(
+            codec=parent.codec,
+            threads=parent.threads,
+            inflight_runs=parent.inflight_runs,
+        )
+        if parent.tpu is not None:
+            import jax
+
+            ndev = max(1, len(jax.devices()))
+            shard_cfg.tpu = dataclasses.replace(
+                parent.tpu, device_index=shard_id % ndev)
+        else:
+            shard_cfg.local = parent.local or None
+            if shard_cfg.local is None:
+                from redisson_tpu.config import LocalConfig
+
+                shard_cfg.local = LocalConfig()
+        if cluster.dir:
+            shard_cfg.persist = PersistConfig(
+                dir=os.path.join(cluster.dir, f"shard-{shard_id:02d}"),
+                fsync=cluster.fsync,
+                snapshot_interval_s=0.0)
+        if cluster.shard_serve:
+            if parent.serve is None:
+                raise ValueError("cluster.shard_serve needs Config.serve")
+            shard_cfg.serve = dataclasses.replace(parent.serve)
+        if parent.trace is not None:
+            shard_cfg.trace = dataclasses.replace(parent.trace)
+        if parent.memory is not None:
+            shard_cfg.memory = dataclasses.replace(parent.memory)
+        if parent.faults is not None:
+            shard_cfg.faults = dataclasses.replace(parent.faults)
+        # shard_id >= 0 tells the client to install the ownership guard.
+        shard_cfg.cluster = dataclasses.replace(cluster, shard_id=shard_id)
+        return shard_cfg
+
+    def _recovered_table(self) -> List[int]:
+        """The live slot table. Fresh start: contiguous near-even ranges.
+        Restart: the per-shard journals already replayed their ownership
+        records into the guards — rebuild from those (the initial split may
+        be long obsolete). Unowned slots (crash between a source's flip and
+        the target's adopt) go to the least-loaded shard; a conflict keeps
+        the lowest shard id and flips the others."""
+        ids = sorted(self.shards)
+        owned_any = any(self.shards[i].guard.owned_slots() is not None
+                        for i in ids)
+        if not owned_any:
+            assign = contiguous_assignment(MAX_SLOT, len(ids))
+            return [ids[owner] for owner in assign]
+        table = [-1] * MAX_SLOT
+        conflicts: Dict[int, List[int]] = {}
+        for shard_id in ids:
+            owned = self.shards[shard_id].guard.owned_slots() or set()
+            for slot in owned:
+                if table[slot] < 0:
+                    table[slot] = shard_id
+                else:
+                    conflicts.setdefault(shard_id, []).append(slot)
+        for shard_id, slots in conflicts.items():
+            self.shards[shard_id].flip(slots)
+        counts = {i: sum(1 for s in table if s == i) for i in ids}
+        orphans = [s for s in range(MAX_SLOT) if table[s] < 0]
+        for slot in orphans:
+            shard_id = min(counts, key=counts.get)
+            table[slot] = shard_id
+            counts[shard_id] += 1
+        return table
+
+    def _adopt_table(self, table: List[int]) -> None:
+        """Journal every shard's ownership (idempotent: adopt is a union,
+        and on a fresh shard it draws the accept-everything -> owned-set
+        boundary before any routed traffic arrives)."""
+        by_shard: Dict[int, List[int]] = {i: [] for i in self.shards}
+        for slot, shard_id in enumerate(table):
+            by_shard[shard_id].append(slot)
+        for shard_id, slots in by_shard.items():
+            self.shards[shard_id].adopt(slots)
+
+    # -- topology healing ------------------------------------------------------
+
+    @staticmethod
+    def _ident(shard_id: int) -> str:
+        return f"shard-{shard_id}"
+
+    def _default_pinger(self, shard_id: int):
+        def ping() -> bool:
+            shard = self.shards.get(shard_id)
+            return shard is not None and not shard.quarantined
+        return ping
+
+    def set_pinger(self, shard_id: int, fn) -> None:
+        """Replace a shard's health probe (drills / real checks). The
+        TopologyManager polls it; `failed_attempts` consecutive False
+        results fire node_down -> quarantine-then-migrate."""
+        self.topology.add_node(self._ident(shard_id), fn)
+
+    def _on_topology_event(self, event: str, ident: str) -> None:
+        try:
+            shard_id = int(ident.rsplit("-", 1)[1])
+        except (IndexError, ValueError):
+            return
+        shard = self.shards.get(shard_id)
+        if shard is None:
+            return
+        if event == "node_down":
+            shard.quarantined = True
+            if self.config.cluster.auto_heal:
+                try:
+                    self.drain_shard(shard_id)
+                except Exception:
+                    # graftlint: allow-bare(healing is best-effort from a watcher callback: a failed drain leaves the shard quarantined with its slots intact, and the next operator action retries; raising here would kill the topology scan loop)
+                    pass
+        elif event == "node_up":
+            shard.quarantined = False
+
+    # -- resharding ------------------------------------------------------------
+
+    def migrate_slots(self, slots: Iterable[int], target_shard: int,
+                      timeout_s: float = 120.0) -> Dict[str, int]:
+        """Live-migrate `slots` to `target_shard` (grouped per current
+        owner; slots already on the target are skipped). Writes keep
+        flowing throughout — see cluster/migrator.py for the protocol."""
+        slots = sorted({int(s) for s in slots})
+        if target_shard not in self.shards:
+            raise ValueError(f"unknown target shard {target_shard}")
+        table = self.router.slot_table()
+        by_source: Dict[int, List[int]] = {}
+        for slot in slots:
+            owner = table[slot]
+            if owner != target_shard:
+                by_source.setdefault(owner, []).append(slot)
+        total: Dict[str, int] = {}
+        with self._lock:  # one migration at a time (BGSAVE-style)
+            for source_id, group in sorted(by_source.items()):
+                migrator = SlotMigrator(
+                    self.router, self.shards[source_id],
+                    self.shards[target_shard], group, timeout_s=timeout_s)
+                stats = migrator.run()
+                self.migrations += 1
+                for k, v in stats.items():
+                    total[k] = total.get(k, 0) + v
+        self.migration_stats = total
+        return total
+
+    def drain_shard(self, shard_id: int) -> int:
+        """Move every slot off `shard_id` onto the other non-quarantined
+        shards (least-loaded first) — the quarantine-then-migrate step and
+        the first half of remove_shard. Returns slots moved."""
+        survivors = [i for i, s in self.shards.items()
+                     if i != shard_id and not s.quarantined]
+        if not survivors:
+            raise RuntimeError("no live shard left to drain onto")
+        table = self.router.slot_table()
+        mine = [s for s in range(MAX_SLOT) if table[s] == shard_id]
+        if not mine:
+            return 0
+        counts = {i: sum(1 for s in table if s == i) for i in survivors}
+        share = (len(mine) + len(survivors) - 1) // len(survivors)
+        moved = 0
+        for start in range(0, len(mine), share):
+            target = min(counts, key=counts.get)
+            chunk = mine[start:start + share]
+            self.migrate_slots(chunk, target)
+            counts[target] += len(chunk)
+            moved += len(chunk)
+        return moved
+
+    def rebalance(self) -> int:
+        """Even out slot ownership across non-quarantined shards. Returns
+        slots moved. Greedy: repeatedly migrate the most-loaded shard's
+        excess to the least-loaded until within one slot of even."""
+        live = sorted(i for i, s in self.shards.items() if not s.quarantined)
+        if len(live) < 2:
+            return 0
+        moved = 0
+        while True:
+            table = self.router.slot_table()
+            counts = {i: sum(1 for s in table if s == i) for i in live}
+            fat = max(counts, key=counts.get)
+            thin = min(counts, key=counts.get)
+            excess = (counts[fat] - counts[thin]) // 2
+            if excess < 1:
+                return moved
+            chunk = [s for s in range(MAX_SLOT) if table[s] == fat][:excess]
+            self.migrate_slots(chunk, thin)
+            moved += len(chunk)
+
+    def add_shard(self) -> int:
+        """Bring up a new empty shard (owns no slots until rebalance /
+        migrate_slots moves some in). Returns its shard id."""
+        from redisson_tpu.client import RedissonTPU
+
+        shard_id = self._next_shard_id
+        self._next_shard_id += 1
+        shard = ClusterShard(
+            shard_id, RedissonTPU.create(self._shard_config(shard_id)))
+        shard.adopt([])  # closed ownership: reject until slots migrate in
+        self.shards[shard_id] = shard
+        self.router.add_shard(shard)
+        self.topology.add_node(self._ident(shard_id),
+                               self._default_pinger(shard_id))
+        return shard_id
+
+    def remove_shard(self, shard_id: int) -> int:
+        """Drain then retire a shard. Returns slots moved off it."""
+        if shard_id not in self.shards:
+            raise ValueError(f"unknown shard {shard_id}")
+        moved = self.drain_shard(shard_id)
+        self.router.remove_shard(shard_id)
+        self.topology.remove_node(self._ident(shard_id))
+        shard = self.shards.pop(shard_id)
+        shard.shutdown()
+        return moved
+
+    # -- CLUSTER command parity ------------------------------------------------
+
+    @staticmethod
+    def cluster_keyslot(key: str) -> int:
+        """CLUSTER KEYSLOT."""
+        return key_slot(key)
+
+    def cluster_slots(self) -> List[Tuple[int, int, int]]:
+        """CLUSTER SLOTS shape: (start, end_inclusive, shard_id) ranges."""
+        return self.router.ranges()
+
+    def cluster_info(self) -> Dict[str, Any]:
+        """CLUSTER INFO analogue (`cluster_state:ok` etc.)."""
+        table = self.router.slot_table()
+        assigned = sum(1 for s in table if s is not None and s >= 0)
+        quarantined = sum(1 for s in self.shards.values() if s.quarantined)
+        return {
+            "cluster_enabled": 1,
+            "cluster_state": "ok" if quarantined == 0 else "degraded",
+            "cluster_slots_assigned": assigned,
+            "cluster_known_nodes": len(self.shards),
+            "cluster_size": len(self.shards) - quarantined,
+            "migrations": self.migrations,
+            "redirects": self.router.redirects,
+            "retries_exhausted": self.router.retries_exhausted,
+            "cross_shard_merges": self.router.cross_shard_merges,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "info": self.cluster_info(),
+            "shards": {i: s.stats() for i, s in sorted(self.shards.items())},
+            "slots": self.cluster_slots(),
+            "last_migration": dict(self.migration_stats),
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        self.topology.shutdown()
+        self.router.close()
+        for shard in self.shards.values():
+            shard.shutdown()
+        self.shards.clear()

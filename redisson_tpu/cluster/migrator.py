@@ -1,0 +1,248 @@
+"""SlotMigrator — live slot migration, never blocking writes.
+
+The persist follower protocol (persist/follower.py), specialized to a slot
+subset and finished with an atomic ownership flip:
+
+  1. **subscribe** — attach a listener to the source shard's journal
+     BEFORE anything else: every record committed from here on lands in
+     our queue, so the snapshot watermark below can never race a write;
+  2. **mark** — journal `migrate_begin` on the source (recovery of a
+     crashed source replays the mark and knows a migration was in flight);
+  3. **snapshot bootstrap** — cut a barrier-consistent source snapshot
+     (persist.snapshot(): immutable jax handles make this cheap) and
+     import only the migrating slots into the target THROUGH its executor
+     (hll_import / bits_import / migrate_install are journaled writes, so
+     a target crash after migration recovers the adopted state);
+  4. **journal-suffix catch-up** — apply queued records with
+     seq > watermark, filtered to the migrating slots, onto the target in
+     journal order (group-boundary drains, exactly recover.py/follower.py:
+     apply order == commit order);
+  5. **cutover** — open the router's ASK window for the migrating slots
+     (new submissions for those slots park; all other slots flow), journal
+     `migrate_flip` on the source — its seq is the cutover point: every
+     source record before it is caught up below, every keyed op the source
+     dispatches after it fails with SlotMovedError and re-routes. Drain
+     the queue up to the flip record, `migrate_adopt` on the target, flip
+     the router table, release the window. Parked and rejected ops land on
+     the target exactly once — zero lost acks, digest-identical to a
+     no-migration run.
+
+Reference: redis cluster resharding (MIGRATE + SETSLOT IMPORTING/NODE,
+`ClusterConnectionManager.java` topology flips); the snapshot+suffix shape
+is the same one `JournalFollower` uses for warm standbys.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from redisson_tpu import checkpoint
+from redisson_tpu.cluster.shard import CLUSTER_KINDS, ClusterShard
+from redisson_tpu.ops.crc16 import key_slot
+from redisson_tpu.persist.follower import slots_record_filter
+from redisson_tpu.persist.journal import JournalRecord
+from redisson_tpu.persist.snapshotter import STRUCTURES_FILE
+
+# Records that are keyspace-wide or control-plane: never slot-filtered onto
+# the target (the router fans flushall/script ops to every shard directly,
+# and migrate_* records are the source's own ownership bookkeeping).
+_SKIP_KINDS = CLUSTER_KINDS | {"flushall", "script_load", "script_flush",
+                               "script_eval"}
+
+
+class MigrationError(RuntimeError):
+    pass
+
+
+class SlotMigrator:
+    """One live migration of `slots` from `source` to `target`."""
+
+    def __init__(self, router, source: ClusterShard, target: ClusterShard,
+                 slots, apply_window: int = 1024,
+                 cutover_lag: int = 256, timeout_s: float = 120.0):
+        self.router = router
+        self.source = source
+        self.target = target
+        self.slots = frozenset(int(s) for s in slots)
+        self._slot_filter = slots_record_filter(self.slots)
+        self._apply_window = apply_window
+        self._cutover_lag = cutover_lag
+        self._timeout_s = timeout_s
+        self._queue: List[JournalRecord] = []
+        self._qlock = threading.Lock()
+        self.stats: Dict[str, int] = {
+            "bootstrapped_objects": 0, "bootstrapped_structures": 0,
+            "caught_up_records": 0, "apply_errors": 0,
+        }
+
+    # -- journal listener ----------------------------------------------------
+
+    def _on_records(self, records: List[JournalRecord]) -> None:
+        with self._qlock:
+            self._queue.extend(records)
+
+    def _drain_queue(self) -> List[JournalRecord]:
+        with self._qlock:
+            out, self._queue = self._queue, []
+        return out
+
+    # -- record filtering (the slot-filtered replay) -------------------------
+
+    def _filter(self, rec: JournalRecord) -> Optional[JournalRecord]:
+        if rec.kind in _SKIP_KINDS:
+            return None
+        return self._slot_filter(rec)
+
+    # -- group-ordered apply (follower._apply idiom) -------------------------
+
+    def _apply(self, records: List[JournalRecord]) -> None:
+        if not records:
+            return
+        executor = self.target.executor
+        futures: List = []
+
+        def drain() -> None:
+            for fut in futures:
+                try:
+                    fut.result(timeout=self._timeout_s)
+                except Exception:
+                    # graftlint: allow-bare(catch-up mirrors follower.py: a record may fail exactly as it failed live on the source; counted, never kills the migration)
+                    self.stats["apply_errors"] += 1
+            futures.clear()
+
+        group = None
+        for rec in records:
+            key = (rec.kind, rec.target)
+            if key != group:
+                drain()
+                group = key
+            futures.append(
+                executor.execute_async(rec.target, rec.kind, rec.payload))
+        drain()
+        self.stats["caught_up_records"] += len(records)
+
+    # -- bootstrap ------------------------------------------------------------
+
+    def _bootstrap(self, snap_path: str) -> None:
+        """Import the migrating slots' objects from the source snapshot into
+        the target THROUGH its executor — journaled writes, unlike a direct
+        store restore, so the target's own recovery covers them."""
+        manifest = checkpoint.info(snap_path)
+        names = [n for n in manifest.get("objects", {})
+                 if key_slot(n) in self.slots]
+        if names:
+            # Honor the same .old fallback as checkpoint.load().
+            import os
+
+            path = snap_path
+            if not os.path.exists(os.path.join(path, checkpoint.MANIFEST)):
+                path = snap_path + ".old"
+            executor = self.target.executor
+            with np.load(os.path.join(path, checkpoint.STATE)) as z:
+                for name in names:
+                    info = manifest["objects"][name]
+                    host = z[checkpoint._KEY_PREFIX + name]
+                    meta = dict(info.get("meta") or {})
+                    if info["otype"] == "hll":
+                        executor.execute_sync(name, "hll_import",
+                                              {"regs": host})
+                        store = getattr(self.target.client, "_store", None)
+                        obj = store.get(name) if store is not None else None
+                        if obj is not None and meta:
+                            obj.meta.update(meta)
+                    else:  # bitset / bloom
+                        executor.execute_sync(
+                            name, "bits_import",
+                            {"otype": info["otype"], "array": host,
+                             "meta": meta})
+                    self.stats["bootstrapped_objects"] += 1
+        blob = checkpoint.extra_file(snap_path, STRUCTURES_FILE)
+        if blob is not None:
+            from redisson_tpu.structures.engine import filter_state_dump
+
+            filtered, count = filter_state_dump(
+                blob, lambda name: key_slot(name) in self.slots)
+            if count:
+                self.target.executor.execute_sync(
+                    "", "migrate_install", {"blob": filtered})
+                self.stats["bootstrapped_structures"] = count
+
+    # -- the protocol ---------------------------------------------------------
+
+    def run(self) -> Dict[str, int]:
+        src_persist = self.source.client.persist
+        if src_persist is None or src_persist.journal is None:
+            raise MigrationError(
+                "live migration needs the source shard's journal "
+                "(Config.cluster persists each shard)")
+        journal = src_persist.journal
+        journal.add_listener(self._on_records)
+        cutover_open = False
+        try:
+            self.source.begin_migrate(self.slots, self.target.shard_id)
+            # The SETSLOT IMPORTING analogue: the target's guard must accept
+            # keyed bootstrap/catch-up writes for slots it does not own yet.
+            # Journaled, so a target crash mid-migration replays the same
+            # acceptance before the replayed imports reach its guard.
+            self.target.begin_migrate(self.slots, self.target.shard_id)
+            snap_path = src_persist.snapshot()
+            watermark = int(checkpoint.info(snap_path).get("journal_seq", 0))
+            self._bootstrap(snap_path)
+
+            # Catch-up: chase the live suffix until we're close enough to
+            # cut over. Writes keep flowing to the source the whole time.
+            applied = watermark
+            deadline = time.monotonic() + self._timeout_s
+            while True:
+                pending = [r for r in self._drain_queue() if r.seq > applied]
+                if pending:
+                    applied = pending[-1].seq
+                    self._apply([r for r in
+                                 (self._filter(rec) for rec in pending)
+                                 if r is not None])
+                if journal.last_seq - applied <= self._cutover_lag:
+                    break
+                if time.monotonic() > deadline:
+                    raise MigrationError("catch-up never converged")
+
+            # Cutover: park NEW submissions for the migrating slots (the
+            # ASK window), then journal the flip — its seq is the fence.
+            self.router.begin_cutover(self.slots)
+            cutover_open = True
+            self.source.flip(self.slots)
+            flip_seq = None
+            deadline = time.monotonic() + self._timeout_s
+            while flip_seq is None:
+                for rec in self._drain_queue():
+                    if rec.seq <= applied:
+                        continue
+                    if (rec.kind == "migrate_flip"
+                            and self.slots.issubset(
+                                {int(s) for s in rec.payload["slots"]})):
+                        flip_seq = rec.seq
+                        break
+                    # Strictly pre-flip records replay; anything later for
+                    # our slots was REJECTED on the source (journal append
+                    # precedes the ownership check) and re-routes through
+                    # the router's MOVED retry — applying it here would
+                    # double-apply.
+                    filtered = self._filter(rec)
+                    if filtered is not None:
+                        self._apply([filtered])
+                    applied = rec.seq
+                if flip_seq is None:
+                    if time.monotonic() > deadline:
+                        raise MigrationError("flip record never surfaced")
+                    time.sleep(0.001)
+            self.target.adopt(self.slots)
+            self.router.commit_cutover(self.slots, self.target.shard_id)
+            cutover_open = False
+            return dict(self.stats)
+        finally:
+            if cutover_open:
+                self.router.abort_cutover()
+            journal.remove_listener(self._on_records)

@@ -227,6 +227,16 @@ OP_TABLE = {d.kind: d for d in [
     # Barrier flushing host-mirror bloom bits into device state before a
     # device-side read (durability/checkpoint); internal, no wire analogue.
     _d("bloom_sync", "-", True, "tpu"),
+    # -- cluster tier (cluster/; ClusterConnectionManager.java semantics) ---
+    # Slot-ownership transitions are journaled WRITES: the migrate_flip
+    # record is the cutover point in the source shard's journal (everything
+    # before it replays on the source, everything after re-routes), and
+    # replaying adopt/begin/flip records at recovery rebuilds the guard's
+    # slot table in exactly the order live traffic saw it.
+    _d("migrate_begin", "CLUSTER SETSLOT IMPORTING", True, "cluster"),
+    _d("migrate_flip", "CLUSTER SETSLOT NODE", True, "cluster"),
+    _d("migrate_adopt", "CLUSTER ADDSLOTS", True, "cluster"),
+    _d("migrate_install", "RESTORE", True, "cluster"),
 ]}
 
 

@@ -261,6 +261,36 @@ class MemConfig:
 
 
 @dataclass
+class ClusterConfig:
+    """Slot-sharded namespace (redisson_tpu/cluster/): N full engine stacks
+    each owning contiguous ranges of the 16384 CRC16 slots, fronted by a
+    ClusterRouter that splits batches per owner and handles MOVED/ASK
+    redirects — the engine-owned analogue of ClusterServersConfig /
+    `ClusterConnectionManager.java`. Orthogonal to the per-shard compute
+    mode: each shard runs the Config's compute section (local by default;
+    tpu spreads shards round-robin across visible devices). Live slot
+    migration (`client.cluster.migrate_slots`) requires `dir` so each shard
+    journals."""
+
+    num_shards: int = 4
+    # Root persist directory; each shard journals under <dir>/shard-NN.
+    # "" = no per-shard persistence (migration unavailable).
+    dir: str = ""
+    fsync: str = "off"
+    # Per-shard admission control: front every shard with a ServingLayer
+    # built from Config.serve (which must then be present).
+    shard_serve: bool = False
+    # MOVED redirect retry depth before an op's future fails.
+    redirect_retries: int = 5
+    # Quarantine-then-migrate on topology node_down events (parallel/
+    # topology.py watcher): drain the lost shard's slots onto survivors.
+    auto_heal: bool = True
+    # INTERNAL: >= 0 marks a config built by the ClusterManager for one
+    # shard member (installs the slot-ownership guard); users leave it -1.
+    shard_id: int = -1
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -276,6 +306,8 @@ class Config:
     trace: Optional[TraceConfig] = None
     # Memory watermarks/pressure (None = ledger only, never shed).
     memory: Optional[MemConfig] = None
+    # Slot-sharded cluster tier (None = one engine owns all slots).
+    cluster: Optional[ClusterConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -340,6 +372,14 @@ class Config:
         self.memory = self.memory or MemConfig()
         return self.memory
 
+    def use_cluster(self, num_shards: int = 0, dir: str = "") -> "ClusterConfig":
+        self.cluster = self.cluster or ClusterConfig()
+        if num_shards:
+            self.cluster.num_shards = num_shards
+        if dir:
+            self.cluster.dir = dir
+        return self.cluster
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -374,6 +414,7 @@ class Config:
             "faults": FaultConfig,
             "trace": TraceConfig,
             "memory": MemConfig,
+            "cluster": ClusterConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

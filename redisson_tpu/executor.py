@@ -191,6 +191,11 @@ class CommandExecutor:
     same-kind ops; others receive singletons.
     """
 
+    # Cluster tier: which shard this executor serves (set by the client for
+    # shard members; None = unsharded). Surfaces through pipeline_stats so
+    # per-shard dispatch work is attributable in rollups and traces.
+    shard_tag: Optional[int] = None
+
     def __init__(self, backend, max_batch_keys: int = 1 << 21, metrics=None,
                  policy=None, clock: Callable[[], float] = None,
                  inflight_runs: int = 2, journal=None, trace=None):
@@ -793,6 +798,7 @@ class CommandExecutor:
                 "runs_overlapped": self._runs_overlapped,
                 "overlap_ratio": (self._runs_overlapped / done) if done else 0.0,
                 "staging_bytes": self._staging_bytes,
+                "shard_tag": self.shard_tag,
             }
 
     def staging_bytes(self) -> int:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from redisson_tpu.cluster.split import split_by_owner
 from redisson_tpu.native import RespError
 from redisson_tpu.ops import crc16
 
@@ -348,14 +349,83 @@ class MasterSlaveRouter:
         return self._execute_routed(args, write=name not in READ_COMMANDS)
 
     def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
-        # Batches go to the master (cross-command atomicity expectations);
-        # per-slot splitting is the CommandBatchService refinement.
-        try:
-            return self._run_on(self._master, "pipeline", commands)
-        except (ConnectionError, OSError, TimeoutError):
-            if self._promote():
-                return self._run_on(self._master, "pipeline", commands)
-            raise
+        """Per-slot pipeline split (`CommandBatchService.java:142-182`):
+        group commands by owner endpoint with the same splitter the
+        in-process cluster tier uses (cluster/split.py), dispatch one
+        sub-pipeline per owner, reassemble replies in submission order.
+        With no slot table learned yet every command resolves to the
+        master, so the split degenerates to the single master pipeline
+        (plus the promote-and-retry failover path)."""
+        groups = split_by_owner(
+            commands, lambda _i, cmd: self._endpoint_for(cmd, write=True))
+        if len(groups) <= 1:
+            addr = next(iter(groups), self._master)
+            try:
+                return self._run_on(addr, "pipeline", commands)
+            except (ConnectionError, OSError, TimeoutError):
+                if addr == self._master and self._promote():
+                    return self._run_on(self._master, "pipeline", commands)
+                raise
+        out = self._pipeline_groups(commands, groups)
+        return self._pipeline_redirects(commands, out)
+
+    def _pipeline_groups(self, commands: Sequence[Sequence],
+                         groups: Dict[str, List[int]]) -> List[Any]:
+        """Dispatch one sub-pipeline per owner group; on a connection blip
+        re-resolve EVERY command of the failed group (a concurrent rescan
+        may have split its slots across owners) and resend per new owner; a
+        second failure lands per-command RespErrors in the reply list,
+        keeping the pipeline contract of in-list errors.
+        NOTE at-least-once semantics: a command that already applied on the
+        half-failed first attempt is applied again by the resend — the
+        reference's batch resend carries the same caveat
+        (CommandBatchService.java:332-343)."""
+        out: List[Any] = [None] * len(commands)
+        for addr, idxs in groups.items():
+            cmds = [commands[i] for i in idxs]
+            try:
+                replies = self._run_on(addr, "pipeline", cmds)
+            except (ConnectionError, OSError, TimeoutError):
+                retry_groups: Dict[str, List[int]] = {}
+                for i in idxs:
+                    try:
+                        raddr = self._endpoint_for(commands[i], write=True)
+                    except Exception:  # noqa: BLE001 — no owner resolvable
+                        raddr = addr
+                    retry_groups.setdefault(raddr, []).append(i)
+                for raddr, ridxs in retry_groups.items():
+                    rcmds = [commands[i] for i in ridxs]
+                    try:
+                        rs = self._run_on(raddr, "pipeline", rcmds)
+                    except Exception as exc:  # noqa: BLE001
+                        rs = [RespError(f"CONNECTIONFAIL {raddr}: {exc}")
+                              for _ in rcmds]
+                    for i, r in zip(ridxs, rs):
+                        out[i] = r
+                continue
+            for i, r in zip(idxs, replies):
+                out[i] = r
+        return out
+
+    def _pipeline_redirects(self, commands: Sequence[Sequence],
+                            out: List[Any]) -> List[Any]:
+        """Resend per-command MOVED/ASK replies individually to the right
+        node — the reference's batch redirect contract
+        (`CommandBatchService.java:184-293` clears errors and resends only
+        unfinished commands)."""
+        for i, r in enumerate(out):
+            if isinstance(r, RespError) and (
+                str(r).startswith("MOVED") or str(r).startswith("ASK")
+            ):
+                # A genuine error from the redirected resend stays in the
+                # reply list (same contract as untouched replies) — raising
+                # here would discard every other command's result.
+                try:
+                    out[i] = self._maybe_redirect(r, tuple(commands[i]),
+                                                  write=True, depth=0)
+                except RespError as exc:
+                    out[i] = exc
+        return out
 
     def execute_blocking(self, *args, response_timeout: float) -> Any:
         addr = self._master
@@ -651,63 +721,15 @@ class ClusterRouter(MasterSlaveRouter):
 
     def pipeline(self, commands: Sequence[Sequence]) -> List[Any]:
         """Split a keyed pipeline by slot owner; unkeyed commands ride with
-        the first group. Results return in submission order. Per-command
-        MOVED/ASK replies are resent individually to the right node — the
-        reference's batch redirect contract (`CommandBatchService.java:
-        184-293` clears errors and resends only unfinished commands)."""
-        groups: Dict[str, List[int]] = {}
-        for i, cmd in enumerate(commands):
-            addr = self._endpoint_for(cmd, write=True)
-            groups.setdefault(addr, []).append(i)
-        out: List[Any] = [None] * len(commands)
-        for addr, idxs in groups.items():
-            cmds = [commands[i] for i in idxs]
-            try:
-                replies = self._run_on(addr, "pipeline", cmds)
-            except (ConnectionError, OSError, TimeoutError):
-                # One blip must not void the other groups' (possibly
-                # already-applied) results. A concurrent rescan may have
-                # SPLIT this group's slots across owners, so the retry
-                # re-resolves EVERY command (not just cmds[0]) and resends
-                # per new owner; a second failure lands per-command
-                # RespErrors in the reply list, keeping the pipeline
-                # contract of in-list errors (advisor r3).
-                # NOTE at-least-once semantics: a command that already
-                # applied on the half-failed first attempt is applied again
-                # by the resend — the reference's batch resend carries the
-                # same caveat (CommandBatchService.java:332-343).
-                retry_groups: Dict[str, List[int]] = {}
-                for i in idxs:
-                    try:
-                        raddr = self._endpoint_for(commands[i], write=True)
-                    except Exception:  # noqa: BLE001 — no owner resolvable
-                        raddr = addr
-                    retry_groups.setdefault(raddr, []).append(i)
-                for raddr, ridxs in retry_groups.items():
-                    rcmds = [commands[i] for i in ridxs]
-                    try:
-                        rs = self._run_on(raddr, "pipeline", rcmds)
-                    except Exception as exc:  # noqa: BLE001
-                        rs = [RespError(f"CONNECTIONFAIL {raddr}: {exc}")
-                              for _ in rcmds]
-                    for i, r in zip(ridxs, rs):
-                        out[i] = r
-                continue
-            for i, r in zip(idxs, replies):
-                out[i] = r
-        for i, r in enumerate(out):
-            if isinstance(r, RespError) and (
-                str(r).startswith("MOVED") or str(r).startswith("ASK")
-            ):
-                # A genuine error from the redirected resend stays in the
-                # reply list (same contract as untouched replies) — raising
-                # here would discard every other command's result.
-                try:
-                    out[i] = self._maybe_redirect(r, tuple(commands[i]),
-                                                  write=True, depth=0)
-                except RespError as exc:
-                    out[i] = exc
-        return out
+        the master group. Always takes the split path (never the base
+        class's single-master fast path) so one blip cannot void the other
+        groups' results — the grouping, group dispatch with re-resolve
+        retry, and per-command MOVED/ASK resend all live in the shared
+        MasterSlaveRouter helpers."""
+        groups = split_by_owner(
+            commands, lambda _i, cmd: self._endpoint_for(cmd, write=True))
+        out = self._pipeline_groups(commands, groups)
+        return self._pipeline_redirects(commands, out)
 
     def execute_blocking(self, *args, response_timeout: float) -> Any:
         # Blocking pops are keyed: route to the key's owner.

@@ -37,15 +37,49 @@ from redisson_tpu.persist.journal import (
 from redisson_tpu.persist.snapshotter import STRUCTURES_FILE, find_snapshots
 
 
+def slots_record_filter(slots):
+    """record_filter projecting a journal stream onto a slot subset —
+    `filter(record) -> Optional[record]` for JournalFollower(record_filter=)
+    and the cluster tier's SlotMigrator catch-up. Keyed records pass when
+    their key's slot is in `slots`; the unkeyed multi-key writes (mset /
+    msetnx) are rewritten to the surviving pairs; every other unkeyed
+    record (flushall, script cache, cluster bookkeeping) is dropped —
+    keyspace-wide ops are fanned to every shard by the router directly, so
+    a slot-scoped replica must not double-apply them."""
+    from redisson_tpu.ops.crc16 import key_slot
+
+    slots = frozenset(int(s) for s in slots)
+
+    def _filter(rec: JournalRecord) -> Optional[JournalRecord]:
+        if rec.target:
+            return rec if key_slot(rec.target) in slots else None
+        if rec.kind in ("mset", "msetnx") and isinstance(rec.payload, dict):
+            pairs = {k: v for k, v in rec.payload.get("pairs", {}).items()
+                     if key_slot(k) in slots}
+            if not pairs:
+                return None
+            payload = dict(rec.payload)
+            payload["pairs"] = pairs
+            return rec._replace(payload=payload)
+        return None
+
+    return _filter
+
+
 class JournalFollower:
     def __init__(self, path: str, config=None, poll_interval_s: float = 0.05,
-                 apply_window: int = 1024):
+                 apply_window: int = 1024, record_filter=None):
         from redisson_tpu.client import RedissonTPU
         from redisson_tpu.config import Config
 
         self.path = path
         self._poll_s = poll_interval_s
         self._apply_window = apply_window
+        # Optional record projection (slot-filtered replicas): applied to
+        # every record AFTER the seq cursor advances, so filtered-out
+        # records still count as applied — lag() measures journal position,
+        # not record volume.
+        self._record_filter = record_filter
         cfg = config or Config()
         if getattr(cfg, "persist", None) is not None:
             raise ValueError("follower clients must not persist — they'd "
@@ -115,6 +149,10 @@ class JournalFollower:
     def _apply(self, records: List[JournalRecord]) -> None:
         if not records:
             return
+        last_seq = records[-1].seq
+        if self._record_filter is not None:
+            records = [r for r in (self._record_filter(rec) for rec in records)
+                       if r is not None]
         futures: List = []
         executor = self.client._executor
 
@@ -141,7 +179,7 @@ class JournalFollower:
                 executor.execute_async(rec.target, rec.kind, rec.payload))
         drain()
         with self._applied_lock:
-            self._applied = records[-1].seq
+            self._applied = last_seq
             self._records_applied += len(records)
 
     def _loop(self) -> None:

@@ -161,7 +161,31 @@ class Snapshotter:
         blob = structures.dump_state() if structures is not None else None
         seq = self._journal.last_seq
         self._journal.rotate()
+        self._reseed_ownership()
         return seq, objs, blob
+
+    def _reseed_ownership(self) -> None:
+        """Cluster shards keep slot ownership ONLY in journal records (the
+        guard's migrate_adopt/begin/flip stream — see cluster/shard.py);
+        rotating would orphan that state from the new segment, so re-seed
+        the guard's current owned/migrating sets as the segment's first
+        records. Runs on the dispatcher inside the cut barrier — the only
+        mutating thread — so the sets are exact at the watermark."""
+        guard = self._client._routing
+        owned_fn = getattr(guard, "owned_slots", None)
+        if owned_fn is None:  # not a cluster shard
+            return
+        owned = owned_fn()
+        if owned is None:  # open ownership: replay's default, nothing to pin
+            return
+        reseed = [Op(target="", kind="migrate_adopt",
+                     payload={"slots": sorted(owned)})]
+        migrating = guard.migrating_slots()
+        if migrating:
+            reseed.append(Op(target="", kind="migrate_begin",
+                             payload={"slots": sorted(migrating)}))
+        for op in reseed:
+            self._journal.append_run(op.kind, [op])
 
     def snapshot_now(self) -> str:
         """Take one full snapshot; returns its directory. Blocks until the

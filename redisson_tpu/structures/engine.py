@@ -290,6 +290,23 @@ class StructureBackend(ExtendedOps):
             self._data = data
         return len(data)
 
+    def load_keys(self, blob: bytes) -> int:
+        """Merge a dump_state() capture into the live keyspace (same-named
+        keys are overwritten; everything else is untouched). The slot
+        migration bootstrap path: a target shard installs the migrating
+        slots' keys without disturbing the keys it already owns. Runs as
+        the journaled `migrate_install` op on the dispatcher thread."""
+        import pickle
+
+        payload = pickle.loads(blob)
+        if payload.get("format") != 1:
+            raise ValueError(f"unsupported structure dump format "
+                             f"{payload.get('format')!r}")
+        data = payload["data"]
+        with self._lock:
+            self._data.update(data)
+        return len(data)
+
     # -- generic / expiry (RedissonExpirable surface) ------------------------
 
     def _op_delete(self, key: str, op: Op) -> None:
@@ -1329,3 +1346,19 @@ class StructureBackend(ExtendedOps):
 
     def _op_publish(self, key: str, op: Op) -> None:
         op.future.set_result(self.pubsub.publish(op.payload["channel"], op.payload["message"]))
+
+
+def filter_state_dump(blob: bytes, keep) -> Tuple[bytes, int]:
+    """Project a dump_state() capture onto the keys `keep(name)` accepts,
+    returning (filtered blob, kept count). Pure host-side pickle surgery —
+    the slot migrator filters a source snapshot's structure sidecar down to
+    the migrating slots before shipping it as a `migrate_install` op."""
+    import pickle
+
+    payload = pickle.loads(blob)
+    if payload.get("format") != 1:
+        raise ValueError(
+            f"unsupported structure dump format {payload.get('format')!r}")
+    data = {k: v for k, v in payload["data"].items() if keep(k)}
+    return (pickle.dumps({"format": 1, "data": data},
+                         protocol=pickle.HIGHEST_PROTOCOL), len(data))

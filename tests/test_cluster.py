@@ -1,0 +1,443 @@
+"""Cluster tier tests: CRC16 KEYSLOT vectors, slot routing, guard
+accept/reject, MOVED retry, batch splitting, keyspace fan-out, cross-shard
+PFMERGE, live slot migration under concurrent writes, and crash recovery
+of the slot table.
+
+Runs single-process on the virtual 8-device CPU platform (conftest).
+"""
+
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.cluster import (
+    ClusterCrossSlotError,
+    SlotMovedError,
+    contiguous_assignment,
+    slot_ranges,
+    split_by_owner,
+)
+from redisson_tpu.ops.crc16 import MAX_SLOT, crc16, key_slot
+
+
+# ---------------------------------------------------------------------------
+# CLUSTER KEYSLOT vectors (redis-cli golden) + hashtag semantics
+# ---------------------------------------------------------------------------
+
+
+def test_crc16_known_vector():
+    # The check value from redis's crc16.c: CRC-CCITT (XModem) of the
+    # standard test string.
+    assert crc16(b"123456789") == 0x31C3
+
+
+@pytest.mark.parametrize("key,slot", [
+    # redis-cli CLUSTER KEYSLOT golden values (cluster tutorial / docs).
+    ("foo", 12182),
+    ("hello", 866),
+    ("somekey", 11058),
+    ("foo{hash_tag}", 2515),
+    ("bar{hash_tag}", 2515),
+])
+def test_cluster_keyslot_vectors(key, slot):
+    assert key_slot(key) == slot
+
+
+def test_hashtag_routes_to_tag_slot():
+    # `{user1000}.following` and `.followers` co-locate on user1000's slot.
+    assert key_slot("{user1000}.following") == key_slot("user1000")
+    assert key_slot("{user1000}.followers") == key_slot("user1000")
+
+
+def test_empty_hashtag_falls_back_to_whole_key():
+    # `foo{}{bar}`: the FIRST brace pair is empty, so the whole key hashes
+    # (the second pair is never considered — redis hashtag rules).
+    assert key_slot("foo{}{bar}") == crc16(b"foo{}{bar}") % MAX_SLOT
+    assert key_slot("foo{}{bar}") != key_slot("bar")
+
+
+def test_first_brace_pair_wins():
+    assert key_slot("foo{bar}{zap}") == key_slot("bar")
+    # `foo{{bar}}zap`: tag is `{bar` (first "{" to first "}").
+    assert key_slot("foo{{bar}}zap") == crc16(b"{bar") % MAX_SLOT
+
+
+def test_unclosed_brace_hashes_whole_key():
+    assert key_slot("foo{bar") == crc16(b"foo{bar") % MAX_SLOT
+
+
+# ---------------------------------------------------------------------------
+# splitter + assignment helpers
+# ---------------------------------------------------------------------------
+
+
+def test_split_by_owner_preserves_order():
+    items = ["a", "b", "c", "d", "e"]
+    groups = split_by_owner(items, lambda i, it: i % 2)
+    assert groups == {0: [0, 2, 4], 1: [1, 3]}
+
+
+def test_contiguous_assignment_covers_all_slots():
+    table = contiguous_assignment(MAX_SLOT, 4)
+    assert len(table) == MAX_SLOT
+    assert set(table) == {0, 1, 2, 3}
+    ranges = slot_ranges(table)
+    assert ranges[0][0] == 0 and ranges[-1][1] == MAX_SLOT - 1
+    # Contiguous: each range starts where the previous ended + 1.
+    for (s0, e0, _), (s1, _, _) in zip(ranges, ranges[1:]):
+        assert s1 == e0 + 1
+
+
+# ---------------------------------------------------------------------------
+# 4-shard cluster (no persist) — routing, fan-out, redirects
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster4():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_cluster(num_shards=4)
+    c = RedissonTPU.create(cfg)
+    yield c
+    c.shutdown()
+
+
+def _key_on_shard(client, sid, prefix="k", start=0):
+    table = client.cluster.router.slot_table()
+    i = start
+    while True:
+        k = f"{prefix}{i}"
+        if table[key_slot(k)] == sid:
+            return k
+        i += 1
+
+
+def test_cluster_mode_facade(cluster4):
+    c = cluster4
+    assert c._mode == "cluster"
+    assert c.cluster_keyslot("foo") == 12182
+    ranges = c.cluster_slots()
+    assert ranges[0][0] == 0 and ranges[-1][1] == MAX_SLOT - 1
+    assert {r[2] for r in ranges} == {0, 1, 2, 3}
+    info = c.cluster_info()
+    assert info["cluster_enabled"] == 1
+    assert info["cluster_state"] == "ok"
+    assert info["cluster_slots_assigned"] == MAX_SLOT
+    assert info["cluster_known_nodes"] == 4
+    # INFO surfaces the cluster section.
+    assert "cluster" in c.info()
+
+
+def test_keyed_ops_route_per_slot(cluster4):
+    c = cluster4
+    for sid in range(4):
+        k = _key_on_shard(c, sid, prefix=f"route{sid}:")
+        c.get_bucket(k).set(f"v{sid}")
+        assert c.get_bucket(k).get() == f"v{sid}"
+    # No shard saw a misrouted (rejected) op.
+    assert all(s.guard.rejected_ops == 0 for s in c.cluster.shards.values())
+
+
+def test_atomic_long_and_map_route(cluster4):
+    c = cluster4
+    al = c.get_atomic_long("cl:counter")
+    al.set(10)
+    assert al.add_and_get(5) == 15
+    m = c.get_map("cl:map")
+    m.put("f", "x")
+    assert m.get("f") == "x"
+
+
+def test_cross_shard_buckets_mget_mset(cluster4):
+    c = cluster4
+    keys = [_key_on_shard(c, sid, prefix=f"mg{sid}:") for sid in range(4)]
+    c.get_buckets().set({k: f"mv{i}" for i, k in enumerate(keys)})
+    got = c.get_buckets().get(*keys)
+    assert got == {k: f"mv{i}" for i, k in enumerate(keys)}
+
+
+def test_msetnx_cross_shard_rejected(cluster4):
+    c = cluster4
+    k0 = _key_on_shard(c, 0, prefix="nx0:")
+    k1 = _key_on_shard(c, 1, prefix="nx1:")
+    with pytest.raises(ClusterCrossSlotError):
+        c.get_buckets().try_set({k0: "a", k1: "b"})
+    # Same-shard msetnx works.
+    k0b = _key_on_shard(c, 0, prefix="nx0b:")
+    assert c.get_buckets().try_set({k0: "a", k0b: "b"}) is True
+
+
+def test_cokey_crossslot_check(cluster4):
+    c = cluster4
+    # rename to a key on a different shard: -CROSSSLOT.
+    src = _key_on_shard(c, 0, prefix="rn:")
+    dst = _key_on_shard(c, 1, prefix="rnd:")
+    c.get_bucket(src).set("x")
+    fut = c.cluster.router.execute_async(src, "rename", {"newkey": dst})
+    with pytest.raises(ClusterCrossSlotError):
+        fut.result(10)
+    # Hashtags co-locate: rename succeeds.
+    c.get_bucket("{rnt}a").set("y")
+    c.cluster.router.execute_sync("{rnt}a", "rename", {"newkey": "{rnt}b"})
+    assert c.get_bucket("{rnt}b").get() == "y"
+
+
+def test_keys_and_delete_fan_out(cluster4):
+    c = cluster4
+    keys = [_key_on_shard(c, sid, prefix=f"fan{sid}:") for sid in range(4)]
+    for k in keys:
+        c.get_bucket(k).set("1")
+    found = c.cluster.router.execute_sync("", "keys", {"pattern": "fan*"})
+    assert sorted(found) == sorted(keys)
+    for k in keys:
+        c.get_bucket(k).set(None)  # DEL
+    assert c.cluster.router.execute_sync("", "keys", {"pattern": "fan*"}) == []
+
+
+def test_execute_many_splits_per_owner(cluster4):
+    c = cluster4
+    keys = [_key_on_shard(c, i % 4, prefix=f"em{i}:") for i in range(12)]
+    staged = [(k, "set", {"value": b"b%d" % i}, 0)
+              for i, k in enumerate(keys)]
+    futs = c.cluster.router.execute_many(staged)
+    for f in futs:
+        f.result(30)
+    for i, k in enumerate(keys):
+        assert c.cluster.router.execute_sync(k, "get", None) == b"b%d" % i
+
+
+def test_batch_collector_via_router(cluster4):
+    c = cluster4
+    b = c.create_batch()
+    k0 = _key_on_shard(c, 0, prefix="bat0:")
+    k3 = _key_on_shard(c, 3, prefix="bat3:")
+    b.get_bucket(k0).set_async("p")
+    b.get_bucket(k3).set_async("q")
+    b.execute()
+    assert c.get_bucket(k0).get() == "p"
+    assert c.get_bucket(k3).get() == "q"
+
+
+def test_cross_shard_pfmerge_matches_single_shard_oracle(cluster4):
+    c = cluster4
+    # Three HLLs guaranteed to live on three different shards.
+    names = [_key_on_shard(c, sid, prefix=f"pf{sid}:") for sid in range(3)]
+    vals = [[b"a%d" % i for i in range(300)],
+            [b"b%d" % i for i in range(300)],
+            [b"a%d" % i for i in range(150)]]  # overlaps set 0
+    for n, vs in zip(names, vals):
+        c.get_hyper_log_log(n).add_all(vs)
+    merged = c.get_hyper_log_log(names[0]).merge_with_and_count(*names[1:])
+    # Oracle: same values in ONE hll on one shard (hashtag co-location).
+    oracle = c.get_hyper_log_log("{pforacle}")
+    for vs in vals:
+        oracle.add_all(vs)
+    assert merged == oracle.count()
+    assert c.cluster.router.cross_shard_merges > 0
+    # count_with does not mutate the target.
+    before = c.get_hyper_log_log(names[1]).count()
+    c.get_hyper_log_log(names[1]).count_with(names[2])
+    assert c.get_hyper_log_log(names[1]).count() == before
+
+
+def test_guard_rejects_foreign_slot_with_moved(cluster4):
+    c = cluster4
+    # Submit a key owned by shard 1 DIRECTLY to shard 0's dispatch: the
+    # ownership guard must reject it on the future with SlotMovedError.
+    k = _key_on_shard(c, 1, prefix="rej:")
+    shard0 = c.cluster.shards[0]
+    fut = shard0.dispatch.execute_async(k, "set", {"value": b"x"})
+    with pytest.raises(SlotMovedError):
+        fut.result(10)
+    assert shard0.guard.rejected_ops > 0
+
+
+def test_moved_retry_lands_on_new_owner(cluster4):
+    """Deterministic MOVED retry: hold shard 0's dispatcher with a barrier,
+    enqueue a flip followed by keyed writes (they pass the router's resolve
+    while the table still says shard 0), open the ASK window, release. The
+    writes dispatch after the flip, get rejected with SlotMovedError, the
+    redirect worker re-resolves — parking on the window — and lands them on
+    the new owner after the table commit. Zero lost acks."""
+    c = cluster4
+    router = c.cluster.router
+    src, tgt = c.cluster.shards[0], c.cluster.shards[1]
+    keys = [_key_on_shard(c, 0, prefix=f"mvd{i}:") for i in range(8)]
+    slots = sorted({key_slot(k) for k in keys})
+
+    entered, release = threading.Event(), threading.Event()
+
+    def hold():
+        entered.set()
+        release.wait(30)
+
+    redirects0 = router.redirects
+    bfut = src.executor.execute_barrier(hold)
+    assert entered.wait(10)
+    # Everything below enqueues behind the barrier on shard 0.
+    fflip = src.executor.execute_async("", "migrate_flip", {"slots": slots})
+    wfuts = [router.execute_async(k, "set", {"value": b"mv%d" % i})
+             for i, k in enumerate(keys)]
+    tgt.adopt(slots)
+    router.begin_cutover(slots)
+    release.set()
+    bfut.result(30)
+    fflip.result(30)
+    time.sleep(0.05)
+    router.commit_cutover(slots, tgt.shard_id)
+    for f in wfuts:
+        f.result(30)  # every ack lands despite the mid-flight move
+    assert router.redirects > redirects0
+    for i, k in enumerate(keys):
+        assert router.execute_sync(k, "get", None) == b"mv%d" % i
+        assert router.slot_table()[key_slot(k)] == tgt.shard_id
+
+
+def test_shard_stats_surface(cluster4):
+    stats = cluster4.cluster.stats()
+    assert set(stats["shards"]) == {0, 1, 2, 3}
+    for s in stats["shards"].values():
+        assert s["owned_slots"] > 0
+        assert not s["quarantined"]
+
+
+def test_topology_quarantine_round_trip():
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_cluster(num_shards=2)
+    cfg.cluster.auto_heal = False  # no journal: drain would be refused
+    c = RedissonTPU.create(cfg)
+    try:
+        mgr = c.cluster
+        down = {"ok": True}
+        mgr.set_pinger(1, lambda: down["ok"])
+        down["ok"] = False
+        for _ in range(mgr.topology.failed_attempts):
+            mgr.topology.scan_once()
+        assert mgr.shards[1].quarantined
+        assert c.cluster_info()["cluster_state"] == "degraded"
+        down["ok"] = True
+        mgr.topology.scan_once()
+        assert not mgr.shards[1].quarantined
+        assert c.cluster_info()["cluster_state"] == "ok"
+    finally:
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# live migration (persisted shards) + recovery
+# ---------------------------------------------------------------------------
+
+
+def _make_persisted_cluster(tmp_path, num_shards=3):
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_cluster(num_shards=num_shards, dir=str(tmp_path / "cl"))
+    return RedissonTPU.create(cfg)
+
+
+def test_live_migration_under_concurrent_writes(tmp_path):
+    c = _make_persisted_cluster(tmp_path, num_shards=3)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        keys = []
+        i = 0
+        while len(keys) < 60:
+            k = f"lm{i}"
+            if table[key_slot(k)] == 0:
+                keys.append(k)
+            i += 1
+        for k in keys:
+            c.get_bucket(k).set("v0")
+        move_slots = sorted({key_slot(k) for k in keys})
+        hll_key = next(k for k in keys)  # reuse a migrating slot's tag
+        h = c.get_hyper_log_log("{%s}hll" % hll_key)
+        h.add_all([b"h%d" % j for j in range(500)])
+        est0 = h.count()
+
+        errs, acked = [], {}
+        stop = threading.Event()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                k = keys[n % len(keys)]
+                v = f"w{n}"
+                try:
+                    c.get_bucket(k).set(v)
+                    acked[k] = v
+                except Exception as e:  # noqa: BLE001 — any lost ack fails the test below
+                    errs.append((k, repr(e)))
+                n += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        stats = mgr.migrate_slots(move_slots, 2, timeout_s=60)
+        time.sleep(0.2)
+        stop.set()
+        t.join(10)
+
+        assert errs == []  # zero lost acks
+        assert stats["apply_errors"] == 0
+        # Digest: every acked write reads back its final acked value.
+        for k, v in acked.items():
+            assert c.get_bucket(k).get() == v
+        # Ownership flipped for every migrated slot.
+        post = mgr.router.slot_table()
+        assert all(post[s] == 2 for s in move_slots)
+        # The co-located HLL migrated with its slot, count preserved.
+        assert c.get_hyper_log_log("{%s}hll" % hll_key).count() == est0
+        assert mgr.migrations == 1
+    finally:
+        c.shutdown()
+
+
+def test_add_shard_and_migrate_into_it(tmp_path):
+    c = _make_persisted_cluster(tmp_path, num_shards=2)
+    try:
+        mgr = c.cluster
+        k = _key_on_shard(c, 0, prefix="grow:")
+        c.get_bucket(k).set("here")
+        new_id = mgr.add_shard()
+        assert new_id == 2
+        assert mgr.shards[new_id].owned_count() == 0
+        mgr.migrate_slots([key_slot(k)], new_id, timeout_s=60)
+        assert mgr.router.slot_table()[key_slot(k)] == new_id
+        assert c.get_bucket(k).get() == "here"
+        info = c.cluster_info()
+        assert info["cluster_known_nodes"] == 3
+    finally:
+        c.shutdown()
+
+
+def test_slot_table_recovers_after_restart(tmp_path):
+    c = _make_persisted_cluster(tmp_path, num_shards=2)
+    k = _key_on_shard(c, 0, prefix="rec:")
+    slot = key_slot(k)
+    try:
+        c.get_bucket(k).set("durable")
+        c.cluster.migrate_slots([slot], 1, timeout_s=60)
+        assert c.cluster.router.slot_table()[slot] == 1
+        table_before = c.cluster.router.slot_table()
+    finally:
+        c.shutdown()
+
+    c2 = _make_persisted_cluster(tmp_path, num_shards=2)
+    try:
+        # Journal replay rebuilt each guard's ownership; the manager's
+        # recovered table must agree — including the migrated slot.
+        assert c2.cluster.router.slot_table() == table_before
+        assert c2.cluster.router.slot_table()[slot] == 1
+        assert c2.get_bucket(k).get() == "durable"
+    finally:
+        c2.shutdown()

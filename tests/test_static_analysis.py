@@ -365,6 +365,23 @@ def test_g007_suppression_with_reason():
     assert "G007" not in rules_of(findings)
 
 
+def test_g007_covers_cluster_write_kinds():
+    """The slot-migration kinds are write=True in OP_TABLE, so G007's
+    registry-derived write set must flag a direct `.run()` of any of them
+    — ownership changes that bypass the journal would silently diverge on
+    recovery (tests/test_cluster.py proves the replay depends on them)."""
+    from redisson_tpu.cluster.shard import CLUSTER_KINDS
+    from tools.graftlint.astlint import _write_kinds
+
+    assert CLUSTER_KINDS <= _write_kinds()
+    for kind in sorted(CLUSTER_KINDS):
+        findings = lint_src(f"""
+            def flip(backend, ops):
+                backend.run("{kind}", "", ops)
+        """)
+        assert "G007" in rules_of(findings), kind
+
+
 def lint_scoped(src, filename="redisson_tpu/executor.py"):
     """Lint an in-memory source under an in-repo relpath (G008 and the
     other scope-gated rules key on the repo-relative location)."""

@@ -170,6 +170,39 @@ def test_device_loss_carries_all_sharded_state(pod_client):
     assert pod_client.get_bloom_filter("dl:bloom").contains_count_ints(keys) == 700
 
 
+def test_on_change_drives_pod_reshard(pod_client):
+    """End-to-end node_down/node_up round-trip: the TopologyManager's
+    on_change hook drives PodBackend.reshard — the failure-driven elastic
+    path the cluster tier's quarantine-then-migrate mirrors."""
+    backend = pod_client._backend.sketch
+    ndev0 = backend.mesh.devices.size
+    assert ndev0 >= 2
+    nodes = {f"dev{i}": FlakyNode() for i in range(ndev0)}
+    tm = TopologyManager(failed_attempts=1)
+    for ident, n in nodes.items():
+        tm.add_node(ident, n.ping)
+    tm.on_change(lambda live: backend.reshard(max(1, len(live))))
+
+    h = pod_client.get_hyper_log_log("oc:h")
+    h.add_all([b"v%d" % i for i in range(5000)])
+    est = h.count()
+
+    # Half the nodes die: one scan fires node_down events + on_change,
+    # which reshards the mesh down. State survives.
+    for i in range(ndev0 // 2, ndev0):
+        nodes[f"dev{i}"].ok = False
+    assert tm.scan_once()
+    assert backend.mesh.devices.size == ndev0 // 2
+    assert pod_client.get_hyper_log_log("oc:h").count() == est
+
+    # They come back: scan reshards up, state still intact.
+    for n in nodes.values():
+        n.ok = True
+    assert tm.scan_once()
+    assert backend.mesh.devices.size == ndev0
+    assert pod_client.get_hyper_log_log("oc:h").count() == est
+
+
 def test_client_topology_manager_facade():
     from redisson_tpu.client import RedissonTPU
 
