@@ -341,6 +341,8 @@ def bench_delta_ingest(n, reps):
                 stats["raw_bytes"] / max(stats["delta_keys"], 1), 3),
             "merge_launches_per_run": round(launches_per_run, 2),
             "delta_runs": stats["delta_runs"],
+            "launches_per_window": round(stats["launches_per_window"], 2),
+            "launch_us_per_window": round(stats["launch_us_per_window"], 1),
             "binding": "hbm",  # elementwise merge: no scatter-issue bound
         }
         print(
@@ -353,6 +355,79 @@ def bench_delta_ingest(n, reps):
         return out
     finally:
         client.shutdown()
+
+
+def bench_tape_window(n, reps):
+    """Window megakernel vs chunked delta: per-window DISPATCH cost.
+
+    The roofline section pins the ingest ceiling at scatter-ISSUE —
+    per-launch overhead, not HBM bandwidth. The tape path attacks that
+    term directly: the whole mixed hll+bloom+bitset window is encoded
+    into one command tape and retired by ONE fused launch. This bench
+    runs the same mixed-window burst under ingest="delta" (gather +
+    per-plane decode + merge + writeback launch train) and
+    ingest="tape", and reports the OBSERVED `launches_per_window` /
+    `launch_us_per_window` for both (acceptance: tape == 1 launch and
+    >= 4x lower issue time per window)."""
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config, TpuConfig
+
+    def run(ingest):
+        client = RedissonTPU.create(Config(tpu=TpuConfig(ingest=ingest)))
+        try:
+            sketch = client._routing.sketch
+            h = client.get_hyper_log_log("bench:tape:hll")
+            f = client.get_bloom_filter("bench:tape:bloom")
+            f.try_init(expected_insertions=200_000, false_probability=0.01)
+            bs = client.get_bit_set("bench:tape:bits")
+            rng = np.random.default_rng(17)
+
+            def burst():
+                futs = [
+                    h.add_ints_async(
+                        rng.integers(0, 2**63, n, dtype=np.uint64)),
+                    f.add_ints_async(rng.integers(
+                        0, 2**62, max(n // 4, 1), dtype=np.uint64)),
+                    bs.set_bits_async(
+                        rng.integers(0, 1 << 20, 256, dtype=np.int64)),
+                ]
+                for fu in futs:
+                    fu.result(timeout=120)
+
+            burst()  # warmup: compile every shape the window will see
+            s0 = sketch.ingest_stats()
+            t0 = time.perf_counter()
+            for _ in range(max(reps - 1, 1)):
+                burst()
+            dt = time.perf_counter() - t0
+            s1 = sketch.ingest_stats()
+            windows = (s1["delta_runs"] + s1["tape_runs"]
+                       - s0["delta_runs"] - s0["tape_runs"])
+            launches = s1["window_launches"] - s0["window_launches"]
+            us = s1["launch_us"] - s0["launch_us"]
+            return {
+                "launches_per_window": round(launches / max(windows, 1), 2),
+                "launch_us_per_window": round(us / max(windows, 1), 1),
+                "windows": windows,
+                "inserts_per_sec": round(max(reps - 1, 1) * n / dt, 1),
+            }
+        finally:
+            client.shutdown()
+
+    delta = run("delta")
+    tape = run("tape")
+    speedup = (delta["launch_us_per_window"]
+               / max(tape["launch_us_per_window"], 1e-9))
+    print(
+        f"# tape window: {tape['launches_per_window']} launches/window "
+        f"@ {tape['launch_us_per_window']} us (delta: "
+        f"{delta['launches_per_window']} @ "
+        f"{delta['launch_us_per_window']} us) -> "
+        f"{speedup:.1f}x lower issue cost",
+        file=sys.stderr,
+    )
+    return {"delta": delta, "tape": tape,
+            "launch_us_speedup": round(speedup, 2)}
 
 
 def bench_roofline(jax, dev, n, kernel_rate, segment_rate=0.0, quick=False):
@@ -827,6 +902,17 @@ def main():
                   file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"# delta ingest bench failed: {exc!r}", file=sys.stderr)
+    try:
+        from redisson_tpu import native as _native
+
+        if _native.available():
+            result["tape_window"] = bench_tape_window(
+                1 << 12 if quick else 1 << 16, 3 if quick else 12)
+        else:
+            print("# tape window bench skipped: native lib unavailable",
+                  file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# tape window bench failed: {exc!r}", file=sys.stderr)
     try:
         result["hll_count_cached"] = bench_read_cache(
             1 << 12 if quick else 1 << 18, reps=5 if quick else 20)

@@ -75,7 +75,7 @@ class SlotOwnershipBackend:
 
     # -- the waist ----------------------------------------------------------
 
-    def run(self, kind: str, target: str, ops: List) -> None:
+    def run(self, kind: str, target: str, ops: List, window=None) -> None:
         if kind in CLUSTER_KINDS:
             self._run_cluster(kind, ops)
             return
@@ -104,7 +104,7 @@ class SlotOwnershipBackend:
                 if not live:
                     return
                 ops = live
-        self._inner.run(kind, target, ops)
+        self._inner.run(kind, target, ops, window=window)
 
     # -- ownership transitions (journaled; dispatcher thread) ---------------
 

@@ -50,8 +50,11 @@ class TpuConfig:
     # "delta" folds hll_add/bloom_add/bitset_set batches into per-target
     # delta planes on the host and retires every plane staged in one
     # pipeline window through a single fused device merge (README "Delta
-    # ingest"); under "auto" the same path competes in the planner's cost
-    # table as the "delta" candidate.
+    # ingest"); "tape" goes one step further and encodes the WHOLE window
+    # into a flat command tape retired by one fused megakernel launch
+    # (README "Window megakernel"); under "auto" both compete in the
+    # planner's cost table as the "delta" / "tape" candidates ("tape"
+    # only once its observed launch saving has been measured).
     ingest: str = "auto"
     hash_seed: int = 0
     # Coalescing cap for one dispatcher run. Device kernels still chunk at

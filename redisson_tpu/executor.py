@@ -229,6 +229,13 @@ class CommandExecutor:
         # state) let the per-target/per-kind gates release at stage time.
         self._window = max(1, int(inflight_runs))
         self._eager_release = bool(getattr(backend, "DISPATCH_TIME_STATE", False))
+        # Window handoff: backends that retire a whole pipeline window in
+        # one fused launch (the tape megakernel) receive a monotonically
+        # increasing window sequence with each run, so per-window dispatch
+        # cost (launches_per_window, launch_us_per_window) is attributable
+        # without the backend guessing at run boundaries.
+        self._window_handoff = bool(getattr(backend, "WINDOW_HANDOFF", False))
+        self._window_seq = 0
         self._inflight: set = set()  # _InflightRun tokens
         self._inflight_targets: set = set()  # gated object names
         self._inflight_kinds: set = set()  # gated GLOBAL_COALESCE kinds
@@ -630,7 +637,12 @@ class CommandExecutor:
                 return
         try:
             fault_inject.fire("kernel_launch", kind=kind, target=target)
-            self._backend.run(kind, target, live)
+            if self._window_handoff:
+                self._window_seq += 1
+                self._backend.run(kind, target, live,
+                                  window=self._window_seq)
+            else:
+                self._backend.run(kind, target, live)
             t_staged = self._clock()
             token.stage_s = t_staged - t0
             if spans:

@@ -171,7 +171,11 @@ class IngestPlanner:
         `device_overhead` (ns/key) is added to every device path before
         the comparison — the caller's per-key H2D transfer cost, which
         the kernel-only measurement cannot see but a host-side candidate
-        in `extra_costs` (hostfold) does not pay.
+        in `extra_costs` (hostfold) does not pay. Window-level candidates
+        ride the same dict: the backend prices "tape" (the window
+        megakernel) as the delta cost minus its OBSERVED per-key launch
+        saving, so the tape only enters the table once the chunked path's
+        dispatch cost has actually been measured — never on faith.
         """
         if forced != "auto":
             return IngestPlan(path=forced, costs={}, measured=False)

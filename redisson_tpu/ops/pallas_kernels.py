@@ -63,13 +63,15 @@ def _merge_kernel(stack_ref, out_ref):
     out_ref[:] = jnp.maximum(out_ref[:], jnp.max(stack_ref[:], axis=0))
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("block",))
 def merge_stack(stack: jnp.ndarray, block: int = 64) -> jnp.ndarray:
     """Elementwise max over the leading axis of an [S, m] int32 bank.
 
     Streams `block` sketches per grid step through VMEM (block * m * 4
     bytes; 64 * 64 KB = 4 MB) with a VMEM-resident [m] accumulator.
-    Registers are >= 0 so zero-padding the ragged tail is a no-op.
+    Registers are >= 0 so zero-padding the ragged tail is a no-op. The
+    stack is a per-call temporary (callers jnp.stack it), so it donates —
+    a bank-sized reduce must not hold two bank-sized buffers live.
     """
     s, m = stack.shape
     if s == 0:
@@ -110,7 +112,7 @@ def _delta_merge_kernel(old_ref, delta_ref, out_ref, changed_ref):
         merged != old_ref[:]).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("block",))
 def delta_merge(old: jnp.ndarray, delta: jnp.ndarray, block: int = 1 << 15):
     """The delta-ingest retire kernel: elementwise max of two [T, L] uint8
     stacks (one row per target; OR == max in the unpacked 0/1 cell domain,
@@ -120,6 +122,9 @@ def delta_merge(old: jnp.ndarray, delta: jnp.ndarray, block: int = 1 << 15):
     outer grid axis with a per-row SMEM changed accumulator (the TPU grid
     is sequential, inner axis fastest, so the `j == 0` reset is safe).
     Purely elementwise — bandwidth-bound, no scatter issue port in sight.
+    `old` donates AND aliases the merged output, so the merge lands in
+    place: peak HBM is one [T, L] stack plus the delta, never two copies
+    of the old state (the memstat ledger test pins this).
     Returns (merged [T, L], changed [T] bool)."""
     t, l = old.shape
     block = min(block, l)
@@ -144,6 +149,7 @@ def delta_merge(old: jnp.ndarray, delta: jnp.ndarray, block: int = 1 << 15):
             pl.BlockSpec((1, 1), lambda i, j: (i, 0),
                          memory_space=pltpu.SMEM),
         ),
+        input_output_aliases={0: 0},
         interpret=_interpret(),
     )(old, delta)
     return merged, changed[:, 0] != 0
