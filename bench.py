@@ -802,6 +802,120 @@ def bench_pfmerge(jax, dev, sketches=1000):
     return merge_ms
 
 
+def bench_replica(quick=False):
+    """Read-replica fleet numbers (PR 13): reads/s with 0 vs 2 replicas
+    on the compute-read workload (BITCOUNT + cache-busting trickle writer,
+    the --replica-smoke scaling gate's shape), and failover_s — wall time
+    from killing the primary to a promoted, writable successor."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    n_bits = 1 << 20 if quick else 1 << 21
+    n_targets = 2 if quick else 4
+    phase_s = 1.0 if quick else 3.0
+    n_threads = 4
+    tmp = tempfile.mkdtemp(prefix="rtpu-bench-replica-")
+    out = {}
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_serve()
+    cfg.use_persist(os.path.join(tmp, "p")).fsync = "always"
+    rc = cfg.use_replicas(2)
+    rc.poll_interval_s = 0.002
+    rc.max_lag_seqs = 1 << 30
+    rc.health_interval_s = 0.0
+    c = RedissonTPU.create(cfg)
+    try:
+        router = c._dispatch
+        fleet = list(c.replicas.replicas)
+        targets = [f"rb{i}" for i in range(n_targets)]
+        for t in targets:
+            c.get_bit_set(t).set_range(0, n_bits, True)
+        c.wait_for_replicas(2, timeout_s=60.0)
+
+        def warmup():
+            for _ in range(4):
+                for t in targets:
+                    router.execute_sync(t, "bitset_cardinality", None,
+                                        max_lag=1 << 30,
+                                        read_your_writes=False)
+            for rep in fleet:
+                for t in targets:
+                    rep.execute_read(t, "bitset_cardinality",
+                                     None).result(30)
+
+        def measure():
+            warmup()
+            stop_w, stop_r = threading.Event(), threading.Event()
+            counts = [0] * n_threads
+
+            def trickle():
+                i = 0
+                while not stop_w.wait(0.001):
+                    c.get_bit_set(targets[i % n_targets]).set_bits(
+                        [i % n_bits])
+                    i += 1
+
+            def reader(slot):
+                j = slot
+                while not stop_r.is_set():
+                    router.execute_sync(
+                        targets[j % n_targets], "bitset_cardinality", None,
+                        max_lag=1 << 30, read_your_writes=False)
+                    counts[slot] += 1
+                    j += 1
+
+            wt = threading.Thread(target=trickle, daemon=True)
+            wt.start()
+            threads = [threading.Thread(target=reader, args=(s,),
+                                        daemon=True)
+                       for s in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(phase_s)
+            stop_r.set()
+            for t in threads:
+                t.join(30)
+            wall = time.perf_counter() - t0
+            stop_w.set()
+            wt.join(10)
+            return sum(counts) / wall
+
+        router.set_replicas([])
+        rps0 = measure()
+        router.set_replicas(fleet)
+        rps2 = measure()
+        out["reads_per_sec_0_replicas"] = round(rps0, 1)
+        out["reads_per_sec_2_replicas"] = round(rps2, 1)
+        out["read_scaling_x"] = round(rps2 / rps0, 2) if rps0 else 0.0
+
+        # failover: kill the primary, promote, first write on the successor
+        mgr = c.replicas
+        c._executor.shutdown(wait=False)
+        t0 = time.perf_counter()
+        promoted = mgr.failover("bench kill")
+        c.get_bucket("post-failover").set(1)
+        out["failover_s"] = round(time.perf_counter() - t0, 4)
+        out["failover_promote_s"] = round(mgr.last_failover_s, 4)
+        out["resyncs_full"] = mgr.full_resyncs()
+        out["resyncs_partial"] = mgr.partial_resyncs()
+        assert promoted is not None
+    finally:
+        c.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"# replica: {out['reads_per_sec_0_replicas']:,.0f} reads/s bare "
+          f"-> {out['reads_per_sec_2_replicas']:,.0f} with 2 replicas "
+          f"({out['read_scaling_x']}x); failover {out['failover_s'] * 1e3:.0f}"
+          f" ms to first write on the successor", file=sys.stderr)
+    return out
+
+
 def main():
     import os
 
@@ -935,6 +1049,10 @@ def main():
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)
     except Exception as exc:  # noqa: BLE001
         print(f"# pfmerge bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["replica"] = bench_replica(quick)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# replica bench failed: {exc!r}", file=sys.stderr)
     try:
         mem = bench_memstat(1 << 12 if quick else 1 << 18)
         result["hbm_live_bytes"] = mem["hbm_live_bytes"]

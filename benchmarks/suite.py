@@ -1007,10 +1007,45 @@ def tape_smoke():
     return ok
 
 
+def _canon_state(obj, h):
+    """Canonical identity-free rendering (raw pickle bytes differ across
+    equal graphs when internal sharing differs — pickle memoizes by id)."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        h.update(repr(obj).encode())
+    elif isinstance(obj, (bytearray, memoryview)):
+        h.update(b"B" + bytes(obj))
+    elif isinstance(obj, dict):
+        h.update(b"{")
+        for k, v in obj.items():
+            _canon_state(k, h)
+            h.update(b":")
+            _canon_state(v, h)
+        h.update(b"}")
+    elif isinstance(obj, (list, tuple)):
+        h.update(b"[")
+        for v in obj:
+            _canon_state(v, h)
+            h.update(b",")
+        h.update(b"]")
+    elif isinstance(obj, (set, frozenset)):
+        h.update(b"<")
+        for r in sorted(repr(v) for v in obj):
+            h.update(r.encode() + b",")
+        h.update(b">")
+    elif isinstance(obj, np.ndarray):
+        h.update(str(obj.dtype).encode() + str(obj.shape).encode())
+        h.update(obj.tobytes())
+    else:
+        h.update(type(obj).__name__.encode())
+        state = getattr(obj, "__dict__", None)
+        _canon_state(state if state is not None else repr(obj), h)
+
+
 def _engine_digest(client) -> str:
     """Bit-identical engine fingerprint (sketch arrays + structure tier) —
     the same definition tests/test_persist.py pins recovery against."""
     import hashlib
+    import pickle
 
     h = hashlib.sha256()
     store = client._store
@@ -1026,7 +1061,7 @@ def _engine_digest(client) -> str:
         h.update(repr(sorted(obj.meta.items())).encode())
     structures = getattr(client._routing, "structures", None)
     if structures is not None:
-        h.update(structures.dump_state())
+        _canon_state(pickle.loads(structures.dump_state()), h)
     return h.hexdigest()
 
 
@@ -1754,6 +1789,282 @@ def cluster_smoke():
     return ok
 
 
+def replica_smoke():
+    """Read-replica fleet acceptance (the CPU-only CI contract for
+    redisson_tpu/replica/). Gates:
+
+      (a) BOUNDED STALENESS: randomized mixed traffic against 2 replicas —
+          every replica-served read must equal the primary's state replayed
+          at SOME seq inside [pick watermark, primary seq], and every
+          read-your-writes read returns the tenant's own latest write;
+      (b) FAILOVER: kill the primary mid-traffic; the health prober
+          promotes automatically, zero acked writes are lost, and the
+          promoted engine's digest is identical to a fault-free oracle
+          replaying the fenced journal;
+      (c) READ SCALING: compute-heavy reads (BITCOUNT over multi-Mbit
+          bitsets, XLA releases the GIL) with a cache-busting trickle
+          writer — throughput from 0 -> 2 replicas must reach >= 1.5x.
+    """
+    import json as _json
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.persist.journal import iter_records
+
+    ok = True
+    tmp = tempfile.mkdtemp(prefix="rtpu-replica-smoke-")
+
+    def replicated(subdir, n=2, fsync="always", **rkw):
+        cfg = Config()
+        cfg.use_local()
+        cfg.use_serve()
+        cfg.use_persist(os.path.join(tmp, subdir)).fsync = fsync
+        rc = cfg.use_replicas(n)
+        for k, v in rkw.items():
+            setattr(rc, k, v)
+        return RedissonTPU.create(cfg)
+
+    # -- (a) bounded staleness under randomized mixed traffic ------------
+    n_steps = 300 if _TINY else 1500
+    lag_bound = 8
+    # Slow replica poll keeps real staleness in play: replicas trail by a
+    # few seqs, so the bound (and the primary fallback) actually bites.
+    c = replicated("stale", poll_interval_s=0.03, max_lag_seqs=lag_bound)
+    try:
+        router = c._dispatch
+        keys = [f"sb{i}" for i in range(8)]
+        hist = {k: [(0, None)] for k in keys}  # (seq, raw value) timeline
+        rng = random.Random(0x57A1E)
+        served = fallbacks = ryw_checked = violations = 0
+        for step in range(n_steps):
+            k = rng.choice(keys)
+            if rng.random() < 0.5:
+                v = f"s{step}"
+                c.get_bucket(k).set(v)
+                hist[k].append((c.persist.journal.last_seq,
+                                _json.dumps(v).encode()))
+                if rng.random() < 0.2:
+                    # RYW: this tenant's next read must see its own write.
+                    fut, _, _ = router.routed_read(
+                        k, "get", None, max_lag=1 << 30,
+                        read_your_writes=True)
+                    ryw_checked += 1
+                    if fut.result(30) != hist[k][-1][1]:
+                        violations += 1
+            else:
+                fut, rep, wm = router.routed_read(
+                    k, "get", None, max_lag=lag_bound,
+                    read_your_writes=False)
+                res = fut.result(30)
+                hi = c.persist.journal.last_seq
+                if rep is None:
+                    fallbacks += 1
+                    continue
+                served += 1
+                # Valid iff res is k's value at SOME seq in [wm, hi].
+                valid = any(
+                    val == res
+                    for s, val in hist[k]
+                    if s <= hi and not any(
+                        s < s2 <= wm for s2, _ in hist[k])
+                )
+                if not valid:
+                    violations += 1
+        print(f"# replica-smoke[staleness]: {served} replica reads + "
+              f"{fallbacks} primary fallbacks over {n_steps} steps "
+              f"(lag bound {lag_bound} seqs), {ryw_checked} RYW probes; "
+              f"{violations} bound violations")
+        if violations or served == 0 or ryw_checked == 0:
+            print("#   bounded-staleness gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        _close(c)
+
+    # -- (b) kill-primary failover: zero acked loss, oracle digest -------
+    n_fkeys = 8
+    c = replicated("fail", poll_interval_s=0.005,
+                   health_interval_s=0.05, health_failures=2)
+    promoted_client = None
+    oracle = None
+    try:
+        old_journal_dir = c.persist.cfg.dir
+        fkeys = [f"fk{i}" for i in range(n_fkeys)]
+        for k in fkeys:
+            c.get_bucket(k).set("seed")
+        assert c.wait_for_replicas(2, timeout_s=30.0) == 2
+        attempted = {k: ["seed"] for k in fkeys}  # every value we tried
+        last_acked = {k: 0 for k in fkeys}        # index into attempted[k]
+        stop = threading.Event()
+        rng = random.Random(0xFA11)
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                k = rng.choice(fkeys)
+                v = f"w{n}"
+                attempted[k].append(v)
+                idx = len(attempted[k]) - 1
+                try:
+                    c.get_bucket(k).set(v)
+                    last_acked[k] = idx  # fsync=always: acked == durable
+                except Exception:  # noqa: BLE001 — the kill lands here
+                    return
+                n += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        time.sleep(0.3)
+        mgr = c.replicas
+        c._executor.shutdown(wait=False)  # kill the primary mid-traffic
+        deadline = time.time() + 30
+        while mgr.promotions == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        stop.set()
+        wt.join(10)
+        auto = mgr.promotions == 1
+        promoted_client = mgr._promoted.client if auto else None
+        lost = []
+        if auto:
+            for k in fkeys:
+                raw = promoted_client._dispatch.execute_sync(k, "get", None)
+                vals = attempted[k]
+                # acked-or-newer: the promoted value must sit at/after the
+                # last acked attempt (a journaled-but-unacked tail write
+                # may legitimately survive; an acked one may never vanish).
+                sur = [_json.dumps(v).encode() for v in
+                       vals[last_acked[k]:]]
+                if raw not in sur:
+                    lost.append(k)
+            # Fault-free oracle: a fresh engine replaying the fenced
+            # journal serially IS the committed history.
+            oracle = RedissonTPU.create(Config())
+            for rec in iter_records(old_journal_dir):
+                oracle._dispatch.execute_sync(rec.target, rec.kind,
+                                              rec.payload)
+            digest_same = _engine_digest(oracle) == _engine_digest(
+                promoted_client)
+        else:
+            digest_same = False
+        n_acked = sum(last_acked[k] > 0 for k in fkeys)
+        print(f"# replica-smoke[failover]: auto-promote "
+              f"{'fired' if auto else 'NEVER FIRED'} "
+              f"({mgr.last_failover_reason!r}, "
+              f"{mgr.last_failover_s * 1e3:.0f} ms), "
+              f"{n_acked}/{len(fkeys)} keys had acked overwrites, "
+              f"lost acks {len(lost)}, oracle digest "
+              f"{'identical' if digest_same else 'MISMATCH'}, "
+              f"resyncs full={mgr.full_resyncs()} "
+              f"partial={mgr.partial_resyncs()}")
+        if not auto or lost or not digest_same:
+            print("#   failover gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        if oracle is not None:
+            oracle.shutdown()
+        _close(c)
+
+    # -- (c) read scaling 0 -> 2 replicas on compute-heavy reads ---------
+    # The fleet's win on the CPU proxy is twofold: BITCOUNT compute runs
+    # under a released GIL, and — with fsync=always — the primary's
+    # dispatcher stalls in journal fsync on every trickle write, stalls
+    # the replicas' read pipelines simply don't have. Per-read compute
+    # stays moderate: monster bitsets would serialize raw compute through
+    # the one shared XLA threadpool and bury both effects.
+    n_bits = 1 << 21
+    n_targets = 2 if _TINY else 4
+    phase_s = 1.5 if _TINY else 3.0
+    n_threads = 4
+    c = replicated("scale", poll_interval_s=0.002, max_lag_seqs=1 << 30)
+    try:
+        router = c._dispatch
+        mgr = c.replicas
+        fleet = list(mgr.replicas)
+        targets = [f"bits{i}" for i in range(n_targets)]
+        for t in targets:
+            c.get_bit_set(t).set_range(0, n_bits, True)
+        assert c.wait_for_replicas(2, timeout_s=60.0) == 2
+
+        def warmup():
+            # Compile bitset_cardinality on EVERY engine before the clock
+            # starts — a replica's first read would otherwise pay its JIT
+            # inside the measured window.
+            for _ in range(4):
+                for t in targets:
+                    router.execute_sync(t, "bitset_cardinality", None,
+                                        max_lag=1 << 30,
+                                        read_your_writes=False)
+            for rep in fleet:
+                for t in targets:
+                    rep.execute_read(t, "bitset_cardinality",
+                                     None).result(30)
+
+        def measure():
+            warmup()
+            stop_w = threading.Event()
+
+            def trickle():
+                # Bust the per-epoch BITCOUNT read caches identically in
+                # both phases (replicas apply these and bump their epochs).
+                i = 0
+                while not stop_w.wait(0.001):
+                    c.get_bit_set(targets[i % n_targets]).set_bits(
+                        [i % n_bits])
+                    i += 1
+
+            counts = [0] * n_threads
+            stop_r = threading.Event()
+
+            def reader(slot):
+                j = slot
+                while not stop_r.is_set():
+                    router.execute_sync(
+                        targets[j % n_targets], "bitset_cardinality", None,
+                        max_lag=1 << 30, read_your_writes=False)
+                    counts[slot] += 1
+                    j += 1
+
+            wt = threading.Thread(target=trickle, daemon=True)
+            wt.start()
+            threads = [threading.Thread(target=reader, args=(s,),
+                                        daemon=True)
+                       for s in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            time.sleep(phase_s)
+            stop_r.set()
+            for t in threads:
+                t.join(30)
+            wall = time.perf_counter() - t0
+            stop_w.set()
+            wt.join(10)
+            return sum(counts) / wall
+
+        router.set_replicas([])  # phase A: the primary serves every read
+        rps0 = measure()
+        router.set_replicas(fleet)  # phase B: the fleet serves them
+        base = router.replica_reads
+        rps2 = measure()
+        routed = router.replica_reads - base
+        scale = rps2 / rps0 if rps0 else 0.0
+        print(f"# replica-smoke[scaling]: {rps0:,.0f} reads/s with 0 "
+              f"replicas -> {rps2:,.0f} with 2 ({scale:.2f}x, "
+              f"{routed} replica-served, {n_targets} x {n_bits >> 20} "
+              f"Mbit bitsets)")
+        if scale < 1.5 or routed == 0:
+            print("#   read-scaling gate failed (need >= 1.5x)",
+                  file=sys.stderr)
+            ok = False
+    finally:
+        _close(c)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -1810,6 +2121,13 @@ def main():
                          "landing on the new owner, and cross-shard "
                          "PFMERGE matching a single-shard oracle, then "
                          "exit")
+    ap.add_argument("--replica-smoke", action="store_true",
+                    help="read-replica fleet acceptance: randomized mixed "
+                         "traffic with every replica-served read inside "
+                         "its staleness bound, kill-primary auto-failover "
+                         "with zero acked-write loss and a fault-free "
+                         "oracle digest match, and >= 1.5x read scaling "
+                         "from 0 -> 2 replicas, then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -1837,6 +2155,9 @@ def main():
 
     if args.cluster_smoke:
         sys.exit(0 if cluster_smoke() else 1)
+
+    if args.replica_smoke:
+        sys.exit(0 if replica_smoke() else 1)
 
     if args.mem_smoke:
         sys.exit(0 if mem_smoke() else 1)

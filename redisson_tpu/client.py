@@ -226,6 +226,32 @@ class RedissonTPU:
             except Exception:
                 self.shutdown()
                 raise
+        # Read-replica fleet (replica/): N serving replicas tailing the
+        # journal (persist IS the replication stream) + bounded-staleness
+        # read routing + automatic failover. Wired after fault so the
+        # DeviceLost trigger can chain onto the fault listener fan-out.
+        self._replicas = None
+        repcfg = self.config.replicas
+        if repcfg is not None:
+            if self._persist is None:
+                self.shutdown()
+                raise ValueError(
+                    "Config.replicas requires Config.persist with a dir — "
+                    "replicas tail that journal as the replication stream")
+            from redisson_tpu.replica import ReplicaManager
+
+            self._replicas = ReplicaManager(self, repcfg)
+            try:
+                self._replicas.start()
+            except Exception:
+                self.shutdown()
+                raise
+            # Model getters bind to _dispatch lazily, so every object
+            # created from here on routes reads through the fleet.
+            self._dispatch = self._replicas.router
+            from redisson_tpu.observability import register_replica
+
+            register_replica(self.metrics, self._replicas)
         if self.config.redis is not None and mode != "redis":
             try:
                 self._connect_durability()
@@ -562,6 +588,19 @@ class RedissonTPU:
     def fault(self):
         """The FaultManager when Config.faults is set, else None."""
         return getattr(self, "_fault", None)
+
+    @property
+    def replicas(self):
+        """The ReplicaManager when Config.replicas is set, else None."""
+        return getattr(self, "_replicas", None)
+
+    def wait_for_replicas(self, n: int, timeout_s: float = 5.0) -> int:
+        """Redis WAIT analogue: block until n replicas have applied at
+        least the primary's current committed journal seq; returns how
+        many have (possibly < n on timeout)."""
+        if self._replicas is None:
+            raise RuntimeError("no replica fleet configured (Config.replicas)")
+        return self._replicas.wait_for_replicas(n, timeout_s=timeout_s)
 
     def snapshot_now(self) -> str:
         """On-demand persistent snapshot (BGSAVE analogue): cuts through
@@ -1025,6 +1064,15 @@ class RedissonTPU:
             except Exception:
                 pass
             self._fault = None
+        if getattr(self, "_replicas", None) is not None:
+            # Replica fleet next: the prober must stop before the executor
+            # it polls drains, and each replica shuts its own client down
+            # (the promoted one tears its attached persistence down too).
+            try:
+                self._replicas.close()
+            except Exception:
+                pass
+            self._replicas = None
         if getattr(self, "_persist", None) is not None:
             # Phase 1: stop the snapshotter before the executor drains (a
             # barrier cut submitted after shutdown would never dispatch);

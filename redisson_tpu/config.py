@@ -294,6 +294,35 @@ class ClusterConfig:
 
 
 @dataclass
+class ReplicaConfig:
+    """Read-replica fleet (redisson_tpu/replica/): N serving replicas, each
+    a full engine stack tailing the primary's journal, fronted by a
+    ReplicaRouter that sends read-only op kinds to a replica whose applied
+    watermark satisfies the read's staleness bound — the engine-owned
+    analogue of `readMode=SLAVE` in `MasterSlaveConnectionManager.java`.
+    Requires `Config.persist` with a dir (replicas tail that journal)."""
+
+    num_replicas: int = 2
+    # Bounded-staleness defaults; per-read `max_lag=`/`max_lag_s=` override.
+    # A replica is eligible when primary_seq - applied_seq <= max_lag_seqs
+    # AND (max_lag_s == 0 or time since it was last caught up <= max_lag_s).
+    max_lag_seqs: int = 1024
+    max_lag_s: float = 0.0
+    # Pin a tenant's reads at/above the highest journal seq acked to it.
+    read_your_writes: bool = True
+    # Follower tail cadence / apply batch (JournalFollower knobs).
+    poll_interval_s: float = 0.01
+    apply_window: int = 1024
+    # Failover: promote the highest-watermark replica when the primary
+    # dies (DeviceLostFault through the fault manager, or health_failures
+    # consecutive failed probes at health_interval_s cadence).
+    auto_failover: bool = True
+    health_interval_s: float = 0.25
+    health_failures: int = 3
+    promote_timeout_s: float = 30.0
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -311,6 +340,8 @@ class Config:
     memory: Optional[MemConfig] = None
     # Slot-sharded cluster tier (None = one engine owns all slots).
     cluster: Optional[ClusterConfig] = None
+    # Read-replica fleet (None = primary serves all reads).
+    replicas: Optional[ReplicaConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -383,6 +414,12 @@ class Config:
             self.cluster.dir = dir
         return self.cluster
 
+    def use_replicas(self, num_replicas: int = 0) -> "ReplicaConfig":
+        self.replicas = self.replicas or ReplicaConfig()
+        if num_replicas:
+            self.replicas.num_replicas = num_replicas
+        return self.replicas
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -418,6 +455,7 @@ class Config:
             "trace": TraceConfig,
             "memory": MemConfig,
             "cluster": ClusterConfig,
+            "replicas": ReplicaConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

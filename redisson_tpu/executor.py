@@ -883,6 +883,14 @@ class CommandExecutor:
         if cancelled and self._metrics:
             self._metrics.record_cancelled(cancelled)
 
+    def is_alive(self) -> bool:
+        """Liveness probe for the replica tier's failover health check:
+        True while the dispatcher thread runs and shutdown hasn't begun.
+        (A dispatcher that died to an unhandled error — or a primary whose
+        process-level kill was simulated by shutdown — reads False and
+        trips the ReplicaManager's consecutive-failure counter.)"""
+        return not self._shutdown and self._thread.is_alive()
+
     def shutdown(self, wait: bool = True, timeout: float = 30.0):
         with self._cv:
             self._shutdown = True

@@ -23,6 +23,10 @@ class FaultManager:
         self.injector: Optional[FaultInjector] = None
         self.watchdog: Optional[RunWatchdog] = None
         self.rebuild: Optional[RebuildCoordinator] = None
+        # Extra fault observers (kind, targets, exc) fanned out after the
+        # rebuild coordinator — the replica tier hooks failover-on-
+        # DeviceLost here without displacing self-healing.
+        self._extra_listeners = []
         self._started = False
 
     def start(self) -> None:
@@ -38,7 +42,7 @@ class FaultManager:
         if cfg.rebuild:
             self.rebuild = RebuildCoordinator(client, breakers=breakers)
             executor.fault_guard = self.rebuild.guard
-            executor.fault_listener = self.rebuild.on_fault
+        executor.fault_listener = self._on_fault
         if cfg.watchdog:
             cost_model = getattr(serve, "cost_model", None) if serve else None
             estimate = cost_model.estimate if cost_model is not None else None
@@ -49,13 +53,33 @@ class FaultManager:
                 floor_s=cfg.watchdog_floor_s,
                 poll_s=cfg.watchdog_poll_s,
                 breakers=breakers,
-                on_trip=self.rebuild.on_fault if self.rebuild else None,
+                on_trip=self._on_fault,
             )
             self.watchdog.start()
         from redisson_tpu.observability import register_fault
 
         register_fault(client.metrics, self)
         self._started = True
+
+    def add_fault_listener(self, fn) -> None:
+        """Register `fn(kind, targets, exc)` to observe retired device
+        faults alongside the rebuild coordinator (the ReplicaManager's
+        DeviceLost failover trigger)."""
+        self._extra_listeners.append(fn)
+
+    def remove_fault_listener(self, fn) -> None:
+        if fn in self._extra_listeners:
+            self._extra_listeners.remove(fn)
+
+    def _on_fault(self, kind, targets, exc) -> None:
+        if self.rebuild is not None:
+            self.rebuild.on_fault(kind, targets, exc)
+        for fn in list(self._extra_listeners):
+            try:
+                fn(kind, targets, exc)
+            except Exception:
+                # graftlint: allow-bare(fault fan-out is best-effort, one observer's crash must not starve the rest or the retire path)
+                pass
 
     def stop(self) -> None:
         if not self._started:

@@ -494,3 +494,20 @@ def register_follower(registry: MetricsRegistry, follower) -> None:
     """Bounded-lag gauge for a warm standby (persist/follower.py)."""
     registry.gauge("persist.follower_lag", follower.lag)
     registry.gauge("persist.follower_applied_seq", lambda: follower.applied_seq)
+
+
+def register_replica(registry: MetricsRegistry, manager) -> None:
+    """Read-replica fleet gauges (replica/manager.py): worst-case lag and
+    lowest watermark across the fleet, PSYNC-parity resync counters
+    (sync_full / sync_partial_ok), promotions, and the router's read
+    routing split."""
+    registry.gauge("replica.count", lambda: len(manager.replicas))
+    registry.gauge("replica.max_lag", manager.max_lag)
+    registry.gauge("replica.min_watermark", manager.min_watermark)
+    registry.gauge("replica.full_resyncs", manager.full_resyncs)
+    registry.gauge("replica.partial_resyncs", manager.partial_resyncs)
+    registry.gauge("replica.promotions", lambda: manager.promotions)
+    registry.gauge("replica.reads",
+                   lambda: manager.router.replica_reads if manager.router else 0)
+    registry.gauge("replica.primary_fallbacks",
+                   lambda: manager.router.primary_fallbacks if manager.router else 0)

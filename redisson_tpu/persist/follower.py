@@ -22,17 +22,22 @@ the issue asks for: leader's last committed seq minus ours.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
+from zlib import crc32
 
 from redisson_tpu import checkpoint
 from redisson_tpu.persist.journal import (
+    _FRAME,
+    _HEADER,
     JournalGap,
     JournalRecord,
     JournalTail,
-    last_seq_in_dir,
+    _body_seq,
+    _list_segments,
 )
 from redisson_tpu.persist.snapshotter import STRUCTURES_FILE, find_snapshots
 
@@ -66,6 +71,91 @@ def slots_record_filter(slots):
     return _filter
 
 
+class _WatermarkScanner:
+    """Incremental leader-watermark reader for file-mode `lag()`.
+
+    `last_seq_in_dir()` re-decodes the whole journal on every call —
+    O(journal) per sample, too slow for the router to poll per-read. The
+    scanner remembers (segment base, path, byte offset, last seq) and each
+    call parses only frames appended since, re-anchoring from scratch when a
+    segment event invalidates the cursor: the cached segment vanished
+    (compaction / torn-segment drop) or the file shrank below the offset
+    (torn-tail repair on leader restart). Frames are CRC-validated before
+    the seq is trusted, exactly as `_scan_segment` does, but payloads are
+    never decoded. A fresh anchor starts at the NEWEST segment with
+    `last = base - 1` — exact, because `rotate()` opens every segment at
+    base == last committed seq + 1."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._seg_base: Optional[int] = None
+        self._seg_path = ""
+        self._offset = 0
+        self._last = 0
+        self.rescans = 0  # cursor invalidations (segment events observed)
+
+    def last_seq(self) -> int:
+        with self._lock:
+            try:
+                return self._scan()
+            except OSError:
+                # segment disappeared mid-read (compaction race): drop the
+                # anchor and serve the stale value; next call re-anchors.
+                self._seg_base = None
+                return self._last
+
+    def _scan(self) -> int:
+        segs = _list_segments(self.path)
+        if not segs:
+            self._seg_base = None
+            self._last = 0
+            return 0
+        if self._seg_base is not None:
+            cur = [p for b, p in segs if b == self._seg_base]
+            if not cur or cur[0] != self._seg_path or \
+                    os.path.getsize(self._seg_path) < self._offset:
+                self._seg_base = None
+        if self._seg_base is None:
+            base, seg_path = segs[-1]
+            self._seg_base, self._seg_path = base, seg_path
+            self._offset = _HEADER.size
+            self._last = base - 1
+            self.rescans += 1
+        while True:
+            self._last = self._read_new_frames()
+            # This segment exhausted; hop to its successor if one exists
+            # (rotation names it base == our last + 1).
+            nxt = [(b, p) for b, p in _list_segments(self.path)
+                   if b == self._last + 1 and p != self._seg_path]
+            if not nxt:
+                return self._last
+            self._seg_base, self._seg_path = nxt[0]
+            self._offset = _HEADER.size
+
+    def _read_new_frames(self) -> int:
+        with open(self._seg_path, "rb") as f:
+            f.seek(self._offset)
+            buf = f.read()
+        pos, n = 0, len(buf)
+        last = self._last
+        while pos + _FRAME.size <= n:
+            body_len, crc = _FRAME.unpack_from(buf, pos)
+            body_end = pos + _FRAME.size + body_len
+            if body_end > n:
+                break  # in-flight tail: length promises bytes not yet landed
+            body = buf[pos + _FRAME.size:body_end]
+            if body_len < 8 or crc32(body) != crc:
+                break  # torn frame: retried next call, never counted
+            seq = _body_seq(body)
+            if seq != last + 1:
+                break  # discontinuity: hold position, re-validate next call
+            last = seq
+            self._offset += _FRAME.size + body_len
+            pos = body_end
+        return last
+
+
 class JournalFollower:
     def __init__(self, path: str, config=None, poll_interval_s: float = 0.05,
                  apply_window: int = 1024, record_filter=None):
@@ -95,9 +185,40 @@ class JournalFollower:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._bootstraps = 0
-        self._bootstrap()
+        # PSYNC parity gauges: every (re)sync is one or the other. The
+        # initial snapshot bootstrap counts as full, mirroring redis
+        # sync_full counting every first-time slave.
+        self._full_resyncs = 0
+        self._partial_resyncs = 0
+        self._scanner = _WatermarkScanner(path)
+        # monotonic stamp of the last moment we KNEW we were at the
+        # journal's visible tip (applied records, or polled it empty).
+        self._fresh_at = time.monotonic()
+        self._resync()
 
     # -- bootstrap / tail ----------------------------------------------------
+
+    def _resync(self) -> None:
+        """(Re)attach to the journal after a start, gap, or retarget.
+
+        PSYNC split: when we already hold applied state AND the journal
+        still has a segment covering our cursor (base <= applied + 1), keep
+        the state and just re-open the tail at the cursor — a partial
+        resync, no snapshot traffic. Only when the suffix was compacted
+        away (or we have nothing yet) pay for the full snapshot bootstrap."""
+        applied = self.applied_seq
+        if self._bootstraps and applied and self._suffix_available(applied):
+            self._tail = JournalTail(self.path, from_seq=applied)
+            self._partial_resyncs += 1
+            return
+        self._bootstrap()
+
+    def _suffix_available(self, applied: int) -> bool:
+        try:
+            segs = _list_segments(self.path)
+        except OSError:
+            return False
+        return any(base <= applied + 1 for base, _ in segs)
 
     def _bootstrap(self) -> None:
         """(Re)load the newest leader snapshot; reset the apply cursor to
@@ -120,6 +241,7 @@ class JournalFollower:
             self._applied = watermark
         self._tail = JournalTail(self.path, from_seq=watermark)
         self._bootstraps += 1
+        self._full_resyncs += 1
 
     def attach(self, journal) -> None:
         """Switch to in-process queue tailing of a live Journal (leader in
@@ -181,17 +303,24 @@ class JournalFollower:
         with self._applied_lock:
             self._applied = last_seq
             self._records_applied += len(records)
+        self._fresh_at = time.monotonic()
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             try:
                 records = self._next_records()
             except JournalGap:
-                self._bootstrap()
+                self._resync()
+                # Pace the retry: a gap that can't heal yet (e.g. a fresh
+                # post-failover journal whose first snapshot hasn't landed)
+                # must not spin the loop hot.
+                self._stop.wait(self._poll_s)
                 continue
             if records:
                 self._apply(records)
             else:
+                # Empty poll == we are at the journal's visible tip.
+                self._fresh_at = time.monotonic()
                 self._stop.wait(self._poll_s)
 
     # -- introspection -------------------------------------------------------
@@ -203,13 +332,20 @@ class JournalFollower:
 
     def lag(self) -> int:
         """Records the leader has committed that we haven't applied (the
-        bounded-lag gauge). File mode re-scans the leader's journal; queue
-        mode reads the live journal's counter."""
+        bounded-lag gauge). File mode reads the incremental watermark
+        scanner (O(new bytes), poll-per-read cheap); queue mode reads the
+        live journal's counter."""
         if self._source_journal is not None:
             leader = self._source_journal.last_seq
         else:
-            leader = last_seq_in_dir(self.path)
+            leader = self._scanner.last_seq()
         return max(0, leader - self.applied_seq)
+
+    def staleness_s(self) -> float:
+        """Seconds since this follower last touched the journal's visible
+        tip (applied records or polled it empty) — the time axis of the
+        bounded-staleness contract (`ReplicaConfig.max_lag_s`)."""
+        return max(0.0, time.monotonic() - self._fresh_at)
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -217,7 +353,10 @@ class JournalFollower:
             "records_applied": self._records_applied,
             "apply_errors": self._apply_errors,
             "lag": self.lag(),
+            "staleness_s": self.staleness_s(),
             "bootstraps": self._bootstraps,
+            "full_resyncs": self._full_resyncs,
+            "partial_resyncs": self._partial_resyncs,
             "mode": "queue" if self._queue is not None else "file",
         }
 
@@ -241,7 +380,7 @@ class JournalFollower:
                 try:
                     records = self._next_records()
                 except JournalGap:
-                    self._bootstrap()
+                    self._resync()
                     continue
                 if records:
                     self._apply(records)
@@ -249,6 +388,30 @@ class JournalFollower:
                 else:
                     idle_polls += 1
         return self.client
+
+    def retarget(self, path: str) -> None:
+        """Repoint a live follower at a new leader's journal (the surviving
+        fleet after a failover): stop the tail loop, swap the source dir,
+        resync, resume. Stays a PARTIAL resync when the new journal's
+        numbering covers our cursor — the promoted primary opens its fresh
+        journal at the old global seq precisely so this path avoids a
+        snapshot; a replica that was behind the promoted watermark full-
+        bootstraps from the new primary's first snapshot instead."""
+        was_running = self._thread is not None
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        if self._source_journal is not None:
+            self._source_journal.remove_listener(self._on_records)
+            self._source_journal = None
+            self._queue = None
+        self.path = path
+        self._scanner = _WatermarkScanner(path)
+        self._stop = threading.Event()
+        self._resync()
+        if was_running:
+            self.start()
 
     def close(self, shutdown_client: bool = True) -> None:
         self._stop.set()

@@ -213,10 +213,15 @@ class Journal:
 
     def __init__(self, path: str, fsync: str = "everysec",
                  fsync_interval_s: float = 1.0, group_commit_runs: int = 2,
-                 segment_max_bytes: int = 64 << 20):
+                 segment_max_bytes: int = 64 << 20, start_seq: int = 0):
         if fsync not in ("always", "everysec", "off"):
             raise ValueError(f"unknown fsync policy {fsync!r}")
         self.path = os.path.abspath(path)
+        # First seq in an EMPTY dir is start_seq + 1: a promoted replica's
+        # fresh journal continues the old primary's global numbering, so
+        # surviving replicas can partial-resync against it (PSYNC replid
+        # continuity). Ignored when the dir already has segments.
+        self._start_seq = max(0, int(start_seq))
         self._fsync = fsync
         self._interval_s = max(0.01, float(fsync_interval_s))
         self._group = max(1, int(group_commit_runs))
@@ -251,8 +256,8 @@ class Journal:
     def _open_segments(self) -> int:
         self._segments = _list_segments(self.path)
         if not self._segments:
-            self._create_segment(1)
-            return 0
+            self._create_segment(self._start_seq + 1)
+            return self._start_seq
         # Validate the committed prefix; truncate at the first tear and
         # drop every segment past it (unreachable history).
         prev: Optional[int] = None
@@ -287,8 +292,8 @@ class Journal:
             _fsync_dir(self.path)
         if not self._segments:
             # every segment was torn at the header: start over
-            self._create_segment(1)
-            return 0
+            self._create_segment(self._start_seq + 1)
+            return self._start_seq
         last_seq = prev if prev is not None else 0
         self._f = open(self._segments[-1][1], "ab")
         return last_seq

@@ -1,0 +1,254 @@
+"""ReplicaManager — fleet lifecycle, health probing, automatic failover.
+
+Owns N ServingReplicas tailing the primary's journal dir, the
+ReplicaRouter in front of the client's dispatch, and the failover state
+machine:
+
+  * health probe: every `health_interval_s` the primary's dispatcher is
+    checked (`executor.is_alive()`); `health_failures` consecutive
+    failures trip a failover (the Redisson `failedSlaveCheckInterval`
+    story pointed at the master).
+  * fault trigger: a retired `DeviceLostFault` observed through the
+    FaultManager's listener fan-out trips the same path without waiting
+    for a probe window.
+  * failover(): fence reads off the fleet, promote the highest-watermark
+    replica (drain the journal suffix), enable journaling + persistence
+    on the promoted client — its fresh journal CONTINUES the global seq
+    numbering (`Journal(start_seq=watermark)`) and immediately snapshots,
+    so surviving replicas `retarget()` with a PSYNC partial resync when
+    they were caught up, or a clean full bootstrap from the new snapshot
+    when they were behind — then repoint the router. `rejoin()`
+    re-bootstraps the demoted old primary's slot as a fresh replica.
+
+`wait_for_replicas(n, timeout_s)` is the WAIT analogue: block until n
+replicas have applied at least the primary's current committed seq.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from redisson_tpu.fault.taxonomy import DeviceLostFault
+from redisson_tpu.replica.replica import ServingReplica
+from redisson_tpu.replica.router import ReplicaRouter
+
+
+class ReplicaManager:
+    def __init__(self, client, cfg):
+        self._client = client
+        self.cfg = cfg
+        self.replicas: List[ServingReplica] = []
+        self.router: Optional[ReplicaRouter] = None
+        self.promotions = 0
+        self.last_failover_reason = ""
+        self.last_failover_s = 0.0
+        self._epoch = 0
+        self._next_index = 0
+        self._stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._probe_failures = 0
+        self._failover_lock = threading.Lock()
+        self._failed_over = False
+        self._fault_mgr = None
+        self._primary_executor = None
+        # The promoted follower (its client is the post-failover primary);
+        # close() shuts it down, including the persistence we attached.
+        self._promoted: Optional[ServingReplica] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        client = self._client
+        persist = client._persist
+        if persist is None or persist.journal is None:
+            raise ValueError(
+                "Config.replicas requires Config.persist with a dir — "
+                "replicas tail that journal as the replication stream")
+        path = persist.cfg.dir
+        for _ in range(max(0, self.cfg.num_replicas)):
+            self._spawn_replica(path)
+        self.router = ReplicaRouter(client._dispatch, persist.journal,
+                                    self.cfg)
+        self.router.set_replicas(self.replicas)
+        serve = getattr(client, "serve", None)
+        if serve is not None:
+            serve.enable_ack_tracking(self.router)
+        self._primary_executor = client._executor
+        fault = getattr(client, "_fault", None)
+        if fault is not None:
+            fault.add_fault_listener(self._on_primary_fault)
+            self._fault_mgr = fault
+        elif client._executor.fault_listener is None:
+            # No fault subsystem: observe retired device faults directly.
+            client._executor.fault_listener = self._on_primary_fault
+        if self.cfg.health_interval_s > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop, name="redisson-tpu-replica-probe",
+                daemon=True)
+            self._prober.start()
+
+    def _spawn_replica(self, path: str) -> ServingReplica:
+        rep = ServingReplica(self._next_index, path, self.cfg)
+        self._next_index += 1
+        rep.start()
+        self.replicas.append(rep)
+        return rep
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout=10.0)
+            self._prober = None
+        if self._fault_mgr is not None:
+            self._fault_mgr.remove_fault_listener(self._on_primary_fault)
+            self._fault_mgr = None
+        for rep in self.replicas:
+            rep.close()
+        self.replicas = []
+        if self._promoted is not None:
+            # Shuts the promoted client down through the normal client
+            # teardown, which drains + closes the persistence we attached.
+            self._promoted.close(shutdown_client=True)
+            self._promoted = None
+
+    # -- health probe / fault trigger ----------------------------------------
+
+    def _probe_primary(self) -> bool:
+        executor = self._primary_executor
+        try:
+            return executor is not None and executor.is_alive()
+        except Exception:
+            # graftlint: allow-bare(a probe that cannot even ask counts as a failed probe, not a prober crash)
+            return False
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.cfg.health_interval_s):
+            if self._failed_over:
+                return
+            if self._probe_primary():
+                self._probe_failures = 0
+                continue
+            self._probe_failures += 1
+            if (self._probe_failures >= max(1, self.cfg.health_failures)
+                    and self.cfg.auto_failover):
+                self.failover(
+                    f"health probe failed {self._probe_failures}x")
+                return
+
+    def _on_primary_fault(self, kind, targets, exc) -> None:
+        if not self.cfg.auto_failover or self._failed_over:
+            return
+        if isinstance(exc, DeviceLostFault):
+            # Off the retire path: failover drains a journal suffix and
+            # snapshots — never block the completer thread on that.
+            threading.Thread(
+                target=self.failover,
+                args=(f"DeviceLostFault on {kind}",),
+                name="redisson-tpu-replica-failover", daemon=True).start()
+
+    # -- failover ------------------------------------------------------------
+
+    def failover(self, reason: str = "manual"):
+        """Promote the highest-watermark replica to primary. Returns the
+        promoted client, or None when a failover already happened (the
+        trigger paths race; first one wins)."""
+        with self._failover_lock:
+            if self._failed_over:
+                return None
+            self._failed_over = True
+        t0 = time.monotonic()
+        best = max(self.replicas, key=lambda r: r.applied_seq)
+        survivors = [r for r in self.replicas if r is not best]
+        # Fence: reads stop landing on the promotee while it drains.
+        self.router.set_replicas(survivors)
+        promoted = best.promote(catch_up=True,
+                                timeout_s=self.cfg.promote_timeout_s)
+        watermark = best.applied_seq
+        # Enable journaling + persistence on the new primary. The fresh
+        # journal opens at seq watermark+1 (global numbering continues) and
+        # the immediate snapshot is the full-resync source for any replica
+        # that was behind the promotee.
+        from redisson_tpu.persist import PersistenceManager
+
+        old_cfg = self._client._persist.cfg
+        self._epoch += 1
+        new_dir = f"{old_cfg.dir.rstrip(os.sep)}-epoch-{self._epoch}"
+        pm = PersistenceManager(
+            promoted,
+            dataclasses.replace(old_cfg, dir=new_dir, auto_recover=False),
+            start_seq=watermark)
+        pm.start()
+        promoted._persist = pm  # promoted client's shutdown tears it down
+        pm.snapshot()
+        self.router.set_primary(promoted._dispatch, pm.journal)
+        self._primary_executor = promoted._executor
+        for rep in survivors:
+            rep.retarget(new_dir)
+        self.router.set_replicas(survivors)
+        self._promoted = best
+        self.replicas = survivors
+        self.promotions += 1
+        self.last_failover_reason = reason
+        self.last_failover_s = time.monotonic() - t0
+        return promoted
+
+    def rejoin(self) -> ServingReplica:
+        """Re-bootstrap the demoted old primary's slot in the fleet: a
+        fresh replica full-bootstraps from the current primary's snapshot
+        and tails its journal. (In-process the old engine's state is gone
+        with its executor; what 'returns' is its capacity.)"""
+        if self.router is None:
+            raise RuntimeError("replica manager not started")
+        journal = self.router.journal
+        rep = self._spawn_replica(journal.path)
+        self.router.set_replicas(self.replicas)
+        return rep
+
+    # -- WAIT analogue -------------------------------------------------------
+
+    def wait_for_replicas(self, n: int, timeout_s: float = 5.0) -> int:
+        """Block until `n` replicas have applied at least the primary's
+        current committed seq; returns how many have (possibly < n on
+        timeout) — redis WAIT numreplicas/timeout semantics on the
+        journal watermark."""
+        journal = self.router.journal if self.router is not None else None
+        watermark = journal.last_seq if journal is not None else 0
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while True:
+            count = sum(1 for r in self.replicas
+                        if r.applied_seq >= watermark)
+            if count >= n or time.monotonic() >= deadline:
+                return count
+            time.sleep(0.002)
+
+    # -- introspection -------------------------------------------------------
+
+    def max_lag(self) -> int:
+        return max((r.lag() for r in self.replicas), default=0)
+
+    def min_watermark(self) -> int:
+        return min((r.applied_seq for r in self.replicas), default=0)
+
+    def full_resyncs(self) -> int:
+        reps = self.replicas + ([self._promoted] if self._promoted else [])
+        return sum(r._full_resyncs for r in reps)
+
+    def partial_resyncs(self) -> int:
+        reps = self.replicas + ([self._promoted] if self._promoted else [])
+        return sum(r._partial_resyncs for r in reps)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "replicas": [r.stats() for r in self.replicas],
+            "promotions": self.promotions,
+            "failed_over": self._failed_over,
+            "last_failover_reason": self.last_failover_reason,
+            "last_failover_s": self.last_failover_s,
+            "full_resyncs": self.full_resyncs(),
+            "partial_resyncs": self.partial_resyncs(),
+            "router": self.router.snapshot() if self.router else {},
+        }

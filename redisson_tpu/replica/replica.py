@@ -1,0 +1,37 @@
+"""ServingReplica — one member of the read fleet.
+
+A `JournalFollower` (full engine stack: own executor, own backends, own
+epoch-stamped read cache) that also serves read traffic through its own
+dispatch waist. Reads and journal replay share that waist, so a replica
+read observes exactly the per-target FIFO prefix its applied watermark
+promises — the property the router's bounded-staleness contract and the
+smoke suite's watermark-replay oracle both stand on.
+
+The follower base contributes tailing, partial/full resync accounting,
+the cached `lag()` watermark scanner, `promote()` (failover) and
+`retarget()` (follow a promoted primary).
+"""
+
+from __future__ import annotations
+
+from redisson_tpu.persist.follower import JournalFollower
+
+
+class ServingReplica(JournalFollower):
+    def __init__(self, index: int, path: str, cfg, config=None):
+        super().__init__(path, config=config,
+                         poll_interval_s=cfg.poll_interval_s,
+                         apply_window=cfg.apply_window)
+        self.index = index
+        self.name = f"replica-{index}"
+        self.reads_served = 0
+
+    def execute_read(self, target: str, kind: str, payload, nkeys: int = 0):
+        self.reads_served += 1
+        return self.client._dispatch.execute_async(target, kind, payload, nkeys)
+
+    def stats(self):
+        out = super().stats()
+        out["name"] = self.name
+        out["reads_served"] = self.reads_served
+        return out

@@ -1,0 +1,237 @@
+"""ReplicaRouter — bounded-staleness read routing in front of the primary.
+
+Drop-in dispatch facade (same `execute_async` / `execute_sync` /
+`execute_many` / `batch()` surface as ServingLayer / CommandExecutor —
+model getters bind to it transparently) that forwards writes to the
+primary and sends read-only op kinds to a replica that can satisfy the
+read's staleness bound:
+
+    eligible(replica) :=
+        primary_seq - replica.applied_seq <= max_lag            (seq axis)
+        and (max_lag_s == 0 or replica.staleness_s() <= max_lag_s)
+        and (not read_your_writes
+             or replica.applied_seq >= acked_seq(tenant))       (RYW pin)
+
+The read set is derived from the op registry (`OP_TABLE` entries with
+write=False) — no hand-maintained list to drift — minus the parked
+blocking kinds (a bpop served from a replica would wait on a frozen
+snapshot forever) and their control ops. Reads with no eligible replica
+fall back to the primary (`primary_fallbacks` counts them), which is also
+where every batch/pipeline goes unsplit: a batch is one admission
+decision with one deadline, and splitting it across engines would break
+that contract.
+
+Read-your-writes: the serve layer reports each acked write's journal
+floor via `record_ack(tenant, seq)` (enable_ack_tracking); without a
+serve layer the router observes write futures itself. The per-tenant pin
+is the journal's last committed seq at ack time — conservative (>= the
+op's own seq, because the write-ahead append precedes the ack), so a
+pinned read can only be *fresher* than required, never staler.
+
+Failover: `set_primary(dispatch, journal)` repoints writes and the
+watermark source in one swap; the acked-seq map survives because the
+promoted primary continues the global seq numbering.
+
+Reference: `readMode=SLAVE` read dispatch in
+`MasterSlaveConnectionManager.java` / `MasterSlaveEntry.java` — there the
+slave is picked by a load balancer with no staleness bound; the bound (and
+the RYW pin) is the redesign this engine's seq watermarks make possible.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from redisson_tpu.commands import OP_TABLE
+from redisson_tpu.executor import PARKED_KINDS
+
+# bpop parks on the primary's structures; bpop_cancel must reach the same
+# engine that parked it.
+_PINNED_TO_PRIMARY = frozenset(PARKED_KINDS) | {"bpop_cancel"}
+
+READ_KINDS = frozenset(
+    k for k, d in OP_TABLE.items() if not d.write) - _PINNED_TO_PRIMARY
+
+
+class ReplicaRouter:
+    def __init__(self, primary_dispatch, journal, cfg):
+        self._primary = primary_dispatch
+        self._journal = journal
+        self._cfg = cfg
+        self._replicas: List = []
+        self._rr = 0  # round-robin cursor over eligible replicas
+        self._lock = threading.Lock()
+        self._acked: Dict[str, int] = {}
+        self.replica_reads = 0
+        self.primary_fallbacks = 0
+        self.primary_reads = 0
+        # Serve-layer primaries push acks via enable_ack_tracking; a raw
+        # executor primary gets per-future callbacks from the router.
+        self._inline_acks = not hasattr(primary_dispatch, "enable_ack_tracking")
+
+    # -- fleet / primary management ------------------------------------------
+
+    @property
+    def journal(self):
+        return self._journal
+
+    @property
+    def primary(self):
+        return self._primary
+
+    def set_replicas(self, replicas: List) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+
+    def set_primary(self, dispatch, journal) -> None:
+        """Failover repoint: writes and the watermark source swap together.
+        The acked map is kept — the promoted journal continues the global
+        seq numbering, so existing pins stay meaningful."""
+        with self._lock:
+            self._primary = dispatch
+            self._journal = journal
+            self._inline_acks = not hasattr(dispatch, "enable_ack_tracking")
+
+    # -- read-your-writes ----------------------------------------------------
+
+    def record_ack(self, tenant: str, seq: int) -> None:
+        with self._lock:
+            if seq > self._acked.get(tenant, 0):
+                self._acked[tenant] = seq
+
+    def acked_seq(self, tenant: str) -> int:
+        with self._lock:
+            return self._acked.get(tenant, 0)
+
+    # -- routing -------------------------------------------------------------
+
+    def _resolve_tenant(self, tenant: Optional[str]) -> str:
+        if tenant is not None:
+            return tenant
+        resolve = getattr(self._primary, "_resolve_tenant", None)
+        return resolve(None) if resolve is not None else ""
+
+    def _pick(self, tenant: str, max_lag: Optional[int],
+              max_lag_s: Optional[float], read_your_writes: Optional[bool]):
+        with self._lock:
+            replicas = self._replicas
+            if not replicas:
+                return None
+            journal = self._journal
+            rr = self._rr
+            self._rr = rr + 1
+            acked = self._acked.get(tenant, 0)
+        if max_lag is None:
+            max_lag = self._cfg.max_lag_seqs
+        if max_lag_s is None:
+            max_lag_s = self._cfg.max_lag_s
+        if read_your_writes is None:
+            read_your_writes = self._cfg.read_your_writes
+        primary_seq = journal.last_seq if journal is not None else 0
+        floor = max(primary_seq - max(0, int(max_lag)),
+                    acked if read_your_writes else 0)
+        n = len(replicas)
+        for i in range(n):
+            rep = replicas[(rr + i) % n]
+            if rep.applied_seq < floor:
+                continue
+            if max_lag_s > 0 and rep.staleness_s() > max_lag_s:
+                continue
+            return rep
+        return None
+
+    def execute_async(self, target: str, kind: str, payload: Any,
+                      nkeys: int = 0, tenant: Optional[str] = None,
+                      max_lag: Optional[int] = None,
+                      max_lag_s: Optional[float] = None,
+                      read_your_writes: Optional[bool] = None, **kw):
+        if kind in READ_KINDS:
+            fut, _, _ = self.routed_read(
+                target, kind, payload, nkeys, tenant=tenant, max_lag=max_lag,
+                max_lag_s=max_lag_s, read_your_writes=read_your_writes, **kw)
+            return fut
+        fut = self._primary.execute_async(
+            target, kind, payload, nkeys,
+            tenant=self._resolve_tenant(tenant), **kw)
+        if self._inline_acks:
+            self._track_write_ack(fut, kind, self._resolve_tenant(tenant))
+        return fut
+
+    def routed_read(self, target: str, kind: str, payload: Any,
+                    nkeys: int = 0, tenant: Optional[str] = None,
+                    max_lag: Optional[int] = None,
+                    max_lag_s: Optional[float] = None,
+                    read_your_writes: Optional[bool] = None, **kw):
+        """Read with routing introspection: returns (future, replica-or-None,
+        watermark) where `watermark` is the chosen replica's applied seq at
+        pick time — the smoke suite replays the primary at that watermark to
+        verify every answer sits inside its staleness bound."""
+        tenant = self._resolve_tenant(tenant)
+        rep = self._pick(tenant, max_lag, max_lag_s, read_your_writes)
+        if rep is not None:
+            watermark = rep.applied_seq
+            self.replica_reads += 1
+            return rep.execute_read(target, kind, payload, nkeys), rep, watermark
+        if self._replicas:
+            self.primary_fallbacks += 1
+        else:
+            self.primary_reads += 1
+        fut = self._primary.execute_async(target, kind, payload, nkeys,
+                                          tenant=tenant, **kw)
+        journal = self._journal
+        return fut, None, (journal.last_seq if journal is not None else 0)
+
+    def _track_write_ack(self, fut, kind: str, tenant: str) -> None:
+        desc = OP_TABLE.get(kind)
+        if desc is None or not desc.write:
+            return
+        journal = self._journal
+
+        def _ack(f) -> None:
+            if journal is not None and not f.cancelled() \
+                    and f.exception() is None:
+                self.record_ack(tenant, journal.last_seq)
+
+        fut.add_done_callback(_ack)
+
+    # -- dispatch facade (models bind to this) -------------------------------
+
+    def execute_sync(self, target: str, kind: str, payload: Any,
+                     nkeys: int = 0, **kw):
+        # graftlint: allow-g006(sync facade mirroring ServingLayer.execute_sync; the wait inherits whatever bound the underlying dispatch enforces)
+        return self.execute_async(target, kind, payload, nkeys, **kw).result()
+
+    def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]], **kw):
+        """Batches stay on the primary unsplit: one admission decision, one
+        deadline, journal-ordered — the acked-write tracking still fires
+        through the serve layer's per-future callbacks."""
+        return self._primary.execute_many(staged, **kw)
+
+    def batch(self, **submit_kwargs):
+        return self._primary.batch(**submit_kwargs)
+
+    def __getattr__(self, name: str):
+        # Everything else (backend, queue_depth, tenant context, executor,
+        # barrier helpers, ...) is the primary's business.
+        primary = self.__dict__.get("_primary")
+        if primary is None:  # early-init / copy protocols: no delegation yet
+            raise AttributeError(name)
+        return getattr(primary, name)
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            replicas = list(self._replicas)
+            tenants_pinned = len(self._acked)
+        journal = self._journal
+        return {
+            "replicas": len(replicas),
+            "primary_seq": journal.last_seq if journal is not None else 0,
+            "replica_reads": self.replica_reads,
+            "primary_fallbacks": self.primary_fallbacks,
+            "primary_reads": self.primary_reads,
+            "tenants_pinned": tenants_pinned,
+            "watermarks": {r.name: r.applied_seq for r in replicas},
+        }

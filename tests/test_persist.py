@@ -48,6 +48,42 @@ def make_client(tmp_path=None, fsync="always", **persist_kw):
     return RedissonTPU.create(cfg)
 
 
+def _canon(obj, h):
+    """Feed a canonical, identity-free rendering of `obj` into hash `h`.
+    Raw pickle bytes are NOT a sound digest basis: pickle memoizes by
+    object identity, so two EQUAL graphs with different internal sharing
+    (leader vs snapshot-restored replica) serialize differently."""
+    if obj is None or isinstance(obj, (bool, int, float, str, bytes)):
+        h.update(repr(obj).encode())
+    elif isinstance(obj, (bytearray, memoryview)):
+        h.update(b"B" + bytes(obj))
+    elif isinstance(obj, dict):
+        h.update(b"{")
+        for k, v in obj.items():  # insertion order is semantic (hash fields)
+            _canon(k, h)
+            h.update(b":")
+            _canon(v, h)
+        h.update(b"}")
+    elif isinstance(obj, (list, tuple)):
+        h.update(b"[")
+        for v in obj:
+            _canon(v, h)
+            h.update(b",")
+        h.update(b"]")
+    elif isinstance(obj, (set, frozenset)):
+        h.update(b"<")
+        for r in sorted(repr(v) for v in obj):
+            h.update(r.encode() + b",")
+        h.update(b">")
+    elif isinstance(obj, np.ndarray):
+        h.update(str(obj.dtype).encode() + str(obj.shape).encode())
+        h.update(obj.tobytes())
+    else:
+        h.update(type(obj).__name__.encode())
+        state = getattr(obj, "__dict__", None)
+        _canon(state if state is not None else repr(obj), h)
+
+
 def engine_digest(client) -> str:
     """Bit-identical fingerprint of engine state: every sketch-store array
     (host copy) plus the structure tier's dump. Version counters are
@@ -66,9 +102,7 @@ def engine_digest(client) -> str:
         h.update(repr(sorted(obj.meta.items())).encode())
     structures = getattr(client._routing, "structures", None)
     if structures is not None:
-        blob = structures.dump_state()
-        h.update(pickle.loads(blob)["format"].to_bytes(2, "little"))
-        h.update(blob)
+        _canon(pickle.loads(structures.dump_state()), h)
     return h.hexdigest()
 
 
@@ -473,6 +507,164 @@ def test_follower_rejects_persisting_config(tmp_path):
     cfg.use_persist(str(tmp_path / "f"))
     with pytest.raises(ValueError):
         JournalFollower(str(tmp_path / "lead"), config=cfg)
+
+
+def test_promote_under_mid_window_crash_equals_committed_prefix(tmp_path):
+    """Satellite of the truncate-anywhere property, pointed at PROMOTION:
+    the primary dies between journal append and backend apply (simulated
+    by truncating its journal at an arbitrary byte — write-ahead order
+    makes truncation exactly that interleaving), a follower bootstraps
+    from the crash image and promotes; the promoted engine must equal the
+    serial execution of the surviving committed prefix, bit-identical."""
+    ops = _write_ops(n_mix=3)
+    lead_dir = tmp_path / "leader"
+    c = make_client(lead_dir, fsync="always")
+    try:
+        for op in ops:
+            op(c)
+        c.persist.journal.sync()
+    finally:
+        c.shutdown()
+
+    golden = RedissonTPU.create(Config())
+    digests = {0: engine_digest(golden)}
+    try:
+        for k, op in enumerate(ops, start=1):
+            op(golden)
+            digests[k] = engine_digest(golden)
+    finally:
+        golden.shutdown()
+
+    _, seg = _list_segments(str(lead_dir))[0]
+    size = os.path.getsize(seg)
+    rng = random.Random(0xFA110)
+    for t in sorted(rng.sample(range(1, size - 1), 4)) + [size]:
+        crash_dir = tmp_path / f"crash-{t}"
+        shutil.copytree(lead_dir, crash_dir)
+        _, cseg = _list_segments(str(crash_dir))[0]
+        with open(cseg, "r+b") as f:
+            f.truncate(t)
+        k = len(list(iter_records(str(crash_dir))))
+        follower = JournalFollower(str(crash_dir), poll_interval_s=0.01)
+        try:
+            promoted = follower.promote(catch_up=True, timeout_s=30)
+            assert follower.applied_seq == k
+            assert follower.stats()["apply_errors"] == 0
+            assert engine_digest(promoted) == digests[k], (
+                f"truncate@{t}: promoted state != serial prefix of {k} ops")
+        finally:
+            follower.close()
+        shutil.rmtree(crash_dir)
+
+
+def test_follower_resync_under_rotation_and_compaction(tmp_path):
+    """A replica tailing while the leader rotates AND compacts
+    (`snapshot_now` truncates covered segments) must either partial-resync
+    or cleanly full-resync — never apply a torn suffix — and converge to
+    the leader's exact state."""
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always", segment_max_bytes=1 << 16)
+    follower = None
+    try:
+        for i in range(20):
+            c.get_map("m").put(f"f{i}", i)
+        follower = JournalFollower(str(lead), poll_interval_s=0.005)
+        follower.start()
+        # Interleave traffic with rotation + snapshot-compaction; the big
+        # payloads force several segment rollovers under the follower.
+        for round_ in range(4):
+            for i in range(8):
+                c.get_bucket(f"r{round_}-{i}").set("x" * 4000)
+            c.persist.journal.rotate()
+            c.snapshot_now()  # compacts: remove_segments_below(watermark)
+        for i in range(5):
+            c.get_map("m").put(f"tail{i}", i)
+        c.persist.journal.sync()
+        leader_digest = engine_digest(c)
+        leader_seq = c.persist.journal.last_seq
+        promoted = follower.promote(catch_up=True, timeout_s=30)
+        st = follower.stats()
+        assert st["applied_seq"] == leader_seq
+        assert st["apply_errors"] == 0  # a torn suffix would error here
+        assert st["full_resyncs"] >= 1  # initial bootstrap counts as full
+        assert engine_digest(promoted) == leader_digest
+    finally:
+        if follower is not None:
+            follower.close()
+        c.shutdown()
+
+
+def test_follower_partial_vs_full_resync_counters(tmp_path):
+    """PSYNC parity: a resync with the suffix still on disk is partial
+    (state kept, tail re-opened at the cursor); one whose suffix was
+    compacted away is full (snapshot re-bootstrap). Initial bootstrap
+    counts as full, mirroring redis sync_full."""
+    lead = tmp_path / "lead"
+    c = make_client(lead, fsync="always")
+    follower = None
+    try:
+        for i in range(10):
+            c.get_map("m").put(f"f{i}", i)
+        c.persist.journal.sync()
+        follower = JournalFollower(str(lead), poll_interval_s=0.005)
+        follower.start()
+        deadline = 30
+        import time as _t
+        t0 = _t.monotonic()
+        while follower.lag() > 0 and _t.monotonic() - t0 < deadline:
+            _t.sleep(0.01)
+        assert follower._full_resyncs == 1 and follower._partial_resyncs == 0
+        # Retarget to the SAME dir: suffix available at the cursor -> partial.
+        follower.retarget(str(lead))
+        assert follower._partial_resyncs == 1 and follower._full_resyncs == 1
+        # Compact history past the cursor while appending more, then force
+        # a resync: the suffix is gone -> full snapshot bootstrap.
+        follower.close(shutdown_client=False)
+        for i in range(10, 16):
+            c.get_map("m").put(f"f{i}", i)
+        c.persist.journal.rotate()  # seal seqs 1..16 so compaction can drop them
+        c.snapshot_now()  # remove_segments_below: history past the cursor gone
+        c.get_map("m").put("post", 99)
+        c.persist.journal.sync()
+        follower.retarget(str(lead))
+        assert follower._full_resyncs == 2 and follower._partial_resyncs == 1
+        promoted = follower.promote(catch_up=True, timeout_s=30)
+        assert promoted.get_map("m").get("post") == 99
+        assert engine_digest(promoted) == engine_digest(c)
+    finally:
+        if follower is not None:
+            follower.close()
+        c.shutdown()
+
+
+def test_watermark_scanner_incremental_lag(tmp_path):
+    """Satellite: file-mode lag() must not rescan the whole journal per
+    call. The incremental scanner tracks appends, rotation, and
+    compaction, agreeing with last_seq_in_dir at every step while only
+    re-anchoring on actual segment events."""
+    from redisson_tpu.persist.follower import _WatermarkScanner
+
+    j = Journal(str(tmp_path), fsync="always")
+    scanner = _WatermarkScanner(str(tmp_path))
+    assert scanner.last_seq() == 0
+    for i in range(5):
+        j.append_run("set", [_Op(f"k{i}", "set", {"value": b"x"})])
+        assert scanner.last_seq() == i + 1 == last_seq_in_dir(str(tmp_path))
+    anchors = scanner.rescans
+    j.rotate()
+    j.append_run("set", [_Op("k5", "set", {"value": b"y"})])
+    assert scanner.last_seq() == 6 == last_seq_in_dir(str(tmp_path))
+    # Rotation follows the base==last+1 chain without a re-anchor.
+    assert scanner.rescans == anchors
+    j.remove_segments_below(5)  # drops the first segment (our history)
+    j.append_run("set", [_Op("k6", "set", {"value": b"z"})])
+    assert scanner.last_seq() == 7 == last_seq_in_dir(str(tmp_path))
+    # Steady state: repeated calls with no appends never re-anchor.
+    anchors = scanner.rescans
+    for _ in range(10):
+        assert scanner.last_seq() == 7
+    assert scanner.rescans == anchors
+    j.close()
 
 
 # ---------------------------------------------------------------------------

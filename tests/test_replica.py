@@ -1,0 +1,230 @@
+"""replica/ — read-replica fleet: bounded-staleness routing, RYW pins,
+PSYNC partial/full resync accounting, and automatic failover.
+
+Layers:
+
+1. READ_KINDS derivation — the routable read set comes from OP_TABLE, not
+   a hand list; parked blocking kinds stay pinned to the primary.
+2. Config plumbing — replicas section round-trips; replicas without
+   persist is a construction-time error.
+3. Routing — reads land on caught-up replicas, fall back to the primary
+   when the staleness bound can't be met, and read-your-writes pins a
+   tenant above its acked seq.
+4. Failover — the highest-watermark replica is promoted with zero acked
+   writes lost; survivors retarget (partial or full resync); the demoted
+   slot rejoins; WAIT semantics via wait_for_replicas.
+"""
+
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.commands import OP_TABLE
+from redisson_tpu.config import Config, ReplicaConfig
+from redisson_tpu.replica import READ_KINDS
+
+
+def make_replicated(tmp_path, n=2, **replica_kw):
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_serve()
+    cfg.use_persist(str(tmp_path / "primary")).fsync = "always"
+    rc = cfg.use_replicas(n)
+    rc.poll_interval_s = 0.005
+    rc.health_interval_s = 0.0  # deterministic tests drive failover manually
+    for k, v in replica_kw.items():
+        setattr(rc, k, v)
+    return RedissonTPU.create(cfg)
+
+
+def _wait_caught_up(c, n=2, timeout_s=10.0):
+    assert c.wait_for_replicas(n, timeout_s=timeout_s) == n
+
+
+# ---------------------------------------------------------------------------
+# 1. read set derivation
+# ---------------------------------------------------------------------------
+
+def test_read_kinds_derived_from_op_table():
+    assert READ_KINDS  # non-empty: the engine has read ops
+    for kind in READ_KINDS:
+        assert not OP_TABLE[kind].write
+    # every write kind stays on the primary
+    assert not any(OP_TABLE[k].write for k in READ_KINDS)
+    # parked blocking reads (and their control ops) are pinned to the
+    # primary: a bpop on a replica would wait on a frozen snapshot forever.
+    assert "bpop" not in READ_KINDS
+    assert "bpop_cancel" not in READ_KINDS
+
+
+# ---------------------------------------------------------------------------
+# 2. config plumbing
+# ---------------------------------------------------------------------------
+
+def test_config_replicas_roundtrip():
+    cfg = Config()
+    rc = cfg.use_replicas(3)
+    rc.max_lag_seqs = 77
+    rc.read_your_writes = False
+    d = cfg.to_dict()
+    back = Config.from_dict(d)
+    assert isinstance(back.replicas, ReplicaConfig)
+    assert back.replicas.num_replicas == 3
+    assert back.replicas.max_lag_seqs == 77
+    assert back.replicas.read_your_writes is False
+
+
+def test_replicas_require_persist(tmp_path):
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_replicas(1)  # no use_persist: nothing to tail
+    with pytest.raises(ValueError, match="persist"):
+        RedissonTPU.create(cfg)
+
+
+# ---------------------------------------------------------------------------
+# 3. routing
+# ---------------------------------------------------------------------------
+
+def test_reads_route_to_replicas_and_match(tmp_path):
+    c = make_replicated(tmp_path, n=2)
+    try:
+        m = c.get_map("m")
+        for i in range(30):
+            m.put(f"k{i}", i)
+        _wait_caught_up(c, 2)
+        for i in range(10):
+            assert m.get(f"k{i}") == i
+        snap = c._dispatch.snapshot()
+        assert snap["replica_reads"] >= 10  # reads left the primary
+        assert snap["watermarks"] and all(
+            w >= 30 for w in snap["watermarks"].values())
+        # writes stayed on the primary journal
+        assert c.persist.journal.last_seq >= 30
+    finally:
+        c.shutdown()
+
+
+def test_stale_replica_falls_back_to_primary(tmp_path):
+    c = make_replicated(tmp_path, n=1, max_lag_seqs=2, read_your_writes=False)
+    try:
+        m = c.get_map("m")
+        m.put("k", 1)
+        _wait_caught_up(c, 1)
+        rep = c.replicas.replicas[0]
+        rep._stop.set()  # freeze the tail loop: watermark stops advancing
+        time.sleep(0.05)
+        frozen = rep.applied_seq
+        for i in range(10):  # push primary_seq > frozen + max_lag
+            m.put(f"x{i}", i)
+        assert c.persist.journal.last_seq - frozen > 2
+        before = c._dispatch.primary_fallbacks
+        fut, picked, _ = c._dispatch.routed_read("m", "hget",
+                                                 {"field": b'"x9"'})
+        fut.result(timeout=30)
+        assert picked is None  # outside the bound -> primary served it
+        assert c._dispatch.primary_fallbacks == before + 1
+        # widening the bound makes the frozen replica eligible again
+        _, picked, watermark = c._dispatch.routed_read(
+            "m", "hget", {"field": b'"k"'}, max_lag=10_000)
+        assert picked is rep and watermark == frozen
+    finally:
+        c.shutdown()
+
+
+def test_read_your_writes_pins_above_acked_seq(tmp_path):
+    c = make_replicated(tmp_path, n=1, max_lag_seqs=10_000)
+    try:
+        m = c.get_map("m")
+        m.put("k", 1)
+        _wait_caught_up(c, 1)
+        rep = c.replicas.replicas[0]
+        rep._stop.set()  # freeze; subsequent acked writes outrun it
+        time.sleep(0.05)
+        m.put("k", 2)  # acked (fsync=always) -> RYW pin rises above replica
+        assert c._dispatch.acked_seq("") >= c.persist.journal.last_seq - 1
+        _, picked, _ = c._dispatch.routed_read("m", "hget",
+                                               {"field": b'"k"'})
+        assert picked is None  # RYW: stale replica may not serve this tenant
+        # the same read with RYW off happily takes the stale replica
+        _, picked, _ = c._dispatch.routed_read(
+            "m", "hget", {"field": b'"k"'}, read_your_writes=False)
+        assert picked is rep
+    finally:
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. failover
+# ---------------------------------------------------------------------------
+
+def test_failover_promotes_highest_watermark_zero_loss(tmp_path):
+    c = make_replicated(tmp_path, n=2)
+    try:
+        m = c.get_map("m")
+        for i in range(10):
+            m.put(f"k{i}", i)
+        _wait_caught_up(c, 2)
+        lagger = c.replicas.replicas[0]
+        lagger._stop.set()  # replica-0 freezes; replica-1 keeps tailing
+        time.sleep(0.05)
+        for i in range(10, 25):
+            m.put(f"k{i}", i)  # every one acked under fsync=always
+        _wait_caught_up(c, 1)
+        mgr = c.replicas
+        c._executor.shutdown(wait=False)  # primary dies
+        promoted = mgr.failover("test kill")
+        assert promoted is not None
+        assert mgr._promoted.name == "replica-1"  # highest watermark wins
+        assert mgr.promotions == 1
+        # a second trigger is a no-op: first one won
+        assert mgr.failover("late trigger") is None
+        # zero acked writes lost on the promoted primary
+        pm = promoted.get_map("m")
+        for i in range(25):
+            assert pm.get(f"k{i}") == i
+        # the promoted journal CONTINUES the global numbering
+        assert promoted._persist.journal.last_seq >= 25
+        # writes flow through the router to the new primary
+        m2 = c.get_map("m")
+        m2.put("post", 99)
+        assert m2.get("post") == 99
+        # the lagging survivor full-resynced from the new snapshot (its
+        # suffix lives only in the fenced old journal)
+        deadline = time.monotonic() + 10
+        while lagger.lag() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert lagger.applied_seq >= 25
+        assert lagger._full_resyncs >= 2
+        # the demoted slot rejoins as a fresh replica and catches up
+        rejoined = mgr.rejoin()
+        assert c.wait_for_replicas(2, timeout_s=10.0) == 2
+        assert rejoined.applied_seq >= 26
+    finally:
+        c.shutdown()
+
+
+def test_wait_for_replicas_semantics(tmp_path):
+    c = make_replicated(tmp_path, n=2)
+    try:
+        c.get_bucket("b").set(1)
+        assert c.wait_for_replicas(2, timeout_s=10.0) == 2
+        # asking for more replicas than exist times out with the true count
+        assert c.wait_for_replicas(3, timeout_s=0.1) == 2
+    finally:
+        c.shutdown()
+
+
+def test_replica_gauges_exported(tmp_path):
+    c = make_replicated(tmp_path, n=2)
+    try:
+        c.get_bucket("b").set(1)
+        _wait_caught_up(c, 2)
+        gauges = c.metrics.snapshot()["gauges"]
+        assert gauges["replica.count"] == 2
+        assert gauges["replica.full_resyncs"] == 2  # one bootstrap each
+        assert gauges["replica.min_watermark"] >= 1
+        assert gauges["replica.max_lag"] >= 0
+    finally:
+        c.shutdown()
