@@ -365,8 +365,9 @@ class JournalFollower:
     def promote(self, catch_up: bool = True, timeout_s: float = 30.0):
         """Failover drill: stop tailing, optionally drain every record the
         journal still exposes, and return the caught-up client — the new
-        leader. The old leader's journal is left untouched (a real failover
-        would fence it first)."""
+        leader. Does not itself fence the old leader's journal — the
+        ReplicaManager's failover path calls `Journal.fence()` first so the
+        drain target is final; a bare drill promotes over a live journal."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout_s)
@@ -389,14 +390,20 @@ class JournalFollower:
                     idle_polls += 1
         return self.client
 
-    def retarget(self, path: str) -> None:
+    def retarget(self, path: str, max_valid_seq: Optional[int] = None) -> None:
         """Repoint a live follower at a new leader's journal (the surviving
         fleet after a failover): stop the tail loop, swap the source dir,
         resync, resume. Stays a PARTIAL resync when the new journal's
         numbering covers our cursor — the promoted primary opens its fresh
         journal at the old global seq precisely so this path avoids a
         snapshot; a replica that was behind the promoted watermark full-
-        bootstraps from the new primary's first snapshot instead."""
+        bootstraps from the new primary's first snapshot instead.
+
+        `max_valid_seq` is the promotion watermark: a follower whose cursor
+        sits PAST it applied old-journal records the new leader never saw,
+        and the new journal will reuse those seq numbers for different
+        contents — its state must be dropped and rebuilt from the new
+        leader's snapshot, never partial-resynced over."""
         was_running = self._thread is not None
         self._stop.set()
         if self._thread is not None:
@@ -409,7 +416,10 @@ class JournalFollower:
         self.path = path
         self._scanner = _WatermarkScanner(path)
         self._stop = threading.Event()
-        self._resync()
+        if max_valid_seq is not None and self.applied_seq > max_valid_seq:
+            self._bootstrap()
+        else:
+            self._resync()
         if was_running:
             self.start()
 

@@ -236,6 +236,7 @@ class Journal:
         self._dirty = False
         self._unsynced_runs = 0
         self._closed = False
+        self._fenced = False
         # counters (stats() snapshots them; writes happen under _io)
         self._records_appended = 0
         self._runs_appended = 0
@@ -358,6 +359,11 @@ class Journal:
         with self._io:
             if self._closed:
                 raise RuntimeError("journal is closed")
+            if self._fenced:
+                # Failover fence: the executor fails the op (nothing has
+                # committed yet), so no write is ever acked into a stream
+                # the surviving fleet has stopped tailing.
+                raise RuntimeError("journal is fenced (failover in progress)")
             self._f.write(frames)
             self._last_seq = seq
             self._records_appended += len(ops)
@@ -397,6 +403,22 @@ class Journal:
         higher seqs and must not feed back into the same replay."""
         with self._io:
             return self._last_seq
+
+    def fence(self) -> None:
+        """Failover fence: flush what's already appended, then refuse every
+        further append_run (the executor fails those ops before they commit,
+        so nothing is acked into a stream the surviving fleet has stopped
+        tailing). After fence() returns, `last_seq` is final — the promotion
+        watermark can be read without racing in-flight writes. Irreversible:
+        a fenced journal only closes."""
+        with self._io:
+            self._fenced = True
+            if not self._closed:
+                self._f.flush()
+
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
 
     # -- durability ---------------------------------------------------------
 
@@ -554,6 +576,7 @@ class Journal:
                 "unsynced_runs": self._unsynced_runs,
                 "segments": len(self._segments),
                 "recovered_tail_bytes": self._recovered_tail_bytes,
+                "fenced": self._fenced,
             }
 
     def close(self) -> None:

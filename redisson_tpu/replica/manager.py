@@ -11,14 +11,19 @@ machine:
   * fault trigger: a retired `DeviceLostFault` observed through the
     FaultManager's listener fan-out trips the same path without waiting
     for a probe window.
-  * failover(): fence reads off the fleet, promote the highest-watermark
-    replica (drain the journal suffix), enable journaling + persistence
-    on the promoted client — its fresh journal CONTINUES the global seq
-    numbering (`Journal(start_seq=watermark)`) and immediately snapshots,
-    so surviving replicas `retarget()` with a PSYNC partial resync when
-    they were caught up, or a clean full bootstrap from the new snapshot
-    when they were behind — then repoint the router. `rejoin()`
-    re-bootstraps the demoted old primary's slot as a fresh replica.
+  * failover(): FENCE the old primary first — its journal refuses further
+    appends (in-flight writes fail before committing, so nothing is acked
+    into a stream the fleet stops tailing) and the router holds new writes
+    — then promote the highest-watermark replica (drain the fenced journal
+    suffix) and enable journaling + persistence on the promoted client:
+    its fresh journal CONTINUES the global seq numbering
+    (`Journal(start_seq=watermark)`) and immediately snapshots, so
+    surviving replicas `retarget()` with a PSYNC partial resync when they
+    were caught up, or a clean full bootstrap from the new snapshot when
+    they were behind (or somehow past the promotion watermark) — then
+    repoint the router, which also releases the held writes onto the new
+    primary. `rejoin()` re-bootstraps the demoted old primary's slot as a
+    fresh replica.
 
 `wait_for_replicas(n, timeout_s)` is the WAIT analogue: block until n
 replicas have applied at least the primary's current committed seq.
@@ -26,6 +31,7 @@ replicas have applied at least the primary's current committed seq.
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import os
 import threading
@@ -37,6 +43,24 @@ from redisson_tpu.replica.replica import ServingReplica
 from redisson_tpu.replica.router import ReplicaRouter
 
 
+def replica_engine_config(primary_config):
+    """Sanitized copy of the primary's engine Config for a replica's own
+    client: codec, compute mode, serve/trace/memory settings carry over
+    (replay through a differently-configured engine silently diverges),
+    while the subsystems a replica must not run are stripped — persist
+    (a follower journaling the leader's ops would double-journal),
+    replicas (no recursive fleets), faults (injection/watchdog belong to
+    the primary), cluster topology, and the redis durability tier."""
+    cfg = copy.deepcopy(primary_config)
+    cfg.persist = None
+    cfg.replicas = None
+    cfg.faults = None
+    cfg.cluster = None
+    cfg.redis = None
+    cfg.flush_interval_s = 0.0
+    return cfg
+
+
 class ReplicaManager:
     def __init__(self, client, cfg):
         self._client = client
@@ -46,6 +70,7 @@ class ReplicaManager:
         self.promotions = 0
         self.last_failover_reason = ""
         self.last_failover_s = 0.0
+        self.last_fence_seq = 0
         self._epoch = 0
         self._next_index = 0
         self._stop = threading.Event()
@@ -92,7 +117,8 @@ class ReplicaManager:
             self._prober.start()
 
     def _spawn_replica(self, path: str) -> ServingReplica:
-        rep = ServingReplica(self._next_index, path, self.cfg)
+        rep = ServingReplica(self._next_index, path, self.cfg,
+                             config=replica_engine_config(self._client.config))
         self._next_index += 1
         rep.start()
         self.replicas.append(rep)
@@ -155,40 +181,75 @@ class ReplicaManager:
     def failover(self, reason: str = "manual"):
         """Promote the highest-watermark replica to primary. Returns the
         promoted client, or None when a failover already happened (the
-        trigger paths race; first one wins)."""
+        trigger paths race; first one wins) or the fleet is empty (nothing
+        to promote; the flag stays clear so a later trigger can retry once
+        replicas exist)."""
         with self._failover_lock:
             if self._failed_over:
                 return None
+            if not self.replicas:
+                self.last_failover_reason = (
+                    f"aborted ({reason}): no replicas to promote")
+                return None
             self._failed_over = True
         t0 = time.monotonic()
-        best = max(self.replicas, key=lambda r: r.applied_seq)
-        survivors = [r for r in self.replicas if r is not best]
-        # Fence: reads stop landing on the promotee while it drains.
-        self.router.set_replicas(survivors)
-        promoted = best.promote(catch_up=True,
-                                timeout_s=self.cfg.promote_timeout_s)
-        watermark = best.applied_seq
-        # Enable journaling + persistence on the new primary. The fresh
-        # journal opens at seq watermark+1 (global numbering continues) and
-        # the immediate snapshot is the full-resync source for any replica
-        # that was behind the promotee.
-        from redisson_tpu.persist import PersistenceManager
+        # FENCE FIRST, promote second. The old journal stops accepting
+        # appends (in-flight writes fail before they commit, so nothing is
+        # acked into a stream the fleet stops tailing), the router holds
+        # new writes until the promotee is installed, and compaction stops
+        # so the drain below can reach the fenced tip. Only after the fence
+        # is any watermark read — last_seq is final from here on.
+        self.router.fence_writes()
+        old_persist = self._client._persist
+        old_journal = old_persist.journal if old_persist is not None else None
+        if old_journal is not None:
+            old_journal.fence()
+        if old_persist is not None:
+            old_persist.stop_background()
+        self.last_fence_seq = (old_journal.last_seq
+                               if old_journal is not None else 0)
+        try:
+            best = max(self.replicas, key=lambda r: r.applied_seq)
+            survivors = [r for r in self.replicas if r is not best]
+            # Reads stop landing on the promotee while it drains.
+            self.router.set_replicas(survivors)
+            promoted = best.promote(catch_up=True,
+                                    timeout_s=self.cfg.promote_timeout_s)
+            # The promotion watermark: the promotee drained the fenced
+            # journal to its tip, so this equals last_fence_seq — every
+            # acked (= journaled) write is in the promoted state.
+            watermark = best.applied_seq
+            # Enable journaling + persistence on the new primary. The fresh
+            # journal opens at seq watermark+1 (global numbering continues)
+            # and the immediate snapshot is the full-resync source for any
+            # replica that was behind the promotee.
+            from redisson_tpu.persist import PersistenceManager
 
-        old_cfg = self._client._persist.cfg
-        self._epoch += 1
-        new_dir = f"{old_cfg.dir.rstrip(os.sep)}-epoch-{self._epoch}"
-        pm = PersistenceManager(
-            promoted,
-            dataclasses.replace(old_cfg, dir=new_dir, auto_recover=False),
-            start_seq=watermark)
-        pm.start()
-        promoted._persist = pm  # promoted client's shutdown tears it down
-        pm.snapshot()
-        self.router.set_primary(promoted._dispatch, pm.journal)
-        self._primary_executor = promoted._executor
-        for rep in survivors:
-            rep.retarget(new_dir)
-        self.router.set_replicas(survivors)
+            old_cfg = old_persist.cfg
+            self._epoch += 1
+            new_dir = f"{old_cfg.dir.rstrip(os.sep)}-epoch-{self._epoch}"
+            pm = PersistenceManager(
+                promoted,
+                dataclasses.replace(old_cfg, dir=new_dir, auto_recover=False),
+                start_seq=watermark)
+            pm.start()
+            promoted._persist = pm  # promoted client's shutdown tears it down
+            pm.snapshot()
+            # Installs the new write target AND lifts the write fence.
+            self.router.set_primary(promoted._dispatch, pm.journal)
+            self._primary_executor = promoted._executor
+            for rep in survivors:
+                # A survivor past the watermark applied old-journal seqs the
+                # promotee never saw — retarget drops its state and
+                # full-bootstraps instead of partial-resyncing over them.
+                rep.retarget(new_dir, max_valid_seq=watermark)
+            self.router.set_replicas(survivors)
+        except BaseException:
+            # Failed mid-promotion: release held writes — they land on the
+            # old primary, whose fenced journal fails them cleanly rather
+            # than acking into an abandoned stream.
+            self.router.unfence_writes()
+            raise
         self._promoted = best
         self.replicas = survivors
         self.promotions += 1
@@ -248,6 +309,7 @@ class ReplicaManager:
             "failed_over": self._failed_over,
             "last_failover_reason": self.last_failover_reason,
             "last_failover_s": self.last_failover_s,
+            "last_fence_seq": self.last_fence_seq,
             "full_resyncs": self.full_resyncs(),
             "partial_resyncs": self.partial_resyncs(),
             "router": self.router.snapshot() if self.router else {},

@@ -19,6 +19,10 @@ from redisson_tpu.persist.follower import JournalFollower
 
 class ServingReplica(JournalFollower):
     def __init__(self, index: int, path: str, cfg, config=None):
+        # `config` is the sanitized copy of the PRIMARY's engine config the
+        # ReplicaManager threads through (persist/replicas/faults stripped):
+        # codec, backend and structure settings must match or journal replay
+        # silently diverges from primary state.
         super().__init__(path, config=config,
                          poll_interval_s=cfg.poll_interval_s,
                          apply_window=cfg.apply_window)
@@ -26,9 +30,14 @@ class ServingReplica(JournalFollower):
         self.name = f"replica-{index}"
         self.reads_served = 0
 
-    def execute_read(self, target: str, kind: str, payload, nkeys: int = 0):
+    def execute_read(self, target: str, kind: str, payload, nkeys: int = 0,
+                     **kw):
+        """Serve one routed read through this replica's own dispatch waist.
+        `kw` (tenant=, deadline=, ...) passes through untouched so a read
+        behaves the same whether a replica or the primary serves it."""
         self.reads_served += 1
-        return self.client._dispatch.execute_async(target, kind, payload, nkeys)
+        return self.client._dispatch.execute_async(target, kind, payload,
+                                                   nkeys, **kw)
 
     def stats(self):
         out = super().stats()

@@ -44,7 +44,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from redisson_tpu.commands import OP_TABLE
-from redisson_tpu.executor import PARKED_KINDS
+from redisson_tpu.executor import BatchCollector, PARKED_KINDS
 
 # bpop parks on the primary's structures; bpop_cancel must reach the same
 # engine that parked it.
@@ -69,6 +69,11 @@ class ReplicaRouter:
         # Serve-layer primaries push acks via enable_ack_tracking; a raw
         # executor primary gets per-future callbacks from the router.
         self._inline_acks = not hasattr(primary_dispatch, "enable_ack_tracking")
+        # Failover fence: cleared while a failover is repointing the
+        # primary — writes hold here instead of landing on a journal the
+        # surviving fleet has stopped tailing.
+        self._unfenced = threading.Event()
+        self._unfenced.set()
 
     # -- fleet / primary management ------------------------------------------
 
@@ -87,11 +92,39 @@ class ReplicaRouter:
     def set_primary(self, dispatch, journal) -> None:
         """Failover repoint: writes and the watermark source swap together.
         The acked map is kept — the promoted journal continues the global
-        seq numbering, so existing pins stay meaningful."""
+        seq numbering, so existing pins stay meaningful. Re-arms ack
+        tracking on the new dispatch (a serve-layer promotee pushes acks
+        itself; a raw executor gets per-future callbacks) and lifts the
+        write fence."""
         with self._lock:
             self._primary = dispatch
             self._journal = journal
             self._inline_acks = not hasattr(dispatch, "enable_ack_tracking")
+        if not self._inline_acks:
+            dispatch.enable_ack_tracking(self)
+        self._unfenced.set()
+
+    # -- failover write fence ------------------------------------------------
+
+    def fence_writes(self) -> None:
+        """First step of failover: hold every new write until set_primary
+        installs the promotee (or unfence_writes aborts). Reads keep
+        flowing — replicas serve what they have, primary fallbacks hit the
+        old dispatch and fail like any read against a dead engine."""
+        self._unfenced.clear()
+
+    def unfence_writes(self) -> None:
+        """Abort path: release held writes without repointing (they land on
+        the old primary, whose fenced journal fails them cleanly)."""
+        self._unfenced.set()
+
+    def _await_unfenced(self) -> None:
+        if self._unfenced.is_set():
+            return
+        if not self._unfenced.wait(self._cfg.promote_timeout_s):
+            raise RuntimeError(
+                "primary is fenced: failover did not repoint writes within "
+                f"promote_timeout_s={self._cfg.promote_timeout_s}")
 
     # -- read-your-writes ----------------------------------------------------
 
@@ -151,6 +184,7 @@ class ReplicaRouter:
                 target, kind, payload, nkeys, tenant=tenant, max_lag=max_lag,
                 max_lag_s=max_lag_s, read_your_writes=read_your_writes, **kw)
             return fut
+        self._await_unfenced()
         fut = self._primary.execute_async(
             target, kind, payload, nkeys,
             tenant=self._resolve_tenant(tenant), **kw)
@@ -172,7 +206,11 @@ class ReplicaRouter:
         if rep is not None:
             watermark = rep.applied_seq
             self.replica_reads += 1
-            return rep.execute_read(target, kind, payload, nkeys), rep, watermark
+            # Same kwargs either way: a deadline= honored on primary
+            # fallback must be honored on the replica too.
+            fut = rep.execute_read(target, kind, payload, nkeys,
+                                   tenant=tenant, **kw)
+            return fut, rep, watermark
         if self._replicas:
             self.primary_fallbacks += 1
         else:
@@ -204,12 +242,23 @@ class ReplicaRouter:
 
     def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]], **kw):
         """Batches stay on the primary unsplit: one admission decision, one
-        deadline, journal-ordered — the acked-write tracking still fires
-        through the serve layer's per-future callbacks."""
-        return self._primary.execute_many(staged, **kw)
+        deadline, journal-ordered. A serve-layer primary pushes acks itself
+        through its per-future callbacks; a raw executor primary gets the
+        router's inline callbacks here, so batched writes advance the
+        tenant's read-your-writes pin on every primary flavor."""
+        self._await_unfenced()
+        futures = self._primary.execute_many(staged, **kw)
+        if self._inline_acks and futures:
+            tenant = self._resolve_tenant(kw.get("tenant"))
+            for (_, kind, _, _), fut in zip(staged, futures):
+                self._track_write_ack(fut, kind, tenant)
+        return futures
 
     def batch(self, **submit_kwargs):
-        return self._primary.batch(**submit_kwargs)
+        # Collect against the router, not the primary: dispatch funnels
+        # through execute_many above, so fencing and RYW ack tracking
+        # apply to RBatch pipelines too.
+        return BatchCollector(self, **submit_kwargs)
 
     def __getattr__(self, name: str):
         # Everything else (backend, queue_depth, tenant context, executor,

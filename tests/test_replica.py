@@ -205,6 +205,137 @@ def test_failover_promotes_highest_watermark_zero_loss(tmp_path):
         c.shutdown()
 
 
+def test_failover_fences_live_old_primary(tmp_path):
+    # The DeviceLostFault trigger retires the fault but the executor keeps
+    # running: without a fence, writes racing the failover would be acked
+    # into the old journal and silently lost. The fence makes them fail.
+    c = make_replicated(tmp_path, n=2)
+    try:
+        m = c.get_map("m")
+        for i in range(10):
+            m.put(f"k{i}", i)
+        _wait_caught_up(c, 2)
+        old_journal = c.persist.journal
+        mgr = c.replicas
+        promoted = mgr.failover("manual, primary still alive")
+        assert promoted is not None
+        # old journal is fenced: last_seq is final, appends are refused
+        assert old_journal.stats()["fenced"]
+        fenced_seq = old_journal.last_seq
+        assert mgr.last_fence_seq == fenced_seq
+        # the promotion watermark reached the fenced tip -> zero acked loss
+        assert mgr._promoted.applied_seq == fenced_seq
+        pm = promoted.get_map("m")
+        for i in range(10):
+            assert pm.get(f"k{i}") == i
+        # a write straggling onto the OLD (live!) primary fails instead of
+        # being acked into the abandoned journal
+        with pytest.raises(Exception, match="fenced"):
+            c._executor.execute_sync("m", "hput",
+                                     {"field": b'"zz"', "value": b"1"})
+        assert old_journal.last_seq == fenced_seq
+        # router writes flow to the new primary (fence was lifted)
+        m.put("post", 1)
+        assert m.get("post") == 1
+        assert promoted._persist.journal.last_seq > fenced_seq
+    finally:
+        c.shutdown()
+
+
+def test_failover_with_empty_fleet_aborts_cleanly(tmp_path):
+    c = make_replicated(tmp_path, n=1)
+    try:
+        mgr = c.replicas
+        for rep in list(mgr.replicas):
+            rep.close()
+        mgr.replicas = []
+        assert mgr.failover("nothing to promote") is None
+        assert mgr._failed_over is False  # not wedged half-failed-over
+        assert "no replicas" in mgr.last_failover_reason
+        # the fleet was never fenced: the primary still accepts writes
+        c.get_bucket("b").set(1)
+        assert c.get_bucket("b").get() == 1
+        # a retry after capacity returns can still promote
+        mgr.rejoin()
+        _wait_caught_up(c, 1)
+        assert mgr.failover("retry") is not None
+    finally:
+        c.shutdown()
+
+
+def test_batch_writes_advance_ryw_pin_inline_acks(tmp_path):
+    # Raw-executor primary (no serve layer): the router itself must attach
+    # ack callbacks on the execute_many/batch paths, or batched writes
+    # never advance the tenant pin and a stale replica serves the read-back.
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_persist(str(tmp_path / "primary")).fsync = "always"
+    rc = cfg.use_replicas(1)
+    rc.poll_interval_s = 0.005
+    rc.health_interval_s = 0.0
+    rc.max_lag_seqs = 10_000
+    c = RedissonTPU.create(cfg)
+    try:
+        router = c._dispatch
+        assert router._inline_acks  # no serve layer on this primary
+        c.get_map("m").put("k", 0)
+        _wait_caught_up(c, 1)
+        rep = c.replicas.replicas[0]
+        rep._stop.set()  # freeze: batched writes must outrun it
+        time.sleep(0.05)
+        batch = c.create_batch()
+        bm = batch.get_map("m")
+        for i in range(5):
+            bm.put_async(f"b{i}", i)
+        batch.execute()
+        assert router.acked_seq("") >= c.persist.journal.last_seq - 1
+        _, picked, _ = router.routed_read("m", "hget", {"field": b'"b4"'})
+        assert picked is None  # RYW pin: the frozen replica may not serve
+    finally:
+        c.shutdown()
+
+
+def test_replicas_inherit_sanitized_primary_config(tmp_path):
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_serve()
+    cfg.codec = "pickle"
+    cfg.use_persist(str(tmp_path / "primary")).fsync = "always"
+    rc = cfg.use_replicas(1)
+    rc.health_interval_s = 0.0
+    c = RedissonTPU.create(cfg)
+    try:
+        rep_cfg = c.replicas.replicas[0].client.config
+        # engine-affecting settings carry over...
+        assert rep_cfg.codec == "pickle"
+        assert rep_cfg.serve is not None
+        # ...subsystems a replica must not run are stripped
+        assert rep_cfg.persist is None
+        assert rep_cfg.replicas is None
+        assert rep_cfg.faults is None
+    finally:
+        c.shutdown()
+
+
+def test_replica_read_honors_deadline_kwarg(tmp_path):
+    from redisson_tpu.serve import DeadlineExceeded
+
+    c = make_replicated(tmp_path, n=1, max_lag_seqs=10_000,
+                        read_your_writes=False)
+    try:
+        c.get_map("m").put("k", 1)
+        _wait_caught_up(c, 1)
+        # an already-expired deadline must fail the read whether a replica
+        # or the primary serves it
+        fut, picked, _ = c._dispatch.routed_read(
+            "m", "hget", {"field": b'"k"'}, deadline=time.monotonic() - 1.0)
+        assert picked is not None  # a replica was chosen...
+        with pytest.raises(DeadlineExceeded):
+            fut.result(timeout=30)  # ...and it enforced the deadline
+    finally:
+        c.shutdown()
+
+
 def test_wait_for_replicas_semantics(tmp_path):
     c = make_replicated(tmp_path, n=2)
     try:
