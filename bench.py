@@ -916,6 +916,91 @@ def bench_replica(quick=False):
     return out
 
 
+def bench_ha(quick=False):
+    """Shard-level HA numbers (PR 14): cluster_failover_s — wall time
+    from killing a shard's primary to the first acked write on its
+    promotee — and reads_served_during_failover — replica-served reads
+    that completed inside that window (the survivor fleet keeps the
+    shard readable while it has no primary)."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.ops.crc16 import key_slot
+
+    n_readers = 2
+    tmp = tempfile.mkdtemp(prefix="rtpu-bench-ha-")
+    out = {}
+    cfg = Config()
+    cfg.use_cluster(num_shards=2, dir=os.path.join(tmp, "cl"),
+                    replicas_per_shard=2)
+    rc = cfg.use_replicas(2)
+    rc.poll_interval_s = 0.002
+    rc.max_lag_seqs = 1 << 30
+    rc.health_interval_s = 0.0
+    c = RedissonTPU.create(cfg)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        keys = [f"hb{i}" for i in range(400)
+                if table[key_slot(f"hb{i}")] == 0][:8]
+        for k in keys:
+            c.get_bucket(k).set("v0")
+        s0 = mgr.shards[0]
+        fleet = s0.replicas
+        deadline = time.monotonic() + 30
+        while (any(r.lag() > 0 for r in fleet.replicas)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+
+        stop = threading.Event()
+        stamps = [[] for _ in range(n_readers)]
+
+        def reader(slot):
+            j = slot
+            while not stop.is_set():
+                try:
+                    fut, rep, _ = s0.dispatch.routed_read(
+                        keys[j % len(keys)], "get", None,
+                        max_lag=1 << 30, read_your_writes=False)
+                    fut.result(30)
+                    if rep is not None:  # replica-served, not primary
+                        stamps[slot].append(time.perf_counter())
+                except Exception:  # noqa: BLE001 — reads racing the kill may fail; only successes count
+                    pass
+                j += 1
+
+        threads = [threading.Thread(target=reader, args=(s,), daemon=True)
+                   for s in range(n_readers)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2 if quick else 0.5)
+        t_kill = time.perf_counter()
+        s0.client._executor.shutdown(wait=False)  # shard primary dies
+        promoted = fleet.failover("bench kill")
+        c.get_bucket(keys[0]).set("post-failover")  # first write lands
+        t_done = time.perf_counter()
+        stop.set()
+        for t in threads:
+            t.join(30)
+        out["cluster_failover_s"] = round(t_done - t_kill, 4)
+        out["cluster_failover_promote_s"] = round(fleet.last_failover_s, 4)
+        out["reads_served_during_failover"] = sum(
+            1 for ts in stamps for ts_i in ts if t_kill <= ts_i <= t_done)
+        assert promoted is not None
+    finally:
+        c.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"# ha: shard failover {out['cluster_failover_s'] * 1e3:.0f} ms "
+          f"to first write on the promotee; "
+          f"{out['reads_served_during_failover']} replica reads served "
+          f"while the shard had no primary", file=sys.stderr)
+    return out
+
+
 def main():
     import os
 
@@ -1053,6 +1138,10 @@ def main():
         result["replica"] = bench_replica(quick)
     except Exception as exc:  # noqa: BLE001
         print(f"# replica bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["ha"] = bench_ha(quick)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# ha bench failed: {exc!r}", file=sys.stderr)
     try:
         mem = bench_memstat(1 << 12 if quick else 1 << 18)
         result["hbm_live_bytes"] = mem["hbm_live_bytes"]

@@ -2065,6 +2065,292 @@ def replica_smoke():
     return ok
 
 
+def ha_smoke():
+    """Shard-level HA acceptance (the CPU-only CI contract for cluster x
+    replica composition). Gates:
+
+      (a) CHAOS UNDER MIGRATION: a cluster with per-shard replica fleets
+          runs single-writer-per-key traffic plus replica-routed reads,
+          recorded as an invoke/ack history. Mid-migration the SOURCE
+          shard's primary is killed (the migrator resumes its suffix
+          against the promotee's continuing journal) while seeded
+          replica_tail partitions freeze replica watermarks. The gate:
+          migration completes, the keyspace digest is identical to the
+          acked-map oracle, and the history checker's verdict is clean
+          (zero lost acks, bounded staleness, RYW, monotonic reads).
+      (b) SPLIT-BRAIN PROBE: seeded health_probe false negatives drive a
+          SPURIOUS failover of a live shard primary under unique-value
+          writes. The fence makes split-brain impossible: every acked
+          value lands in exactly ONE journal (the old primary's or the
+          promotee's epoch journal), never both, never neither.
+    """
+    import hashlib
+    import json as _json
+    import shutil
+    import tempfile
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.fault import inject
+    from redisson_tpu.ops.crc16 import key_slot
+    from tools import histcheck
+
+    rps = 1 if _TINY else 2
+    n_mig_keys = 20 if _TINY else 60
+    n_read_keys = 6 if _TINY else 12
+    n_read_rounds = 120 if _TINY else 500
+    ok = True
+    tmp = tempfile.mkdtemp(prefix="rtpu-ha-smoke-")
+
+    def ha_cluster(subdir, num_shards, health_interval_s=0.0):
+        cfg = Config()
+        cfg.use_cluster(num_shards=num_shards,
+                        dir=os.path.join(tmp, subdir),
+                        replicas_per_shard=rps)
+        rc = cfg.use_replicas(rps)  # per-shard fleet tuning template
+        rc.poll_interval_s = 0.002
+        # 0.0 = no prober: gate (a) drives failover itself; gate (b)
+        # arms probing so the injected false negatives can trip it.
+        rc.health_interval_s = health_interval_s
+        rc.health_failures = 2
+        return RedissonTPU.create(cfg)
+
+    def digest(kv):
+        h = hashlib.sha256()
+        for k in sorted(kv):
+            h.update(k.encode() + b"=" + str(kv[k]).encode() + b";")
+        return h.hexdigest()
+
+    # -- (a) chaos under migration: kill + partitions, checked history ---
+    c = ha_cluster("chaos", num_shards=3)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        mig_keys, read_keys, i = [], [], 0
+        while len(mig_keys) < n_mig_keys or len(read_keys) < n_read_keys:
+            k = f"ha{i}"
+            owner = table[key_slot(k)]
+            if owner == 0 and len(mig_keys) < n_mig_keys:
+                mig_keys.append(k)
+            elif owner == 1 and len(read_keys) < n_read_keys:
+                read_keys.append(k)
+            i += 1
+        for k in mig_keys + read_keys:
+            c.get_bucket(k).set("v0")
+        move_slots = sorted({key_slot(k) for k in mig_keys})
+        s0, s1 = mgr.shards[0], mgr.shards[1]
+        deadline = time.time() + 30
+        while (any(r.lag() > 0 for s in (s0, s1)
+                   for r in s.replicas.replicas)
+               and time.time() < deadline):
+            time.sleep(0.005)
+
+        # Seeded partitions: every fleet's tail named "replica-0" freezes
+        # for a long stretch of polls. Promotion is immune (its drain
+        # bypasses the tail loop) — the gate is that READS stay correct
+        # via primary fallback while the frozen watermark disqualifies
+        # the partitioned replica.
+        inj = inject.FaultInjector(inject.FaultPlan(rules=[
+            inject.FaultRule(seam="replica_tail", fault="retryable",
+                             nth=20, times=400, target="replica-0"),
+        ], seed=0x4A))
+        inject.install(inj)
+
+        mig_rec = histcheck.HistoryRecorder()
+        read_rec = histcheck.HistoryRecorder()
+        stop = threading.Event()
+        logical_seq = [0]
+
+        def mig_writer():
+            # Single writer per key; a fence-raced ack is recorded as
+            # unknown-fate and retried until acked, so the oracle below
+            # is exact. Seqs are logical (the keys cross journals as
+            # their slots migrate; lost-ack checking needs order only).
+            n = 0
+            while not stop.is_set():
+                k = mig_keys[n % len(mig_keys)]
+                v = f"m{n}"
+                while not stop.is_set():
+                    try:
+                        c.get_bucket(k).set(v)
+                        logical_seq[0] += 1
+                        mig_rec.record_write("wm", k, v, logical_seq[0])
+                        break
+                    except Exception:  # noqa: BLE001 — fence race: fate unknown, retried (idempotent set)
+                        mig_rec.record_write_unknown("wm", k, v)
+                        time.sleep(0.005)
+                n += 1
+                time.sleep(0.001)
+
+        def read_worker():
+            # Writes + replica-routed reads on the stable shard, recorded
+            # with REAL journal seqs (this shard never migrates or fails
+            # over, so its seq space is the history's clock). The same
+            # thread writes and reads, so recording order per tenant is
+            # real-time order — what RYW checking needs.
+            journal = s1.journal
+            n = 0
+            while not stop.is_set():
+                k = read_keys[n % len(read_keys)]
+                v = f"r{n}"
+                try:
+                    c.get_bucket(k).set(v)
+                except Exception:  # noqa: BLE001 — never expected on the stable shard; surfaces as a lost ack
+                    read_rec.record_write_unknown("wr", k, v)
+                    n += 1
+                    continue
+                read_rec.record_write("wr", k, v, journal.last_seq)
+                fut, _, wm = s1.dispatch.routed_read(k, "get", None)
+                raw = fut.result(30)
+                hi = journal.last_seq
+                val = _json.loads(raw) if raw is not None else None
+                read_rec.record_read("wr", k, val, watermark=wm,
+                                     primary_seq=hi)
+                n += 1
+                if n >= n_read_rounds:
+                    break
+
+        wt = threading.Thread(target=mig_writer, daemon=True)
+        rt = threading.Thread(target=read_worker, daemon=True)
+        wt.start()
+        rt.start()
+        result = {}
+
+        def migrate():
+            try:
+                result["stats"] = mgr.migrate_slots(move_slots, 2,
+                                                    timeout_s=120)
+            except Exception as exc:  # noqa: BLE001 — surfaced in the gate print below
+                result["err"] = repr(exc)
+
+        mt = threading.Thread(target=migrate, daemon=True)
+        mt.start()
+        deadline = time.time() + 30
+        while not s0.guard.migrating_slots() and time.time() < deadline:
+            time.sleep(0.001)
+        killed = bool(s0.guard.migrating_slots())
+        if killed:
+            # The chaos moment: the migration source's primary dies with
+            # slots mid-flight; failover must resume the suffix.
+            s0.client._executor.shutdown(wait=False)
+            s0.replicas.failover("ha-smoke: source kill mid-migration")
+        mt.join(150)
+        stop.set()
+        wt.join(10)
+        rt.join(10)
+
+        migrated = "stats" in result
+        post = mgr.router.slot_table()
+        flipped = migrated and all(post[s] == 2 for s in move_slots)
+        got = {k: c.get_bucket(k).get() for k in mig_keys}
+        want = {k: recs[-1][2] for k, recs in mig_rec.writes().items()}
+        for k in mig_keys:
+            want.setdefault(k, "v0")
+        same = digest(got) == digest(want)
+        mv = histcheck.check(mig_rec, final_state=got)
+        rv = histcheck.check(
+            read_rec,
+            final_state={k: c.get_bucket(k).get() for k in read_keys})
+        snap = inj.snapshot()
+        fallbacks = s1.dispatch.primary_fallbacks
+        print(f"# ha-smoke[chaos]: kill mid-migration "
+              f"{'fired' if killed else 'MISSED WINDOW'}, migration "
+              f"{'completed' if migrated else 'FAILED: ' + result.get('err', '?')}, "
+              f"{mgr.failovers()} failover(s), "
+              f"{snap['injected']} replica_tail partitions, "
+              f"{fallbacks} primary fallbacks | {mv.summary()} | "
+              f"{rv.summary()} | digest "
+              f"{'identical' if same else 'MISMATCH'}")
+        if (not killed or not migrated or not flipped or not same
+                or not mv.ok or not rv.ok or mgr.failovers() < 1
+                or snap["injected"] == 0 or rv.reads_checked == 0):
+            for issue in (mv.issues + rv.issues)[:10]:
+                print(f"#   {issue}", file=sys.stderr)
+            print("#   chaos-under-migration gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        inject.uninstall()
+        _close(c)
+
+    # -- (b) split-brain probe: spurious failover, exactly-once acks -----
+    c = ha_cluster("brain", num_shards=2, health_interval_s=0.02)
+    try:
+        mgr = c.cluster
+        s0 = mgr.shards[0]
+        fleet = s0.replicas
+        table = mgr.router.slot_table()
+        bkeys = [f"sb{i}" for i in range(400)
+                 if table[key_slot(f"sb{i}")] == 0][:4]
+        for k in bkeys:
+            c.get_bucket(k).set("seed")
+        deadline = time.time() + 30
+        while (any(r.lag() > 0 for r in fleet.replicas)
+               and time.time() < deadline):
+            time.sleep(0.005)
+        old_journal_path = s0.journal.path
+        # Prober with false negatives ONLY for shard 0's fleet (targeted
+        # by base dir); two consecutive misses trip the failover.
+        inj = inject.FaultInjector(inject.FaultPlan(rules=[
+            inject.FaultRule(seam="health_probe", fault="retryable",
+                             nth=3, times=2, target=fleet._base_dir),
+        ], seed=0xB12A))
+        acked, unknown = {}, []
+        stop = threading.Event()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                k = bkeys[n % len(bkeys)]
+                v = f"u{n}"
+                try:
+                    c.get_bucket(k).set(v)
+                    acked[v] = k
+                except Exception:  # noqa: BLE001 — fence race: fate checked against both journals below
+                    unknown.append(v)
+                n += 1
+                time.sleep(0.0005)
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        inject.install(inj)
+        deadline = time.time() + 30
+        while fleet.promotions < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        spurious = fleet.promotions == 1
+        time.sleep(0.1)  # post-failover writes land on the promotee
+        stop.set()
+        wt.join(10)
+        dupes, missing = [], []
+        if spurious:
+            new_journal = fleet.primary_client._persist.journal
+            new_journal.sync()  # iter_records scans files: flush first
+            new_journal_path = new_journal.path
+            old_vals = {_json.loads(v) for _, tgt, v in
+                        histcheck.journal_writes(old_journal_path)
+                        if tgt in bkeys and v is not None}
+            new_vals = {_json.loads(v) for _, tgt, v in
+                        histcheck.journal_writes(new_journal_path)
+                        if tgt in bkeys and v is not None}
+            dupes = sorted(old_vals & new_vals)
+            missing = [v for v in acked
+                       if v not in old_vals and v not in new_vals]
+        print(f"# ha-smoke[split-brain]: spurious failover "
+              f"{'fired' if spurious else 'NEVER FIRED'} "
+              f"({fleet.last_failover_reason!r}), {len(acked)} acked + "
+              f"{len(unknown)} unknown-fate writes; values in BOTH "
+              f"journals: {len(dupes)}, acked-but-in-NEITHER: "
+              f"{len(missing)}")
+        if not spurious or dupes or missing or not acked:
+            print("#   split-brain gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        inject.uninstall()
+        _close(c)
+        shutil.rmtree(tmp, ignore_errors=True)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -2128,6 +2414,15 @@ def main():
                          "with zero acked-write loss and a fault-free "
                          "oracle digest match, and >= 1.5x read scaling "
                          "from 0 -> 2 replicas, then exit")
+    ap.add_argument("--ha-smoke", action="store_true",
+                    help="shard-level HA acceptance: per-shard replica "
+                         "fleets under seeded chaos — source-primary kill "
+                         "mid-slot-migration plus replica_tail partitions "
+                         "with a clean history-checker verdict and a "
+                         "digest identical to the acked-map oracle, and a "
+                         "spurious health_probe failover where every "
+                         "acked write lands in exactly one journal, then "
+                         "exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -2158,6 +2453,9 @@ def main():
 
     if args.replica_smoke:
         sys.exit(0 if replica_smoke() else 1)
+
+    if args.ha_smoke:
+        sys.exit(0 if ha_smoke() else 1)
 
     if args.mem_smoke:
         sys.exit(0 if mem_smoke() else 1)

@@ -454,7 +454,9 @@ class RedissonTPU:
         return self._require_cluster("CLUSTER KEYSLOT").cluster_keyslot(key)
 
     def cluster_slots(self):
-        """CLUSTER SLOTS analogue: (start, end_inclusive, shard_id) ranges."""
+        """CLUSTER SLOTS analogue: (start, end_inclusive, shard_id,
+        replica_entries) ranges; each replica entry is {id, watermark, lag}
+        for the owning shard's fleet, like redis lists replicas per range."""
         return self._require_cluster("CLUSTER SLOTS").cluster_slots()
 
     def cluster_info(self):

@@ -104,6 +104,22 @@ class ClusterManager:
                 dir=os.path.join(cluster.dir, f"shard-{shard_id:02d}"),
                 fsync=cluster.fsync,
                 snapshot_interval_s=0.0)
+        if cluster.replicas_per_shard > 0:
+            from redisson_tpu.config import ReplicaConfig
+
+            if not cluster.dir:
+                raise ValueError(
+                    "cluster.replicas_per_shard needs cluster.dir — each "
+                    "shard's fleet tails that shard's journal")
+            # Per-shard replica fleet (shard-level HA): the shard client
+            # wires its own ReplicaManager/ReplicaRouter, so reads route
+            # with bounded staleness and a primary loss fails over INSIDE
+            # the shard while the rest of the slot map keeps serving — the
+            # per-partition slave set of ClusterConnectionManager.java.
+            # Config.replicas on the facade acts as the tuning template.
+            template = parent.replicas or ReplicaConfig()
+            shard_cfg.replicas = dataclasses.replace(
+                template, num_replicas=cluster.replicas_per_shard)
         if cluster.shard_serve:
             if parent.serve is None:
                 raise ValueError("cluster.shard_serve needs Config.serve")
@@ -303,22 +319,42 @@ class ClusterManager:
         """CLUSTER KEYSLOT."""
         return key_slot(key)
 
-    def cluster_slots(self) -> List[Tuple[int, int, int]]:
-        """CLUSTER SLOTS shape: (start, end_inclusive, shard_id) ranges."""
-        return self.router.ranges()
+    def cluster_slots(self) -> List[Tuple[int, int, int, List[dict]]]:
+        """CLUSTER SLOTS shape: (start, end_inclusive, shard_id, replicas)
+        ranges — `replicas` lists the owning shard's fleet members as
+        {id, watermark, lag} dicts, the way redis CLUSTER SLOTS appends
+        replica entries after the master per range (empty without
+        replicas_per_shard)."""
+        out = []
+        for start, end, shard_id in self.router.ranges():
+            shard = self.shards.get(shard_id)
+            entries = shard.replica_entries() if shard is not None else []
+            out.append((start, end, shard_id, entries))
+        return out
+
+    def failovers(self) -> int:
+        """Total per-shard promotions across the cluster."""
+        return sum(s.replicas.promotions for s in self.shards.values()
+                   if s.replicas is not None)
 
     def cluster_info(self) -> Dict[str, Any]:
         """CLUSTER INFO analogue (`cluster_state:ok` etc.)."""
         table = self.router.slot_table()
         assigned = sum(1 for s in table if s is not None and s >= 0)
         quarantined = sum(1 for s in self.shards.values() if s.quarantined)
+        replicas = sum(len(s.replicas.replicas) for s in self.shards.values()
+                       if s.replicas is not None)
         return {
             "cluster_enabled": 1,
             "cluster_state": "ok" if quarantined == 0 else "degraded",
             "cluster_slots_assigned": assigned,
-            "cluster_known_nodes": len(self.shards),
+            # Known nodes counts every engine in the topology — masters
+            # plus live fleet members, like redis counts replicas too.
+            "cluster_known_nodes": len(self.shards) + replicas,
+            "cluster_replicas": replicas,
             "cluster_size": len(self.shards) - quarantined,
             "migrations": self.migrations,
+            "failovers": self.failovers(),
             "redirects": self.router.redirects,
             "retries_exhausted": self.router.retries_exhausted,
             "cross_shard_merges": self.router.cross_shard_merges,

@@ -74,9 +74,14 @@ class SlotMigrator:
         self._timeout_s = timeout_s
         self._queue: List[JournalRecord] = []
         self._qlock = threading.Lock()
+        # The source journal object we are subscribed to; a per-shard
+        # failover swaps the live journal (promotee epoch dir, same global
+        # seq numbering) and _sync_source_journal re-subscribes.
+        self._journal = None
         self.stats: Dict[str, int] = {
             "bootstrapped_objects": 0, "bootstrapped_structures": 0,
-            "caught_up_records": 0, "apply_errors": 0,
+            "caught_up_records": 0, "apply_errors": 0, "apply_retries": 0,
+            "source_failovers": 0, "aborts": 0,
         }
 
     # -- journal listener ----------------------------------------------------
@@ -88,7 +93,46 @@ class SlotMigrator:
     def _drain_queue(self) -> List[JournalRecord]:
         with self._qlock:
             out, self._queue = self._queue, []
+        if len(out) > 1:
+            # A failover backfill can interleave with live listener
+            # appends: replay strictly in seq order, once per seq.
+            out.sort(key=lambda r: r.seq)
+            deduped, last = [], -1
+            for rec in out:
+                if rec.seq != last:
+                    deduped.append(rec)
+                    last = rec.seq
+            out = deduped
         return out
+
+    def _sync_source_journal(self, applied: int) -> None:
+        """Failover-under-migration (source side): the source shard
+        promoted a replica, so its live journal is a NEW object in an
+        epoch dir CONTINUING the global seq numbering. Re-subscribe the
+        listener and backfill what the promotee committed before the
+        listener landed — `flush + read file` closes the gap, and the
+        drain's seq dedup absorbs the overlap with live appends. Called
+        from the single protocol thread, so no drain races the swap."""
+        current = self.source.journal
+        if current is None or current is self._journal:
+            return
+        old = self._journal
+        current.add_listener(self._on_records)
+        if old is not None:
+            old.remove_listener(self._on_records)
+        self._journal = current
+        from redisson_tpu.persist.journal import iter_records
+
+        # Records appended before our listener attached are in the new
+        # journal's buffer/file; sync() flushes the buffered tail so the
+        # file read below sees everything pre-attach.
+        current.sync()
+        backfill = [r for r in iter_records(current.path, from_seq=applied)
+                    if r.seq > applied]
+        if backfill:
+            with self._qlock:
+                self._queue.extend(backfill)
+        self.stats["source_failovers"] += 1
 
     # -- record filtering (the slot-filtered replay) -------------------------
 
@@ -106,12 +150,13 @@ class SlotMigrator:
         futures: List = []
 
         def drain() -> None:
-            for fut in futures:
+            for rec, fut in futures:
                 try:
                     fut.result(timeout=self._timeout_s)
                 except Exception:
-                    # graftlint: allow-bare(catch-up mirrors follower.py: a record may fail exactly as it failed live on the source; counted, never kills the migration)
-                    self.stats["apply_errors"] += 1
+                    # graftlint: allow-bare(catch-up mirrors follower.py: a record may fail exactly as it failed live on the source; counted — unless the TARGET failed over mid-apply, which re-drives through the promotee)
+                    if not self._retry_failover_apply(rec, executor):
+                        self.stats["apply_errors"] += 1
             futures.clear()
 
         group = None
@@ -121,9 +166,62 @@ class SlotMigrator:
                 drain()
                 group = key
             futures.append(
-                executor.execute_async(rec.target, rec.kind, rec.payload))
+                (rec,
+                 executor.execute_async(rec.target, rec.kind, rec.payload)))
         drain()
         self.stats["caught_up_records"] += len(records)
+
+    def _snapshot_source(self) -> str:
+        """Cut the bootstrap snapshot on the source's CURRENT primary. A
+        failover racing the cut leaves the captured persist fenced (its
+        snapshotter re-seeds ownership through the fenced journal and
+        fails); ride it out by re-resolving `source.persist` until the
+        promotee's epoch persistence is installed and cutting there —
+        the promotee's snapshot is simply a later, equally consistent
+        bootstrap point."""
+        deadline = time.monotonic() + self._timeout_s
+        while True:
+            persist = self.source.persist
+            try:
+                return persist.snapshot()
+            except Exception:
+                if time.monotonic() >= deadline:
+                    raise
+                journal = persist.journal if persist is not None else None
+                fenced = journal is not None and journal.fenced
+                if self.source.persist is persist and not fenced:
+                    raise  # genuine snapshot error, not a failover race
+                time.sleep(0.02)
+
+    def _retry_failover_apply(self, rec: JournalRecord,
+                              failed_executor) -> bool:
+        """Failover-under-migration (target side): a record that failed
+        against a dead or already-replaced target executor re-applies
+        through the promotee once it is installed. A record that failed
+        against the LIVE current executor is a genuine replay error
+        (mirrors how it failed live on the source) and is not retried.
+        Re-driving is at-least-once across the fence race — the same
+        semantics as a retried redis MIGRATE."""
+        deadline = time.monotonic() + self._timeout_s
+        while time.monotonic() < deadline:
+            current = self.target.executor
+            if current is failed_executor:
+                try:
+                    if current.is_alive():
+                        return False
+                except Exception:
+                    # graftlint: allow-bare(an executor that cannot answer is treated as dead: keep waiting for the promotee)
+                    pass
+                time.sleep(0.02)  # failover in flight; promotee pending
+                continue
+            try:
+                current.execute_sync(rec.target, rec.kind, rec.payload)
+            except Exception:
+                # graftlint: allow-bare(fails on the promotee too: a genuine replay error, counted by the caller)
+                return False
+            self.stats["apply_retries"] += 1
+            return True
+        return False
 
     # -- bootstrap ------------------------------------------------------------
 
@@ -174,14 +272,15 @@ class SlotMigrator:
     # -- the protocol ---------------------------------------------------------
 
     def run(self) -> Dict[str, int]:
-        src_persist = self.source.client.persist
+        src_persist = self.source.persist
         if src_persist is None or src_persist.journal is None:
             raise MigrationError(
                 "live migration needs the source shard's journal "
                 "(Config.cluster persists each shard)")
-        journal = src_persist.journal
-        journal.add_listener(self._on_records)
+        self._journal = src_persist.journal
+        self._journal.add_listener(self._on_records)
         cutover_open = False
+        flip_attempted = False
         try:
             self.source.begin_migrate(self.slots, self.target.shard_id)
             # The SETSLOT IMPORTING analogue: the target's guard must accept
@@ -189,22 +288,30 @@ class SlotMigrator:
             # Journaled, so a target crash mid-migration replays the same
             # acceptance before the replayed imports reach its guard.
             self.target.begin_migrate(self.slots, self.target.shard_id)
-            snap_path = src_persist.snapshot()
+            snap_path = self._snapshot_source()
             watermark = int(checkpoint.info(snap_path).get("journal_seq", 0))
             self._bootstrap(snap_path)
 
             # Catch-up: chase the live suffix until we're close enough to
-            # cut over. Writes keep flowing to the source the whole time.
+            # cut over. Writes keep flowing to the source the whole time —
+            # and a source failover mid-chase swaps the journal underneath
+            # us: _sync_source_journal resumes the suffix against the
+            # promotee's continuing global seq.
             applied = watermark
             deadline = time.monotonic() + self._timeout_s
             while True:
+                self._sync_source_journal(applied)
                 pending = [r for r in self._drain_queue() if r.seq > applied]
                 if pending:
                     applied = pending[-1].seq
                     self._apply([r for r in
                                  (self._filter(rec) for rec in pending)
                                  if r is not None])
-                if journal.last_seq - applied <= self._cutover_lag:
+                if self._journal.last_seq - applied <= self._cutover_lag \
+                        and not self._journal.fenced:
+                    # A fenced journal mid-failover is NOT converged: its
+                    # last_seq is final but the promotee's continuation
+                    # journal is about to carry the live suffix.
                     break
                 if time.monotonic() > deadline:
                     raise MigrationError("catch-up never converged")
@@ -213,10 +320,12 @@ class SlotMigrator:
             # ASK window), then journal the flip — its seq is the fence.
             self.router.begin_cutover(self.slots)
             cutover_open = True
+            flip_attempted = True
             self.source.flip(self.slots)
             flip_seq = None
             deadline = time.monotonic() + self._timeout_s
             while flip_seq is None:
+                self._sync_source_journal(applied)
                 for rec in self._drain_queue():
                     if rec.seq <= applied:
                         continue
@@ -242,7 +351,34 @@ class SlotMigrator:
             self.router.commit_cutover(self.slots, self.target.shard_id)
             cutover_open = False
             return dict(self.stats)
+        except BaseException:
+            self._rollback(flip_attempted)
+            raise
         finally:
             if cutover_open:
                 self.router.abort_cutover()
-            journal.remove_listener(self._on_records)
+            if self._journal is not None:
+                self._journal.remove_listener(self._on_records)
+
+    def _rollback(self, flip_attempted: bool) -> None:
+        """Abort to a RETRYABLE journaled state: no slot stays stranded in
+        `migrating`, and no slot goes ownerless. When the flip may have
+        landed (it journals before we could observe the failure) the
+        source RE-ADOPTS the slots — adopt is a journaled union, so it is
+        idempotent when the flip never actually committed. Both sides are
+        best-effort: an abort caused by a dead shard can only clean up
+        the living one, and recovery replay heals the rest."""
+        try:
+            if flip_attempted:
+                self.source.adopt(self.slots)
+            else:
+                self.source.abort_migrate(self.slots)
+        except Exception:
+            # graftlint: allow-bare(rollback on a dead source waits for its own recovery replay; the living side still gets cleaned below)
+            pass
+        try:
+            self.target.abort_migrate(self.slots)
+        except Exception:
+            # graftlint: allow-bare(rollback on a dead target waits for its own recovery replay)
+            pass
+        self.stats["aborts"] += 1

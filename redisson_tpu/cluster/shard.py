@@ -22,6 +22,7 @@ and the dispatch the router submits to.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Iterable, List, Optional, Set
 
 from redisson_tpu.cluster.errors import SlotMovedError
@@ -29,6 +30,7 @@ from redisson_tpu.ops.crc16 import key_slot
 
 CLUSTER_KINDS = frozenset({
     "migrate_begin", "migrate_flip", "migrate_adopt", "migrate_install",
+    "migrate_abort",
 })
 
 
@@ -135,6 +137,14 @@ class SlotOwnershipBackend:
                             self._owned |= slots
                         self._migrating -= slots
                     op.future.set_result(True)
+                elif kind == "migrate_abort":
+                    # Migration rollback (SETSLOT STABLE): clear the
+                    # migrating mark; ownership is untouched — the source
+                    # re-adopts explicitly when a flip must be undone.
+                    slots = {int(s) for s in op.payload["slots"]}
+                    with self._lock:
+                        self._migrating -= slots
+                    op.future.set_result(True)
                 else:  # migrate_install: structure-tier state for our slots
                     structures = getattr(self._inner, "structures", None)
                     if structures is None:
@@ -148,42 +158,119 @@ class SlotOwnershipBackend:
 
 
 class ClusterShard:
-    """The manager's handle on one shard: client + guard + dispatch."""
+    """The manager's handle on one shard: client + guard + dispatch.
+
+    With `ClusterConfig.replicas_per_shard` the shard client carries its
+    own replica fleet (its `_dispatch` is a ReplicaRouter), and a shard-
+    level failover can swap the live engine underneath this handle — so
+    `guard` / `executor` / `journal` resolve through the fleet's CURRENT
+    primary on every access instead of being captured at construction."""
 
     def __init__(self, shard_id: int, client):
         self.shard_id = int(shard_id)
         self.client = client
-        self.guard: SlotOwnershipBackend = client._routing
-        # User traffic goes through the shard's dispatch (the ServingLayer
-        # when per-shard admission is configured); ownership transitions
-        # and migration replay are maintenance traffic on the raw executor
-        # — never shed, never deadline-expired.
-        self.dispatch = client._dispatch
-        self.executor = client._executor
         self.quarantined = False
+
+    @property
+    def _primary_client(self):
+        """The shard's live engine: the latest promotee after a per-shard
+        failover, the original shard client otherwise."""
+        mgr = getattr(self.client, "replicas", None)
+        return mgr.primary_client if mgr is not None else self.client
+
+    @property
+    def replicas(self):
+        """The shard's ReplicaManager (replicas_per_shard > 0), or None."""
+        return getattr(self.client, "replicas", None)
+
+    @property
+    def guard(self) -> SlotOwnershipBackend:
+        return self._primary_client._routing
+
+    @property
+    def dispatch(self):
+        # User traffic goes through the shard's dispatch — the per-shard
+        # ReplicaRouter when a fleet is configured (it survives failover:
+        # set_primary repoints it in place), else the ServingLayer /
+        # executor as before. Ownership transitions and migration replay
+        # are maintenance traffic on the raw executor — never shed, never
+        # deadline-expired.
+        return self.client._dispatch
+
+    @property
+    def executor(self):
+        return self._primary_client._executor
 
     # -- journaled ownership transitions ------------------------------------
 
+    def _cluster_op(self, kind: str, payload: dict,
+                    timeout_s: float = 30.0) -> None:
+        """Execute one journaled ownership transition on the CURRENT
+        primary, riding out a failover: a fenced journal or a dead
+        executor mid-promotion is transient — the dynamic `executor`
+        property resolves to the promotee once `set_primary` lands, and
+        the op must be re-journaled THERE (cluster kinds are idempotent
+        set operations, so a retry that raced the fence is safe)."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            ex = self.executor
+            try:
+                ex.execute_sync("", kind, payload)
+                return
+            except Exception as exc:
+                fenced = "fenced" in str(exc)
+                try:
+                    dead = not ex.is_alive()
+                except Exception:
+                    # graftlint: allow-bare(an executor that cannot answer is treated as dead: keep waiting for the promotee)
+                    dead = True
+                swapped = self.executor is not ex
+                if ((fenced or dead or swapped)
+                        and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                    continue
+                raise
+
     def adopt(self, slots: Iterable[int]) -> None:
-        self.executor.execute_sync(
-            "", "migrate_adopt", {"slots": sorted(int(s) for s in slots)})
+        self._cluster_op(
+            "migrate_adopt", {"slots": sorted(int(s) for s in slots)})
 
     def begin_migrate(self, slots: Iterable[int], target_shard: int) -> None:
-        self.executor.execute_sync(
-            "", "migrate_begin",
+        self._cluster_op(
+            "migrate_begin",
             {"slots": sorted(int(s) for s in slots),
              "target_shard": int(target_shard)})
 
     def flip(self, slots: Iterable[int]) -> None:
-        self.executor.execute_sync(
-            "", "migrate_flip", {"slots": sorted(int(s) for s in slots)})
+        self._cluster_op(
+            "migrate_flip", {"slots": sorted(int(s) for s in slots)})
+
+    def abort_migrate(self, slots: Iterable[int]) -> None:
+        self._cluster_op(
+            "migrate_abort", {"slots": sorted(int(s) for s in slots)})
 
     # -- introspection -------------------------------------------------------
 
     @property
+    def persist(self):
+        """The CURRENT primary's PersistenceManager (post-failover: the
+        promotee's epoch persistence), or None."""
+        return self._primary_client._persist
+
+    @property
     def journal(self):
-        persist = self.client.persist
+        persist = self.persist
         return persist.journal if persist is not None else None
+
+    def replica_entries(self) -> List[dict]:
+        """CLUSTER SLOTS replica-entry shape for this shard: one dict per
+        fleet member with its id, applied watermark and current lag."""
+        mgr = self.replicas
+        if mgr is None:
+            return []
+        return [{"id": f"shard-{self.shard_id}:{r.name}",
+                 "watermark": r.applied_seq, "lag": r.lag()}
+                for r in mgr.replicas]
 
     def owned_count(self) -> int:
         owned = self.guard.owned_slots()
@@ -198,6 +285,10 @@ class ClusterShard:
             "queue_depth": self.executor.queue_depth(),
             "quarantined": self.quarantined,
         }
+        mgr = self.replicas
+        if mgr is not None:
+            out["replicas"] = self.replica_entries()
+            out["failovers"] = mgr.promotions
         memstat = getattr(self.client, "memstat", None)
         if memstat is not None:
             # Per-shard HBM attribution: each shard owns a full ledger.

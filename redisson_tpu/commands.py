@@ -237,6 +237,9 @@ OP_TABLE = {d.kind: d for d in [
     _d("migrate_flip", "CLUSTER SETSLOT NODE", True, "cluster"),
     _d("migrate_adopt", "CLUSTER ADDSLOTS", True, "cluster"),
     _d("migrate_install", "RESTORE", True, "cluster"),
+    # Journaled migration rollback: clears the migrating mark so an
+    # aborted migration leaves a retryable state (CLUSTER SETSLOT STABLE).
+    _d("migrate_abort", "CLUSTER SETSLOT STABLE", True, "cluster"),
 ]}
 
 

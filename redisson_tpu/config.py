@@ -285,6 +285,13 @@ class ClusterConfig:
     shard_serve: bool = False
     # MOVED redirect retry depth before an op's future fails.
     redirect_retries: int = 5
+    # Shard-level HA: each shard gets its own replica fleet tailing the
+    # shard journal (requires `dir`), with per-shard bounded-staleness read
+    # routing and fence-first automatic failover — the per-partition slave
+    # set of ClusterConnectionManager.java. Replica tuning knobs (staleness
+    # bounds, probe cadence, ...) inherit from Config.replicas when that
+    # section is set on the facade config.
+    replicas_per_shard: int = 0
     # Quarantine-then-migrate on topology node_down events (parallel/
     # topology.py watcher): drain the lost shard's slots onto survivors.
     auto_heal: bool = True
@@ -406,12 +413,15 @@ class Config:
         self.memory = self.memory or MemConfig()
         return self.memory
 
-    def use_cluster(self, num_shards: int = 0, dir: str = "") -> "ClusterConfig":
+    def use_cluster(self, num_shards: int = 0, dir: str = "",
+                    replicas_per_shard: int = 0) -> "ClusterConfig":
         self.cluster = self.cluster or ClusterConfig()
         if num_shards:
             self.cluster.num_shards = num_shards
         if dir:
             self.cluster.dir = dir
+        if replicas_per_shard:
+            self.cluster.replicas_per_shard = replicas_per_shard
         return self.cluster
 
     def use_replicas(self, num_replicas: int = 0) -> "ReplicaConfig":

@@ -17,6 +17,12 @@ Seams (each is one `fire()` call placed in product code):
   journal_fsync   persist/journal.py — before the durability fsync
   snapshot_io     persist/snapshotter.py — the snapshot write
   mesh_collective parallel/backend_pod.py — mesh-sharded dispatch entry
+  replica_tail    persist/follower.py — a replica's tail poll; an injected
+                  fault models a PARTITION (the replica silently stops
+                  tailing for `times` polls, its watermark freezes)
+  health_probe    replica/manager.py — the primary health probe; an
+                  injected fault is a false-negative probe (drives a
+                  spurious failover against a live primary)
 
 Cost when disabled: `fire()` reads one module global and returns — no
 lock, no allocation — so the instrumentation stays under the <1%
@@ -40,6 +46,8 @@ SEAMS = (
     "journal_fsync",
     "snapshot_io",
     "mesh_collective",
+    "replica_tail",
+    "health_probe",
 )
 
 #: fault-class name (as written in plans/config dicts) -> taxonomy class

@@ -507,7 +507,16 @@ def register_replica(registry: MetricsRegistry, manager) -> None:
     registry.gauge("replica.full_resyncs", manager.full_resyncs)
     registry.gauge("replica.partial_resyncs", manager.partial_resyncs)
     registry.gauge("replica.promotions", lambda: manager.promotions)
+    # Failover generation: which journal stream is live (0 = the original
+    # primary's, N = the Nth promotee's epoch journal) and how many
+    # demoted primaries the fleet still tracks for teardown.
+    registry.gauge("replica.epoch", lambda: manager._epoch)
+    registry.gauge("replica.retired_primaries",
+                   lambda: len(manager._retired))
     registry.gauge("replica.reads",
                    lambda: manager.router.replica_reads if manager.router else 0)
     registry.gauge("replica.primary_fallbacks",
                    lambda: manager.router.primary_fallbacks if manager.router else 0)
+    registry.gauge("replica.moved_retries",
+                   lambda: (manager.router.replica_moved_retries
+                            if manager.router else 0))

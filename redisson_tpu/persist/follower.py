@@ -306,7 +306,20 @@ class JournalFollower:
         self._fresh_at = time.monotonic()
 
     def _loop(self) -> None:
+        from redisson_tpu.fault import inject, taxonomy
+
         while not self._stop.is_set():
+            try:
+                # Partition seam: an injected fault here models a replica
+                # that silently stops tailing — the poll is skipped and
+                # `_fresh_at` does NOT advance, so the frozen watermark is
+                # visible to the router's staleness bound (lag grows; the
+                # replica drops out of the eligible set instead of serving
+                # stale reads).
+                inject.fire("replica_tail", target=getattr(self, "name", ""))
+            except taxonomy.Fault:
+                self._stop.wait(self._poll_s)
+                continue
             try:
                 records = self._next_records()
             except JournalGap:

@@ -50,12 +50,19 @@ def replica_engine_config(primary_config):
     while the subsystems a replica must not run are stripped — persist
     (a follower journaling the leader's ops would double-journal),
     replicas (no recursive fleets), faults (injection/watchdog belong to
-    the primary), cluster topology, and the redis durability tier."""
+    the primary), facade-level cluster topology, and the redis durability
+    tier. A shard member's cluster section (shard_id >= 0) is KEPT: its
+    replicas need the slot-ownership guard to replay migrate_* records."""
     cfg = copy.deepcopy(primary_config)
     cfg.persist = None
     cfg.replicas = None
     cfg.faults = None
-    cfg.cluster = None
+    if cfg.cluster is None or cfg.cluster.shard_id < 0:
+        cfg.cluster = None
+    # else: shard-member primary — the replica keeps the cluster section so
+    # it installs its own SlotOwnershipBackend and replays the journaled
+    # migrate_* ownership records; the slot table survives a promotion
+    # because the promotee rebuilds it from the same stream as the data.
     cfg.redis = None
     cfg.flush_interval_s = 0.0
     return cfg
@@ -83,6 +90,10 @@ class ReplicaManager:
         # The promoted follower (its client is the post-failover primary);
         # close() shuts it down, including the persistence we attached.
         self._promoted: Optional[ServingReplica] = None
+        # Previous promotees demoted by cascading failovers — dead engines
+        # whose teardown waits for close().
+        self._retired: List[ServingReplica] = []
+        self._base_dir = ""
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -94,6 +105,7 @@ class ReplicaManager:
                 "Config.replicas requires Config.persist with a dir — "
                 "replicas tail that journal as the replication stream")
         path = persist.cfg.dir
+        self._base_dir = path  # epoch dirs derive from the original root
         for _ in range(max(0, self.cfg.num_replicas)):
             self._spawn_replica(path)
         self.router = ReplicaRouter(client._dispatch, persist.journal,
@@ -140,12 +152,23 @@ class ReplicaManager:
             # teardown, which drains + closes the persistence we attached.
             self._promoted.close(shutdown_client=True)
             self._promoted = None
+        for rep in self._retired:
+            rep.close(shutdown_client=True)
+        self._retired = []
 
     # -- health probe / fault trigger ----------------------------------------
 
     def _probe_primary(self) -> bool:
+        from redisson_tpu.fault import inject
+
         executor = self._primary_executor
         try:
+            # False-negative seam: an injected fault IS a failed probe —
+            # chaos plans use it to drive a spurious failover against a
+            # live primary (the fence must keep that split-brain-free).
+            # Target = this fleet's base dir, so a plan can single out one
+            # shard's prober in a multi-fleet (cluster) topology.
+            inject.fire("health_probe", target=self._base_dir)
             return executor is not None and executor.is_alive()
         except Exception:
             # graftlint: allow-bare(a probe that cannot even ask counts as a failed probe, not a prober crash)
@@ -154,16 +177,23 @@ class ReplicaManager:
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.cfg.health_interval_s):
             if self._failed_over:
-                return
+                # Protection is disarmed between a promotion and the
+                # rejoin() that restores fleet capacity; the thread stays
+                # alive so a SECOND primary loss is survivable.
+                continue
             if self._probe_primary():
                 self._probe_failures = 0
                 continue
             self._probe_failures += 1
             if (self._probe_failures >= max(1, self.cfg.health_failures)
                     and self.cfg.auto_failover):
-                self.failover(
-                    f"health probe failed {self._probe_failures}x")
-                return
+                try:
+                    self.failover(
+                        f"health probe failed {self._probe_failures}x")
+                except Exception:
+                    # graftlint: allow-bare(an aborted promotion cleared the once-guard; the prober must survive to retry, not crash the protection thread)
+                    pass
+                self._probe_failures = 0
 
     def _on_primary_fault(self, kind, targets, exc) -> None:
         if not self.cfg.auto_failover or self._failed_over:
@@ -178,12 +208,22 @@ class ReplicaManager:
 
     # -- failover ------------------------------------------------------------
 
+    @property
+    def primary_client(self):
+        """The CURRENT primary's client: the latest promotee after a
+        failover, the original client before one. Everything that must
+        survive cascading failovers (the shard handle's guard/executor,
+        the next failover's fence target) resolves through here."""
+        return self._promoted.client if self._promoted is not None \
+            else self._client
+
     def failover(self, reason: str = "manual"):
         """Promote the highest-watermark replica to primary. Returns the
         promoted client, or None when a failover already happened (the
         trigger paths race; first one wins) or the fleet is empty (nothing
         to promote; the flag stays clear so a later trigger can retry once
-        replicas exist)."""
+        replicas exist). An aborted promotion clears the once-guard too —
+        a transient failure must not permanently disable protection."""
         with self._failover_lock:
             if self._failed_over:
                 return None
@@ -199,8 +239,11 @@ class ReplicaManager:
         # new writes until the promotee is installed, and compaction stops
         # so the drain below can reach the fenced tip. Only after the fence
         # is any watermark read — last_seq is final from here on.
+        # `primary_client` (not `self._client`): on a SECOND failover the
+        # stream to fence is the previous promotee's epoch journal.
         self.router.fence_writes()
-        old_persist = self._client._persist
+        old_primary = self.primary_client
+        old_persist = old_primary._persist
         old_journal = old_persist.journal if old_persist is not None else None
         if old_journal is not None:
             old_journal.fence()
@@ -227,7 +270,7 @@ class ReplicaManager:
 
             old_cfg = old_persist.cfg
             self._epoch += 1
-            new_dir = f"{old_cfg.dir.rstrip(os.sep)}-epoch-{self._epoch}"
+            new_dir = f"{self._base_dir.rstrip(os.sep)}-epoch-{self._epoch}"
             pm = PersistenceManager(
                 promoted,
                 dataclasses.replace(old_cfg, dir=new_dir, auto_recover=False),
@@ -247,9 +290,20 @@ class ReplicaManager:
         except BaseException:
             # Failed mid-promotion: release held writes — they land on the
             # old primary, whose fenced journal fails them cleanly rather
-            # than acking into an abandoned stream.
+            # than acking into an abandoned stream. The fleet and the
+            # once-guard roll back so a later trigger can retry (the
+            # attempted promotee stays in the fleet; a re-promotion drains
+            # from wherever its cursor stopped).
+            self.router.set_replicas(self.replicas)
             self.router.unfence_writes()
+            with self._failover_lock:
+                self._failed_over = False
             raise
+        if self._promoted is not None:
+            # Cascading failover: the previous promotee's client is now the
+            # demoted (dead) primary — close() tears it and its epoch
+            # persistence down with the rest of the fleet.
+            self._retired.append(self._promoted)
         self._promoted = best
         self.replicas = survivors
         self.promotions += 1
@@ -267,6 +321,13 @@ class ReplicaManager:
         journal = self.router.journal
         rep = self._spawn_replica(journal.path)
         self.router.set_replicas(self.replicas)
+        # Fleet capacity is restored: RE-ARM protection against the
+        # promoted primary — the prober thread is still running (it idles
+        # while _failed_over is set), so a second primary loss fails over
+        # again instead of being ignored.
+        self._probe_failures = 0
+        with self._failover_lock:
+            self._failed_over = False
         return rep
 
     # -- WAIT analogue -------------------------------------------------------
@@ -310,6 +371,8 @@ class ReplicaManager:
             "last_failover_reason": self.last_failover_reason,
             "last_failover_s": self.last_failover_s,
             "last_fence_seq": self.last_fence_seq,
+            "epoch": self._epoch,
+            "retired_primaries": len(self._retired),
             "full_resyncs": self.full_resyncs(),
             "partial_resyncs": self.partial_resyncs(),
             "router": self.router.snapshot() if self.router else {},

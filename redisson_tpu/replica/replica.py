@@ -29,6 +29,12 @@ class ServingReplica(JournalFollower):
         self.index = index
         self.name = f"replica-{index}"
         self.reads_served = 0
+        # Cluster-mode replica: a slot-ownership guard sits on its dispatch
+        # (replica_engine_config kept the shard's cluster section), so its
+        # reads can bounce with SlotMovedError while its ownership table
+        # catches up — the router's _moved_fallback handles those.
+        self.guarded = (config is not None and config.cluster is not None
+                        and config.cluster.shard_id >= 0)
 
     def execute_read(self, target: str, kind: str, payload, nkeys: int = 0,
                      **kw):

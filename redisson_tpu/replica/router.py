@@ -41,8 +41,10 @@ the RYW pin) is the redesign this engine's seq watermarks make possible.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from redisson_tpu.cluster.errors import SlotMovedError
 from redisson_tpu.commands import OP_TABLE
 from redisson_tpu.executor import BatchCollector, PARKED_KINDS
 
@@ -66,6 +68,10 @@ class ReplicaRouter:
         self.replica_reads = 0
         self.primary_fallbacks = 0
         self.primary_reads = 0
+        # Cluster mode: replica-served reads the replica's own slot guard
+        # rejected (its ownership table lags a flip/adopt by a few
+        # records) and the router re-served from the primary.
+        self.replica_moved_retries = 0
         # Serve-layer primaries push acks via enable_ack_tracking; a raw
         # executor primary gets per-future callbacks from the router.
         self._inline_acks = not hasattr(primary_dispatch, "enable_ack_tracking")
@@ -210,6 +216,16 @@ class ReplicaRouter:
             # fallback must be honored on the replica too.
             fut = rep.execute_read(target, kind, payload, nkeys,
                                    tenant=tenant, **kw)
+            if getattr(rep, "guarded", False):
+                # Cluster-mode replica: its slot-ownership guard lags the
+                # primary's by the replication delay, so a read for a slot
+                # adopted moments ago can bounce with MOVED even though
+                # this shard owns it. The primary's guard is authoritative
+                # — retry there; a genuine MOVED (slot really left the
+                # shard) surfaces identically from the primary for the
+                # ClusterRouter's redirect path.
+                fut = self._moved_fallback(fut, target, kind, payload,
+                                           nkeys, tenant, kw)
             return fut, rep, watermark
         if self._replicas:
             self.primary_fallbacks += 1
@@ -219,6 +235,43 @@ class ReplicaRouter:
                                           tenant=tenant, **kw)
         journal = self._journal
         return fut, None, (journal.last_seq if journal is not None else 0)
+
+    def _moved_fallback(self, fut, target: str, kind: str, payload: Any,
+                        nkeys: int, tenant: str, kw: Dict[str, Any]):
+        """Wrap a replica-served read so a SlotMovedError from the
+        REPLICA's guard re-serves from the primary instead of failing the
+        caller; every other outcome passes through untouched."""
+        outer: Future = Future()
+
+        def _chain(rf) -> None:
+            if rf.cancelled():
+                outer.cancel()
+                return
+            exc = rf.exception()
+            if exc is not None:
+                outer.set_exception(exc)
+            else:
+                outer.set_result(rf.result())
+
+        def _done(f) -> None:
+            if f.cancelled():
+                outer.cancel()
+                return
+            exc = f.exception()
+            if isinstance(exc, SlotMovedError):
+                self.replica_moved_retries += 1
+                try:
+                    retry = self._primary.execute_async(
+                        target, kind, payload, nkeys, tenant=tenant, **kw)
+                except Exception as retry_exc:
+                    outer.set_exception(retry_exc)
+                    return
+                retry.add_done_callback(_chain)
+                return
+            _chain(f)
+
+        fut.add_done_callback(_done)
+        return outer
 
     def _track_write_ack(self, fut, kind: str, tenant: str) -> None:
         desc = OP_TABLE.get(kind)
@@ -281,6 +334,7 @@ class ReplicaRouter:
             "replica_reads": self.replica_reads,
             "primary_fallbacks": self.primary_fallbacks,
             "primary_reads": self.primary_reads,
+            "replica_moved_retries": self.replica_moved_retries,
             "tenants_pinned": tenants_pinned,
             "watermarks": {r.name: r.applied_seq for r in replicas},
         }

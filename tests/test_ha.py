@@ -1,0 +1,472 @@
+"""Shard-level HA: per-shard replica fleets, failover under live
+migration, chaos seams, and the history checker.
+
+Layers:
+
+1. histcheck — the consistency checker itself flags synthetic violating
+   histories and passes clean ones (the checker is only as good as its
+   ability to fail).
+2. Failover robustness — retry after an aborted failover (the one-shot
+   guard must re-arm), cascading double failover through rejoin with the
+   prober re-armed after promotion.
+3. Fault seams — replica_tail partitions never violate bounded staleness
+   (the router falls back to the primary); a health_probe false-negative
+   drives a SPURIOUS failover against a live primary and the fence
+   guarantees every acked write lands in exactly one journal.
+4. Cluster composition — per-shard fleets surface in CLUSTER SLOTS /
+   INFO, the journaled slot table survives promotion, and an aborted
+   migration is retryable (nothing stranded in `migrating`).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.fault import inject
+from redisson_tpu.ops.crc16 import key_slot
+from tests.test_replica import make_replicated
+from tools import histcheck
+
+
+# ---------------------------------------------------------------------------
+# 1. the history checker itself
+# ---------------------------------------------------------------------------
+
+def test_histcheck_clean_history_passes():
+    rec = histcheck.HistoryRecorder()
+    rec.record_write("w", "k", "v1", acked_seq=1)
+    rec.record_write("w", "k", "v2", acked_seq=2)
+    rec.record_read("w", "k", "v2", watermark=2, primary_seq=2)
+    rec.record_read("r", "k", "v1", watermark=1, primary_seq=1)
+    v = histcheck.check(rec, final_state={"k": "v2"})
+    assert v.ok, v.issues
+    assert v.writes_checked == 2 and v.reads_checked == 2
+
+
+def test_histcheck_flags_lost_ack():
+    rec = histcheck.HistoryRecorder()
+    rec.record_write("w", "k", "v1", acked_seq=1)
+    v = histcheck.check(rec, final_state={"k": "v0"})
+    assert v.lost_acks == 1 and not v.ok
+    # ...but an unknown-fate write explains a newer final state
+    rec.record_write_unknown("w", "k", "v0")
+    assert histcheck.check(rec, final_state={"k": "v0"}).lost_acks == 0
+    # a missing key is a lost ack too
+    assert histcheck.check(rec, final_state={}).lost_acks == 1
+
+
+def test_histcheck_flags_staleness_violation():
+    rec = histcheck.HistoryRecorder()
+    rec.record_write("w", "k", "v1", acked_seq=1)
+    rec.record_write("w", "k", "v2", acked_seq=2)
+    # serving watermark says >= 2, yet the read returned the seq-1 value:
+    # the replica lied about its watermark (or served outside the bound).
+    rec.record_read("r", "k", "v1", watermark=2, primary_seq=5)
+    v = histcheck.check(rec)
+    assert v.staleness_violations == 1 and not v.ok
+
+
+def test_histcheck_flags_ryw_violation():
+    rec = histcheck.HistoryRecorder()
+    rec.record_write("t", "k", "v1", acked_seq=1)
+    rec.record_write("t", "k", "v2", acked_seq=2)
+    # tenant t was acked seq 2 before this read, but read the seq-1 value
+    # from a watermark-1 replica: legal staleness, illegal RYW.
+    rec.record_read("t", "k", "v1", watermark=1, primary_seq=2)
+    v = histcheck.check(rec)
+    assert v.ryw_violations == 1 and v.staleness_violations == 0
+
+
+def test_histcheck_flags_monotonic_violation():
+    rec = histcheck.HistoryRecorder()
+    rec.record_write("w", "k", "v1", acked_seq=1)
+    rec.record_write("w", "k", "v2", acked_seq=2)
+    # reader saw v2, then stepped back to v1: monotonic-reads violation
+    # (each read alone is within its staleness window).
+    rec.record_read("r", "k", "v2", watermark=0, primary_seq=2)
+    rec.record_read("r", "k", "v1", watermark=0, primary_seq=2)
+    v = histcheck.check(rec)
+    assert v.monotonic_violations == 1
+    assert v.ryw_violations == 0 and v.staleness_violations == 0
+
+
+def test_histcheck_absent_reads():
+    rec = histcheck.HistoryRecorder()
+    # reading a never-written key as absent is clean
+    rec.record_read("r", "nope", None, watermark=0, primary_seq=0)
+    assert histcheck.check(rec).ok
+    # reading absent AFTER the watermark passed the first write is stale
+    rec.record_write("w", "k", "v1", acked_seq=3)
+    rec.record_read("r", "k", None, watermark=3, primary_seq=3)
+    assert histcheck.check(rec).staleness_violations == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. failover robustness (S1 retry-after-abort, S2 cascading + re-arm)
+# ---------------------------------------------------------------------------
+
+def test_failover_retry_after_aborted_promotion(tmp_path):
+    c = make_replicated(tmp_path, n=2)
+    try:
+        c.get_bucket("b").set("v")
+        assert c.wait_for_replicas(2, timeout_s=10.0) == 2
+        mgr = c.replicas
+        # first promotion attempt blows up mid-flight on EVERY candidate
+        originals = [(r, r.promote) for r in mgr.replicas]
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected promote failure")
+
+        for r in mgr.replicas:
+            r.promote = boom
+        with pytest.raises(RuntimeError, match="injected promote"):
+            mgr.failover("first attempt, doomed")
+        # the abort re-armed the one-shot guard...
+        assert mgr._failed_over is False
+        assert mgr.promotions == 0
+        # ...but the old journal stays fenced (writes fail cleanly instead
+        # of acking into a stream a half-promoted fleet may abandon)
+        with pytest.raises(RuntimeError, match="fenced"):
+            c.get_bucket("b").set("lost-cause")
+        for r, orig in originals:
+            r.promote = orig
+        # the retry promotes cleanly and service resumes on the promotee
+        assert mgr.failover("retry") is not None
+        assert mgr.promotions == 1
+        c.get_bucket("b").set("post-retry")
+        assert c.get_bucket("b").get() == "post-retry"
+        assert c.get_bucket("b").get() != "lost-cause"
+    finally:
+        c.shutdown()
+
+
+def test_cascading_double_failover_with_prober_rearm(tmp_path):
+    # health prober ON: both failovers must fire from the prober thread,
+    # which proves the prober re-arms (and keeps running) after the first
+    # promotion instead of exiting with the one-shot guard latched.
+    c = make_replicated(tmp_path, n=2, health_interval_s=0.02,
+                        health_failures=2, auto_failover=True)
+    try:
+        mgr = c.replicas
+        for i in range(10):
+            c.get_bucket(f"k{i}").set(f"v{i}")
+        assert c.wait_for_replicas(2, timeout_s=10.0) == 2
+
+        def wait_promotions(n, timeout_s=15.0):
+            deadline = time.monotonic() + timeout_s
+            while mgr.promotions < n and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert mgr.promotions == n
+
+        c._executor.shutdown(wait=False)  # primary dies -> prober fires
+        wait_promotions(1)
+        first = mgr.primary_client
+        # demoted slot rejoins; wait for it to catch up off the promotee
+        mgr.rejoin()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            reps = mgr.replicas
+            if reps and all(r.lag() == 0 for r in reps):
+                break
+            time.sleep(0.01)
+        # the promotee dies too -> the RE-ARMED prober fires again
+        first._executor.shutdown(wait=False)
+        wait_promotions(2)
+        assert mgr.primary_client is not first
+        # every acked write survived two generations of failover
+        for i in range(10):
+            assert c.get_bucket(f"k{i}").get() == f"v{i}"
+        c.get_bucket("post").set("2nd-gen")
+        assert c.get_bucket("post").get() == "2nd-gen"
+        # second epoch dir derives from the BASE dir, not the first epoch
+        # (no -epoch-1-epoch-2 nesting)
+        path = mgr.primary_client._persist.journal.path
+        assert "epoch-1-epoch" not in path
+    finally:
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. fault seams: replica_tail partition + spurious health_probe failover
+# ---------------------------------------------------------------------------
+
+def test_replica_tail_partition_never_violates_staleness(tmp_path):
+    # Partition replica-0's tail loop for many polls: its watermark
+    # freezes while acked writes race ahead. Bounded staleness must hold
+    # by PRIMARY FALLBACK, verified with the history checker.
+    c = make_replicated(tmp_path, n=1, max_lag_seqs=4,
+                        read_your_writes=False)
+    inj = inject.FaultInjector(inject.FaultPlan(rules=[
+        inject.FaultRule(seam="replica_tail", fault="retryable",
+                         nth=1, times=10_000),
+    ], seed=7))
+    inject.install(inj)
+    try:
+        m = c.get_map("m")
+        m.put("k", "v0")
+        rec = histcheck.HistoryRecorder()
+        router = c._dispatch
+        before = router.primary_fallbacks
+        seq = c.persist.journal.last_seq
+        rec.record_write("w", "k", "v0", acked_seq=seq)
+        for i in range(30):
+            m.put("k", f"v{i + 1}")
+            seq = c.persist.journal.last_seq
+            rec.record_write("w", "k", f"v{i + 1}", acked_seq=seq)
+            fut, picked, wm = router.routed_read(
+                "m", "hget", {"field": b'"k"'})
+            raw = fut.result(timeout=30)
+            value = json.loads(raw) if raw is not None else None
+            rec.record_read("r", "k", value, watermark=wm,
+                            primary_seq=c.persist.journal.last_seq)
+        assert inj.injected > 0  # the partition actually fired
+        assert router.primary_fallbacks > before  # fallback carried reads
+        v = histcheck.check(rec, final_state={"k": "v30"})
+        assert v.ok, v.issues
+    finally:
+        inject.uninstall()
+        c.shutdown()
+
+
+def test_spurious_health_probe_failover_acks_exactly_once(tmp_path):
+    # A false-negative prober fails over a LIVE primary while unique
+    # writes are in flight. The fence makes split-brain impossible: every
+    # acked value must appear in exactly one journal (old primary's or
+    # the promotee's epoch journal), never both, never neither.
+    c = make_replicated(tmp_path, n=2, health_interval_s=0.02,
+                        health_failures=2, auto_failover=True)
+    inj = inject.FaultInjector(inject.FaultPlan(rules=[
+        # two consecutive false negatives = health_failures -> failover
+        inject.FaultRule(seam="health_probe", fault="retryable",
+                         nth=5, times=2),
+    ], seed=11))
+    old_journal_path = c.persist.journal.path
+    mgr = c.replicas
+    acked = {}      # value -> seq
+    unknown = []    # fate uncertain (fence race)
+    stop = threading.Event()
+
+    def writer():
+        n = 0
+        b = c.get_bucket("sb")
+        while not stop.is_set():
+            v = f"u{n}"
+            try:
+                b.set(v)
+                acked[v] = c.persist.journal.last_seq
+            except Exception:  # noqa: BLE001 — fence race: fate checked against journals below
+                unknown.append(v)
+            n += 1
+            time.sleep(0.001)
+
+    try:
+        c.get_bucket("sb").set("seed")
+        assert c.wait_for_replicas(2, timeout_s=10.0) == 2
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        inject.install(inj)
+        deadline = time.monotonic() + 15.0
+        while mgr.promotions < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mgr.promotions == 1  # the spurious failover happened
+        time.sleep(0.1)  # let post-failover writes flow
+        stop.set()
+        t.join(10)
+        assert acked  # writes acked on both sides of the fence
+        new_journal_path = mgr.primary_client._persist.journal.path
+        assert new_journal_path != old_journal_path
+        old_vals = [json.loads(v) for _, tgt, v in
+                    histcheck.journal_writes(old_journal_path,
+                                             kinds=("set",))
+                    if tgt == "sb"]
+        new_vals = [json.loads(v) for _, tgt, v in
+                    histcheck.journal_writes(new_journal_path,
+                                             kinds=("set",))
+                    if tgt == "sb"]
+        dupes = set(old_vals) & set(new_vals)
+        assert not dupes  # split-brain: a value acked by BOTH primaries
+        landed = set(old_vals) | set(new_vals)
+        missing = [v for v in acked if v not in landed]
+        assert not missing  # an acked write that no journal carries
+    finally:
+        inject.uninstall()
+        c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 4. cluster composition: fleets, slot-table survival, retryable abort
+# ---------------------------------------------------------------------------
+
+def _make_ha_cluster(tmp_path, num_shards=2, replicas_per_shard=1):
+    cfg = Config()
+    cfg.use_cluster(num_shards=num_shards, dir=str(tmp_path / "cl"),
+                    replicas_per_shard=replicas_per_shard)
+    rc = cfg.use_replicas(replicas_per_shard)  # per-shard tuning template
+    rc.health_interval_s = 0.0  # deterministic: failover driven manually
+    rc.poll_interval_s = 0.002
+    return RedissonTPU.create(cfg)
+
+
+def _wait_shard_caught_up(shard, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        reps = shard.replicas.replicas
+        if reps and all(r.lag() == 0 for r in reps):
+            return
+        time.sleep(0.005)
+    raise AssertionError("shard fleet never caught up")
+
+
+def test_cluster_replicas_surface_in_slots_and_info(tmp_path):
+    c = _make_ha_cluster(tmp_path, num_shards=2, replicas_per_shard=1)
+    try:
+        c.get_bucket("k").set("v")
+        ranges = c.cluster_slots()
+        assert all(len(r) == 4 for r in ranges)
+        entries = [e for _, _, _, reps in ranges for e in reps]
+        assert len(entries) == 2  # one fleet member per shard
+        for e in entries:
+            assert set(e) == {"id", "watermark", "lag"}
+            assert e["id"].startswith("shard-")
+        info = c.cluster_info()
+        # masters + fleet members, like redis counts replicas as nodes
+        assert info["cluster_known_nodes"] == 4
+        assert info["cluster_replicas"] == 2
+        assert info["failovers"] == 0
+    finally:
+        c.shutdown()
+
+
+def test_cluster_shard_failover_slot_table_survives(tmp_path):
+    c = _make_ha_cluster(tmp_path, num_shards=2, replicas_per_shard=1)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        keys = [f"k{i}" for i in range(400)
+                if table[key_slot(f"k{i}")] == 0][:15]
+        for k in keys:
+            c.get_bucket(k).set("v:" + k)
+        s0 = mgr.shards[0]
+        _wait_shard_caught_up(s0)
+        owned_before = s0.guard.owned_slots()
+        assert owned_before  # the shard owns its contiguous range
+        s0.client._executor.shutdown(wait=False)  # shard primary dies
+        assert s0.replicas.failover("test kill") is not None
+        # the journaled slot table replayed on the promotee: same guard
+        # decisions as the dead primary, with the data that backs them
+        assert s0.guard.owned_slots() == owned_before
+        for k in keys:
+            assert c.get_bucket(k).get() == "v:" + k
+        k0 = keys[0]
+        c.get_bucket(k0).set("post-failover")
+        assert c.get_bucket(k0).get() == "post-failover"
+        # introspection reflects the promotion
+        assert mgr.failovers() == 1
+        assert c.cluster_info()["failovers"] == 1
+        assert s0.stats()["failovers"] == 1
+    finally:
+        c.shutdown()
+
+
+def test_migration_abort_is_retryable(tmp_path, monkeypatch):
+    from redisson_tpu.cluster import migrator as migrator_mod
+
+    c = _make_ha_cluster(tmp_path, num_shards=2, replicas_per_shard=0)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        k = next(f"ab{i}" for i in range(400)
+                 if table[key_slot(f"ab{i}")] == 0)
+        slot = key_slot(k)
+        c.get_bucket(k).set("keep")
+        monkeypatch.setattr(
+            migrator_mod.SlotMigrator, "_bootstrap",
+            lambda self, p: (_ for _ in ()).throw(
+                migrator_mod.MigrationError("injected bootstrap failure")))
+        with pytest.raises(migrator_mod.MigrationError):
+            mgr.migrate_slots([slot], 1, timeout_s=30)
+        # the abort journaled a clean, RETRYABLE state: nothing stranded
+        # in `migrating`, ownership still with the source, data intact
+        assert not mgr.shards[0].guard.migrating_slots()
+        assert not mgr.shards[1].guard.migrating_slots()
+        assert mgr.router.slot_table()[slot] == 0
+        assert c.get_bucket(k).get() == "keep"
+        monkeypatch.undo()
+        # the retry completes the move
+        mgr.migrate_slots([slot], 1, timeout_s=60)
+        assert mgr.router.slot_table()[slot] == 1
+        assert c.get_bucket(k).get() == "keep"
+    finally:
+        c.shutdown()
+
+
+def test_failover_mid_migration_resumes_and_converges(tmp_path):
+    # The tentpole interplay: the migration source's primary dies while
+    # slots are mid-flight. The migrator re-subscribes to the promotee's
+    # continuing journal, finishes catch-up, and every acked write reads
+    # back — verified by digest.
+    c = _make_ha_cluster(tmp_path, num_shards=3, replicas_per_shard=1)
+    try:
+        mgr = c.cluster
+        table = mgr.router.slot_table()
+        keys = [f"mm{i}" for i in range(4000)
+                if table[key_slot(f"mm{i}")] == 0][:30]
+        for k in keys:
+            c.get_bucket(k).set("v0")
+        move_slots = sorted({key_slot(k) for k in keys})
+        s0 = mgr.shards[0]
+        _wait_shard_caught_up(s0)
+
+        acked, errs = {}, []
+        stop = threading.Event()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                k = keys[n % len(keys)]
+                v = f"w{n}"
+                try:
+                    c.get_bucket(k).set(v)
+                    acked[k] = v
+                except Exception:  # noqa: BLE001 — fence race: fate is unknown, digest below only checks acked
+                    errs.append((k, v))
+                n += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        result = {}
+
+        def migrate():
+            try:
+                result["stats"] = mgr.migrate_slots(move_slots, 2,
+                                                    timeout_s=60)
+            except Exception as e:  # noqa: BLE001 — surfaced via the assertion below
+                result["err"] = repr(e)
+
+        mt = threading.Thread(target=migrate, daemon=True)
+        mt.start()
+        deadline = time.monotonic() + 20
+        while (not s0.guard.migrating_slots()
+               and time.monotonic() < deadline):
+            time.sleep(0.001)
+        assert s0.guard.migrating_slots(), "migration never started"
+        s0.client._executor.shutdown(wait=False)
+        assert s0.replicas.failover("chaos: source kill") is not None
+        mt.join(70)
+        stop.set()
+        t.join(10)
+        assert "stats" in result, result.get("err")
+        # zero acked writes lost across kill + promotion + cutover
+        for k, v in acked.items():
+            assert c.get_bucket(k).get() == v
+        post = mgr.router.slot_table()
+        assert all(post[s] == 2 for s in move_slots)
+        assert not s0.guard.migrating_slots()
+        assert not mgr.shards[2].guard.migrating_slots()
+    finally:
+        c.shutdown()

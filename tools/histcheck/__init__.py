@@ -1,0 +1,265 @@
+"""History-based consistency checker for chaos runs.
+
+The chaos harness records an *invocation/ack history* while faults fire —
+every acked write (with its journal seq) and every read (with the value
+it returned plus the serving watermark and primary seq at serve time) —
+and `check()` verifies the four contracts the replica/cluster stack
+advertises, against the acked-write timeline:
+
+  * **zero lost acks** — with a single writer per key, the final engine
+    state for each key is the last acked write or a later write whose
+    fate was in-flight at the kill (acked-or-newer, never older);
+  * **bounded staleness** — each read returns some state the key held at
+    a seq inside `[serving watermark, primary seq]`;
+  * **read-your-writes** — a tenant's read reflects at least the highest
+    write that tenant had already been acked on that key;
+  * **monotonic reads** — per (tenant, key), successive reads never step
+    backwards in the timeline.
+
+The checker deliberately knows nothing about the engine: histories are
+(tenant, key, value, seq) tuples and the timeline is reconstructed from
+the acks themselves, so the same checker drives unit tests, the suite's
+`--ha-smoke` gate, and ad-hoc chaos scripts. `journal_writes()` bridges
+to the journal timeline for cross-checks (e.g. the split-brain probe:
+no acked value may appear in two primaries' journals).
+
+Verification strategy for reads: a read of value v is *explained* by
+write seq s when the key held v throughout `[s, next_write(s))` and that
+interval intersects the read's admissible window `[lo, hi]`, where
+`lo = max(serving watermark, tenant's RYW floor, monotonic floor)` and
+`hi` is the primary seq at serve time. Monotonic floors are assigned
+greedily (smallest explaining seq ≥ the previous read's), which never
+rejects a history a non-greedy assignment would accept.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from redisson_tpu.persist.journal import iter_records
+
+# Sentinel for "key absent" — distinct from any stored value.
+ABSENT = object()
+
+
+@dataclass
+class _Read:
+    tenant: str
+    key: str
+    value: Any
+    watermark: int
+    primary_seq: int
+    ryw_floor: int  # tenant's highest acked seq on this key at read time
+    order: int      # per-tenant recording order (monotonic-reads axis)
+
+
+@dataclass
+class Verdict:
+    lost_acks: int = 0
+    staleness_violations: int = 0
+    ryw_violations: int = 0
+    monotonic_violations: int = 0
+    reads_checked: int = 0
+    writes_checked: int = 0
+    issues: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.lost_acks or self.staleness_violations
+                    or self.ryw_violations or self.monotonic_violations)
+
+    def summary(self) -> str:
+        status = "OK" if self.ok else "VIOLATIONS"
+        return (f"histcheck {status}: {self.writes_checked} writes, "
+                f"{self.reads_checked} reads | lost_acks={self.lost_acks} "
+                f"staleness={self.staleness_violations} "
+                f"ryw={self.ryw_violations} "
+                f"monotonic={self.monotonic_violations}")
+
+
+class HistoryRecorder:
+    """Thread-safe invoke/ack history. Writers call `record_write` only
+    AFTER the engine acked (the returned seq is the journal seq the ack
+    carried); reads capture the router's serving watermark and the
+    primary seq observed when the read was issued. The RYW floor is
+    captured at record time, so recording order per tenant must match
+    that tenant's real-time order (one thread per tenant suffices)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> [(seq, tenant, value)] in ack order
+        self._writes: Dict[str, List[Tuple[int, str, Any]]] = {}
+        # writes whose fate is unknown (in-flight at a kill): key -> values
+        self._unknown: Dict[str, List[Tuple[int, Any]]] = {}
+        self._unknown_order = 0
+        self._reads: List[_Read] = []
+        # (tenant, key) -> highest acked seq
+        self._floors: Dict[Tuple[str, str], int] = {}
+        self._order: Dict[str, int] = {}
+
+    # -- recording ----------------------------------------------------------
+
+    def record_write(self, tenant: str, key: str, value: Any,
+                     acked_seq: int) -> None:
+        with self._lock:
+            self._writes.setdefault(key, []).append(
+                (int(acked_seq), tenant, value))
+            fk = (tenant, key)
+            if int(acked_seq) > self._floors.get(fk, 0):
+                self._floors[fk] = int(acked_seq)
+
+    def record_write_unknown(self, tenant: str, key: str, value: Any) -> None:
+        """A write that errored or was in flight when a fault hit: it MAY
+        have applied. Lost-ack checking accepts the final state matching
+        any unknown write issued after the key's last ack."""
+        with self._lock:
+            self._unknown_order += 1
+            self._unknown.setdefault(key, []).append(
+                (self._unknown_order, value))
+
+    def record_read(self, tenant: str, key: str, value: Any,
+                    watermark: int, primary_seq: int) -> None:
+        with self._lock:
+            order = self._order.get(tenant, 0)
+            self._order[tenant] = order + 1
+            self._reads.append(_Read(
+                tenant=tenant, key=key, value=value,
+                watermark=int(watermark), primary_seq=int(primary_seq),
+                ryw_floor=self._floors.get((tenant, key), 0),
+                order=order))
+
+    # -- introspection ------------------------------------------------------
+
+    def writes(self) -> Dict[str, List[Tuple[int, str, Any]]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._writes.items()}
+
+    def acked_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._writes.values())
+
+    def reads_count(self) -> int:
+        with self._lock:
+            return len(self._reads)
+
+
+def check(recorder: HistoryRecorder,
+          final_state: Optional[Dict[str, Any]] = None,
+          max_issues: int = 20) -> Verdict:
+    """Verify the recorded history; `final_state` (key -> value, missing
+    key = absent) additionally arms the zero-lost-acks check."""
+    with recorder._lock:
+        writes = {k: sorted(v) for k, v in recorder._writes.items()}
+        unknown = {k: list(v) for k, v in recorder._unknown.items()}
+        reads = sorted(recorder._reads, key=lambda r: (r.tenant, r.order))
+
+    verdict = Verdict()
+    verdict.writes_checked = sum(len(v) for v in writes.values())
+    verdict.reads_checked = len(reads)
+
+    def note(msg: str) -> None:
+        if len(verdict.issues) < max_issues:
+            verdict.issues.append(msg)
+
+    # key -> ([seq...], [value...]) with a virtual absent-state at seq 0.
+    timelines: Dict[str, Tuple[List[int], List[Any]]] = {}
+    for key, recs in writes.items():
+        seqs = [0] + [s for s, _, _ in recs]
+        vals: List[Any] = [ABSENT] + [v for _, _, v in recs]
+        timelines[key] = (seqs, vals)
+
+    # -- zero lost acks -----------------------------------------------------
+    if final_state is not None:
+        for key, recs in writes.items():
+            last_seq, _, last_val = recs[-1]
+            final = final_state.get(key, ABSENT)
+            if final == last_val:
+                continue
+            # acked-or-newer: an unknown-fate write may have landed after
+            # the last ack (single writer per key => any unknown value is
+            # at least as new as the last ack recorded before the kill).
+            if any(final == v for _, v in unknown.get(key, [])):
+                continue
+            verdict.lost_acks += 1
+            note(f"lost ack: key={key!r} last acked seq={last_seq} "
+                 f"value={last_val!r} but final state is {final!r}")
+
+    # -- reads: staleness, RYW, monotonic -----------------------------------
+    # monotonic floor per (tenant, key): smallest explaining seq chosen so
+    # far; greedy-min keeps later reads maximally explainable.
+    mono_floor: Dict[Tuple[str, str], int] = {}
+    for r in reads:
+        seqs, vals = timelines.get(r.key, ([0], [ABSENT]))
+        hi = r.primary_seq
+
+        def explaining(lo: int) -> Optional[int]:
+            # Smallest write seq s with vals[s]==value whose hold interval
+            # [s, next) intersects [lo, hi]. Scan candidates in order; the
+            # first s with next_seq > lo wins (s <= hi bounds the scan).
+            want = ABSENT if r.value is None else r.value
+            for i, s in enumerate(seqs):
+                if s > hi:
+                    break
+                nxt = seqs[i + 1] if i + 1 < len(seqs) else float("inf")
+                if nxt > lo and _values_match(vals[i], want):
+                    return s
+            return None
+
+        lo_staleness = max(r.watermark, 0)
+        lo_ryw = max(lo_staleness, r.ryw_floor)
+        mk = (r.tenant, r.key)
+        lo_mono = max(lo_ryw, mono_floor.get(mk, 0))
+
+        s = explaining(lo_mono)
+        if s is not None:
+            mono_floor[mk] = max(mono_floor.get(mk, 0), s)
+            continue
+        # Attribute the failure to the tightest contract that breaks it.
+        if explaining(lo_ryw) is not None:
+            verdict.monotonic_violations += 1
+            note(f"monotonic violation: tenant={r.tenant!r} key={r.key!r} "
+                 f"read {r.value!r} steps behind floor {mono_floor.get(mk)}")
+        elif explaining(lo_staleness) is not None:
+            verdict.ryw_violations += 1
+            note(f"RYW violation: tenant={r.tenant!r} key={r.key!r} read "
+                 f"{r.value!r} older than acked floor {r.ryw_floor}")
+        else:
+            verdict.staleness_violations += 1
+            note(f"staleness violation: key={r.key!r} read {r.value!r} not "
+                 f"a state in [{lo_staleness}, {hi}] (tenant={r.tenant!r})")
+        # Do not advance the monotonic floor on an unexplained read.
+    return verdict
+
+
+def _values_match(a: Any, b: Any) -> bool:
+    if a is ABSENT or b is ABSENT:
+        return a is b
+    return a == b
+
+
+def journal_writes(path: str, kinds: Iterable[str] = ("set",),
+                   from_seq: int = 0) -> List[Tuple[int, str, Any]]:
+    """Flatten a journal into (seq, key, raw payload value) for the write
+    kinds of interest — the journal-timeline side of verification (e.g.
+    exactly-once ack checks across an old primary's journal and its
+    promotee's epoch journal)."""
+    wanted = frozenset(kinds)
+    out: List[Tuple[int, str, Any]] = []
+    for rec in iter_records(path, from_seq=from_seq):
+        if rec.kind in wanted:
+            payload = rec.payload
+            value = payload.get("value") if isinstance(payload, dict) \
+                else payload
+            out.append((rec.seq, rec.target, value))
+    return out
+
+
+def seq_floor(timeline: List[Tuple[int, Any]], seq: int) -> Any:
+    """State of a key at `seq` given its [(write_seq, value)] timeline —
+    the value of the last write at or before `seq` (ABSENT before any)."""
+    seqs = [s for s, _ in timeline]
+    i = bisect.bisect_right(seqs, seq)
+    return timeline[i - 1][1] if i else ABSENT
