@@ -684,6 +684,96 @@ def bench_journal_overhead(rounds=200, reps=3):
     return pct
 
 
+def bench_lock_witness(rounds=200, reps=3):
+    """Lock-order witness tax (PR 15): the batched-insert path with a
+    journal attached — the workload that hammers the hottest witnessed
+    locks (executor._lock, _InflightRun.lock, journal._io: ~1.9k
+    acquisitions per 200-round pass) — on a client whose locks were built
+    under
+    REDISSON_TPU_LOCK_WITNESS=1 vs the same client with plain primitives.
+    The witness is opt-in diagnostics; its budget is < 3% so it stays
+    usable under load. Zero-cost when disabled: make_lock returns a plain
+    threading.Lock, so the 'off' side IS the production configuration.
+    Both clients live side by side and single passes alternate plain/
+    witnessed (best-of-reps each), so scheduler and fsync-thread drift
+    hits both sides instead of biasing whichever ran second."""
+    import os
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.concurrency import witness_reset
+    from redisson_tpu.config import Config
+
+    batch = 64
+    ints = np.random.default_rng(23).integers(
+        0, 2**63, size=(rounds, batch), dtype=np.uint64)
+
+    def one_pass(client, tag):
+        h = client.get_hyper_log_log(f"bench:wit:{tag}")
+        m = client.get_map(f"bench:witm:{tag}")
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            pend.append(h.add_ints_async(ints[i]))
+            pend.append(m.put_async(f"f{i}", i))
+            if len(pend) >= 8:
+                for f in pend:
+                    f.result(timeout=60)
+                pend.clear()
+        for f in pend:
+            f.result(timeout=60)
+        return time.perf_counter() - t0
+
+    def make_client(witness: bool, root: str):
+        old = os.environ.get("REDISSON_TPU_LOCK_WITNESS")
+        if witness:
+            os.environ["REDISSON_TPU_LOCK_WITNESS"] = "1"
+        else:
+            os.environ.pop("REDISSON_TPU_LOCK_WITNESS", None)
+        try:
+            cfg = Config()
+            # "off": journal appends still take Journal._io on the hot
+            # path, but no everysec fsync tick randomly lands inside a
+            # ~300ms timed pass (that tick is pure variance here; the
+            # journal tax itself is bench_journal_overhead's number).
+            cfg.use_persist(root).fsync = "off"
+            return RedissonTPU.create(cfg)
+        finally:
+            if old is None:
+                os.environ.pop("REDISSON_TPU_LOCK_WITNESS", None)
+            else:
+                os.environ["REDISSON_TPU_LOCK_WITNESS"] = old
+
+    root_a = tempfile.mkdtemp(prefix="rtpu-bench-wit-a-")
+    root_b = tempfile.mkdtemp(prefix="rtpu-bench-wit-b-")
+    base = wit = float("inf")
+    try:
+        plain_client = make_client(False, root_a)
+        try:
+            wit_client = make_client(True, root_b)
+            try:
+                one_pass(plain_client, "p")  # warm compile/caches
+                one_pass(wit_client, "w")
+                for _ in range(max(2, reps)):
+                    base = min(base, one_pass(plain_client, "p"))
+                    wit = min(wit, one_pass(wit_client, "w"))
+            finally:
+                wit_client.shutdown()
+        finally:
+            plain_client.shutdown()
+    finally:
+        witness_reset()
+        shutil.rmtree(root_a, ignore_errors=True)
+        shutil.rmtree(root_b, ignore_errors=True)
+
+    pct = 100.0 * (wit / base - 1.0)
+    print(f"# lock_witness_overhead: {base * 1e3:.1f} ms plain -> "
+          f"{wit * 1e3:.1f} ms witnessed ({pct:+.1f}%; budget < 3%)",
+          file=sys.stderr)
+    return pct
+
+
 def bench_fault(rounds=200, reps=3):
     """Fault-subsystem numbers (PR 8): fault_overhead_pct — the batched-
     insert workload with taxonomy + injection seams + watchdog + rebuild
@@ -1122,6 +1212,11 @@ def main():
             50 if quick else 200, reps=2 if quick else 3), 1)
     except Exception as exc:  # noqa: BLE001
         print(f"# journal overhead bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["lock_witness_overhead_pct"] = round(bench_lock_witness(
+            50 if quick else 200, reps=2 if quick else 3), 1)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# lock witness bench failed: {exc!r}", file=sys.stderr)
     try:
         pct, rebuild_s = bench_fault(
             50 if quick else 200, reps=2 if quick else 3)

@@ -2351,6 +2351,105 @@ def ha_smoke():
     return ok
 
 
+def race_smoke():
+    """Runtime lock-order witness over the most thread-heavy suites.
+
+    Re-runs test_ha.py / test_replica.py / test_pipeline.py in
+    subprocesses with REDISSON_TPU_LOCK_WITNESS=1 and an atexit JSON dump
+    per process, merges the witnessed order graphs, and gates on:
+
+      * every subprocess suite still passes under the witness, and
+      * the MERGED witnessed lock-order graph is acyclic (no two threads
+        were ever seen taking witnessed locks in opposite orders).
+
+    Also reports per-site hold-time p99 (the witness's sampled hold
+    durations) and cross-checks the witnessed edges against graftlint's
+    static Tier C lock-order graph — informational: the static graph is
+    an over-approximation built from nested `with` blocks, the witness
+    only sees orders that actually executed."""
+    import subprocess
+    import tempfile
+
+    from redisson_tpu.concurrency import find_cycle, merge_snapshots
+
+    suites = ["tests/test_ha.py", "tests/test_replica.py",
+              "tests/test_pipeline.py"]
+    snaps = []
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="rtpu-race-") as td:
+        for suite in suites:
+            out = os.path.join(td, os.path.basename(suite) + ".witness.json")
+            env = {**os.environ,
+                   "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+                   "REDISSON_TPU_LOCK_WITNESS": "1",
+                   "REDISSON_TPU_LOCK_WITNESS_OUT": out}
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, "-m", "pytest", suite, "-q",
+                 "-m", "not slow", "-p", "no:cacheprovider"],
+                cwd=REPO, env=env, capture_output=True, text=True)
+            wall = time.perf_counter() - t0
+            if proc.returncode != 0:
+                print(f"# race-smoke: {suite} FAILED under the witness:\n"
+                      + proc.stdout[-2000:] + proc.stderr[-2000:],
+                      file=sys.stderr)
+                ok = False
+            if os.path.exists(out):
+                with open(out) as fh:
+                    snaps.append(json.load(fh))
+            else:
+                print(f"# race-smoke: {suite} left no witness dump",
+                      file=sys.stderr)
+                ok = False
+            print(f"# race-smoke: {suite} done in {wall:.1f}s "
+                  f"({'pass' if proc.returncode == 0 else 'FAIL'})",
+                  file=sys.stderr)
+    merged = merge_snapshots(snaps)
+    edges = [(e["from"], e["to"]) for e in merged["edges"]]
+    cyc = find_cycle(edges)
+    if cyc is not None:
+        print("# race-smoke: WITNESSED LOCK-ORDER CYCLE: "
+              + " -> ".join(cyc), file=sys.stderr)
+        ok = False
+    # hold-time p99 per witnessed site, worst first
+    sites = sorted(merged["sites"].items(),
+                   key=lambda kv: -kv[1].get("p99_s", 0.0))
+    for site, st in sites:
+        print(f"#   hold {site}: holds={st['holds']} "
+              f"p99={st.get('p99_s', 0.0) * 1e3:.3f}ms "
+              f"max={st['max_s'] * 1e3:.3f}ms", file=sys.stderr)
+    # informational cross-check vs the static Tier C graph
+    try:
+        from tools.graftlint.concurrency import analyze_paths
+
+        _f, _l, static_graph = analyze_paths(
+            [os.path.join(REPO, "redisson_tpu")], repo_root=REPO)
+        static_edges = {(e["from"], e["to"])
+                        for e in static_graph["edges"]}
+        witnessed_only = sorted(set(edges) - static_edges)
+        if witnessed_only:
+            print(f"# race-smoke: {len(witnessed_only)} witnessed edge(s) "
+                  f"the static graph missed (cross-object / callback "
+                  f"orders):", file=sys.stderr)
+            for a, b in witnessed_only:
+                print(f"#   {a} -> {b}", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001 — cross-check is informational
+        print(f"# race-smoke: static cross-check skipped: {exc!r}",
+              file=sys.stderr)
+    result = {
+        "suites": suites,
+        "witnessed_edges": len(edges),
+        "witnessed_threads": len(merged.get("threads", [])),
+        "cycle": cyc,
+        "sites": {k: v for k, v in sites[:10]},
+    }
+    print(json.dumps({"race_smoke": result}), flush=True)
+    print(f"# race-smoke: {'PASS' if ok else 'FAIL'} — "
+          f"{len(edges)} witnessed edge(s), "
+          f"{'acyclic' if cyc is None else 'CYCLIC'}", file=sys.stderr)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -2423,6 +2522,14 @@ def main():
                          "spurious health_probe failover where every "
                          "acked write lands in exactly one journal, then "
                          "exit")
+    ap.add_argument("--race-smoke", action="store_true",
+                    help="runtime lock-order witness: re-run the HA / "
+                         "replica / pipeline suites under "
+                         "REDISSON_TPU_LOCK_WITNESS=1, merge the per-"
+                         "process witnessed order graphs, gate on "
+                         "acyclicity, report per-site hold-time p99, and "
+                         "cross-check against the static Tier C graph, "
+                         "then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -2444,6 +2551,9 @@ def main():
 
     if args.persist_smoke:
         sys.exit(0 if persist_smoke() else 1)
+
+    if args.race_smoke:
+        sys.exit(0 if race_smoke() else 1)
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)

@@ -22,6 +22,17 @@ import numpy as np
 
 from redisson_tpu import engine
 from redisson_tpu.executor import Op
+
+# graftlint Tier C guarded-by audit: the backend runs entirely on the
+# executor's dispatcher thread — run(), the allocator grow hook, and the
+# tape-encode callbacks are all invoked from inside backend.run.
+GUARDED_BY = {
+    "TpuBackend.bank":
+        "thread:dispatcher-confined — every writer (_ensure_bank, "
+        "_grow_bank via RowAllocator, _hll_row via tape encode) runs "
+        "inside backend.run on the dispatcher; checkpoint load replaces "
+        "it only through an executor barrier",
+}
 from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.fault.taxonomy import classify
 from redisson_tpu.ingest import delta as delta_mod

@@ -35,6 +35,7 @@ from redisson_tpu.cluster.shard import ClusterShard
 from redisson_tpu.cluster.split import MAX_SLOT, contiguous_assignment
 from redisson_tpu.ops.crc16 import key_slot
 from redisson_tpu.parallel.topology import TopologyManager
+from redisson_tpu.concurrency import make_lock
 
 
 class ClusterManager:
@@ -50,7 +51,7 @@ class ClusterManager:
                 "tier shards the namespace over full engine stacks, pod "
                 "shards one engine over the mesh")
         self.config = config
-        self._lock = threading.Lock()
+        self._lock = make_lock("manager.ClusterManager._lock")
         self.migrations = 0
         self.migration_stats: Dict[str, int] = {}
         self._next_shard_id = 0
@@ -239,7 +240,10 @@ class ClusterManager:
                 self.migrations += 1
                 for k, v in stats.items():
                     total[k] = total.get(k, 0) + v
-        self.migration_stats = total
+            # Published under the migration lock: an auto-heal drain on the
+            # topology-watcher thread must not interleave its publish with
+            # an operator-driven reshard's.
+            self.migration_stats = total
         return total
 
     def drain_shard(self, shard_id: int) -> int:

@@ -46,6 +46,7 @@ from redisson_tpu.ops.crc16 import key_slot
 from redisson_tpu.persist.follower import slots_record_filter
 from redisson_tpu.persist.journal import JournalRecord
 from redisson_tpu.persist.snapshotter import STRUCTURES_FILE
+from redisson_tpu.concurrency import make_lock
 
 # Records that are keyspace-wide or control-plane: never slot-filtered onto
 # the target (the router fans flushall/script ops to every shard directly,
@@ -73,7 +74,7 @@ class SlotMigrator:
         self._cutover_lag = cutover_lag
         self._timeout_s = timeout_s
         self._queue: List[JournalRecord] = []
-        self._qlock = threading.Lock()
+        self._qlock = make_lock("migrator.SlotMigrator._qlock")
         # The source journal object we are subscribed to; a per-shard
         # failover swaps the live journal (promotee epoch dir, same global
         # seq numbering) and _sync_source_journal re-subscribes.

@@ -38,6 +38,7 @@ import numpy as np
 from redisson_tpu.cluster.errors import (ClusterCrossSlotError, SlotAskError,
                                          SlotMovedError)
 from redisson_tpu.cluster.split import slot_ranges, split_by_owner
+from redisson_tpu.concurrency import make_lock
 from redisson_tpu.ops.crc16 import MAX_SLOT, key_slot
 
 # Multi-key ops whose co-keys ride in the payload: must co-locate with the
@@ -98,7 +99,7 @@ class ClusterRouter:
             if sid not in self._shards:
                 raise ValueError(f"slot table references unknown shard {sid}")
         self._table = list(table)
-        self._lock = threading.Lock()
+        self._lock = make_lock("router.ClusterRouter._lock")
         # (frozenset(slots), Event) while a cutover is in flight — the ASK
         # window. New submissions for those slots wait on the event; the
         # migrator sets it right after the table flip.
@@ -268,11 +269,15 @@ class ClusterRouter:
             if (isinstance(exc, SlotMovedError) and not self._closed
                     and pending.attempts < self._retry_depth):
                 pending.attempts += 1
-                self.redirects += 1
+                # Completer-thread callback racing caller threads: the
+                # redirect counters share the router lock.
+                with self._lock:
+                    self.redirects += 1
                 self._retryq.put(pending)
                 return
             if isinstance(exc, SlotMovedError):
-                self.retries_exhausted += 1
+                with self._lock:
+                    self.retries_exhausted += 1
             _copy_result(fut, pending.outer)
 
         return done
@@ -396,6 +401,7 @@ class ClusterRouter:
                         state["exc"] = exc
                     elif exc is None:
                         # graftlint: allow-g006(done-callback: f is resolved)
+                        # graftlint: allow-hold(done-callback: f is already resolved, result() returns immediately — the lock only orders the slot write against its siblings)
                         results[i] = f.result()
                     state["pending"] -= 1
                     last = state["pending"] == 0
@@ -435,7 +441,10 @@ class ClusterRouter:
                 return shard.dispatch.execute_sync(target, kind, payload,
                                                    nkeys)
             except SlotMovedError as exc:
-                self.redirects += 1
+                # Caller-thread retry path racing the redirect worker's
+                # counter bumps: share the router lock.
+                with self._lock:
+                    self.redirects += 1
                 last = exc
         raise last  # type: ignore[misc]
 

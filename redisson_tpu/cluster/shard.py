@@ -27,6 +27,7 @@ from typing import Iterable, List, Optional, Set
 
 from redisson_tpu.cluster.errors import SlotMovedError
 from redisson_tpu.ops.crc16 import key_slot
+from redisson_tpu.concurrency import make_lock
 
 CLUSTER_KINDS = frozenset({
     "migrate_begin", "migrate_flip", "migrate_adopt", "migrate_install",
@@ -50,7 +51,7 @@ class SlotOwnershipBackend:
         self._migrating: Set[int] = set()
         # Mutations happen only on the dispatcher thread (the single
         # backend.run caller); the lock covers cross-thread introspection.
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard.SlotOwnershipBackend._lock")
         self.rejected_ops = 0
 
     # -- delegation ---------------------------------------------------------

@@ -57,9 +57,34 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from redisson_tpu.concurrency import make_condition, make_lock
 from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.fault.taxonomy import StateUncertainFault, classify
 from redisson_tpu.serve.errors import DeadlineExceeded
+
+# graftlint Tier C guarded-by audit (tools/graftlint/concurrency.py):
+# which shared attribute is protected by which lock. `token.*` entries use
+# name-based provenance — any `token.<attr>` access must hold
+# `with token.lock:` (the per-run completion token is touched by every
+# completer thread racing its siblings and the dispatcher).
+GUARDED_BY = {
+    "CommandExecutor._queues": "_lock",
+    "CommandExecutor._ready": "_lock",
+    "CommandExecutor._inflight": "_lock",
+    "CommandExecutor._inflight_targets": "_lock",
+    "CommandExecutor._inflight_kinds": "_lock",
+    "CommandExecutor._staging_bytes": "_lock",
+    "CommandExecutor._runs_completed": "_lock",
+    "CommandExecutor._runs_overlapped": "_lock",
+    "CommandExecutor._shutdown": "_lock",
+    "CommandExecutor._journal": "_lock:writes",
+    "CommandExecutor._trace": "_lock:writes",
+    "CommandExecutor._window_seq":
+        "thread:dispatcher-confined — bumped and read only in _dispatch_one",
+    "token.pending": "lock",
+    "token.op_failed": "lock:writes",
+    "token.fault_exc": "lock:writes",
+}
 
 # Op kinds that may coalesce with the previous op of the same kind+target.
 COALESCABLE = {"hll_add", "bloom_add", "bitset_set", "bitset_clear", "bitset_get", "bloom_contains"}
@@ -176,7 +201,7 @@ class _InflightRun:
         self.overlapped = False
         self.depth = 1
         self.gates_held = True
-        self.lock = threading.Lock()
+        self.lock = make_lock("executor._InflightRun.lock")
         self.ops: Sequence[Op] = ()  # live ops (watchdog trip / diagnostics)
         self.fault_exc = None  # first StateUncertainFault among the ops
         self.run_span = None  # parent trace span for this pipeline window
@@ -242,8 +267,9 @@ class CommandExecutor:
         self._staging_bytes = 0  # in-flight payload bytes (memstat meter)
         self._runs_completed = 0
         self._runs_overlapped = 0
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("executor.CommandExecutor._lock")
+        self._cv = make_condition("executor.CommandExecutor._lock",
+                                  self._lock)
         self._queues: Dict[str, deque] = {}
         self._ready: deque = deque()  # round-robin of object names with work
         self._shutdown = False
@@ -577,6 +603,7 @@ class CommandExecutor:
                 self._staging_bytes += staged
         t0 = token.t0 = self._clock()
         token.queue_delay_s = t0 - min(op.enqueued_at for op in live)
+        # graftlint: allow-guarded(pre-publish init: done-callbacks that contend on token.lock are not armed yet)
         token.pending = len(live)
         # Sampled spans riding this run (usually empty). The run span links
         # them to the pipeline window they shared.
@@ -608,6 +635,7 @@ class CommandExecutor:
             # letting the "always" policy group-commit one fsync across
             # the pipeline window instead of paying one per run.
             try:
+                # graftlint: allow-guarded(advisory group-commit hint: a stale _ready read only costs one extra fsync)
                 journal.append_run(kind, live, defer=bool(self._ready))
                 if spans:
                     t_j = self._clock()
@@ -715,20 +743,26 @@ class CommandExecutor:
                     if seam is not None:
                         span.annotations.setdefault("seam", seam)
             span.finish(error=err)
-        if fut is not None and not fut.cancelled() and \
-                fut.exception() is not None:
-            # A backend that isolates failures per op/group (the delta
-            # window) completes futures with exceptions instead of raising
-            # out of run() — the error metric must still see the run.
-            token.op_failed = True
+        exc = None
+        if fut is not None and not fut.cancelled():
             exc = fut.exception()
-            if token.fault_exc is None and isinstance(exc, StateUncertainFault):
-                # State-uncertain retirement (device loss, watchdog trip,
-                # post-dispatch transfer death): remember the first such
-                # fault so _run_completed can hand the run's targets to
-                # the rebuild listener.
-                token.fault_exc = exc
         with token.lock:
+            if exc is not None:
+                # A backend that isolates failures per op/group (the delta
+                # window) completes futures with exceptions instead of
+                # raising out of run() — the error metric must still see
+                # the run. Written under token.lock: callbacks for one run
+                # race each other across completer threads, and the
+                # release that drops `pending` to 0 is what publishes
+                # these to _run_completed's thread.
+                token.op_failed = True
+                if token.fault_exc is None and \
+                        isinstance(exc, StateUncertainFault):
+                    # State-uncertain retirement (device loss, watchdog
+                    # trip, post-dispatch transfer death): remember the
+                    # first such fault so _run_completed can hand the
+                    # run's targets to the rebuild listener.
+                    token.fault_exc = exc
             token.pending -= 1
             if token.pending > 0:
                 return
@@ -889,6 +923,7 @@ class CommandExecutor:
         (A dispatcher that died to an unhandled error — or a primary whose
         process-level kill was simulated by shutdown — reads False and
         trips the ReplicaManager's consecutive-failure counter.)"""
+        # graftlint: allow-guarded(liveness probe: a racy _shutdown read flips one probe round late, the failure counter absorbs it)
         return not self._shutdown and self._thread.is_alive()
 
     def shutdown(self, wait: bool = True, timeout: float = 30.0):

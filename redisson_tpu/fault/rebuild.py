@@ -44,6 +44,7 @@ import time
 from collections import deque
 from typing import Dict, Iterable, Optional
 
+from redisson_tpu.concurrency import make_lock
 from redisson_tpu.fault import taxonomy
 from redisson_tpu.fault.taxonomy import (
     TargetDegradedError,
@@ -78,11 +79,11 @@ class RebuildCoordinator:
     def __init__(self, client, breakers=None):
         self._client = client
         self._breakers = breakers  # serve BreakerBoard or None
-        self._lock = threading.Lock()
+        self._lock = make_lock("rebuild.RebuildCoordinator._lock")
         # One rebuild at a time: concurrent rebuilds (two faults landing on
         # different targets) would race each other's snapshot restore and
         # post-rebuild snapshot cut. Rebuilds are rare; serialize them.
-        self._serial = threading.Lock()
+        self._serial = make_lock("rebuild.RebuildCoordinator._serial")
         self._quarantined: set = set()
         self._degraded: set = set()
         self._tls = threading.local()  # .bypass on the rebuild thread
@@ -151,6 +152,7 @@ class RebuildCoordinator:
         t0 = time.monotonic()
         try:
             with self._serial:
+                # graftlint: allow-hold(rebuild serialization IS the point of _serial: one barrier-driven rebuild at a time; nothing else ever takes _serial, so the held blocking cannot deadlock)
                 self._rebuild(targets)
         except Exception as exc:
             # graftlint: allow-bare(rebuild is the recovery path itself — on any failure the targets degrade instead of re-raising into a daemon thread)

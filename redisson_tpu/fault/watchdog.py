@@ -34,6 +34,19 @@ from typing import Callable, Optional
 from redisson_tpu.fault import taxonomy
 from redisson_tpu.fault.taxonomy import StateUncertainFault
 
+# graftlint Tier C guarded-by audit: the scan state lives on the watchdog
+# thread. check_once() doubles as a deterministic test hook, but tests
+# construct the watchdog with a 0 interval (no thread) or call it while
+# the loop sleeps — production traffic never enters it off-thread.
+GUARDED_BY = {
+    "RunWatchdog._tripped_ids":
+        "thread:watchdog-loop confined; check_once() as a test hook runs "
+        "without a live loop thread",
+    "RunWatchdog.trips":
+        "thread:watchdog-loop confined monotonic counter; stats readers "
+        "tolerate a scan-stale value",
+}
+
 
 class RunWatchdog:
     """Polls the executor's in-flight window and trips stuck runs."""

@@ -21,6 +21,15 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+# graftlint Tier C guarded-by audit: scan_once() is the probe thread's
+# body and a deterministic test hook; the two never overlap (tests drive
+# it only on unstarted managers).
+GUARDED_BY = {
+    "TopologyManager.scans":
+        "thread:probe-loop confined monotonic counter; scan_once() as a "
+        "test hook runs on unstarted managers",
+}
+
 
 @dataclass
 class NodeState:

@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional
 from zlib import crc32
 
 from redisson_tpu import checkpoint
+from redisson_tpu.concurrency import make_lock
 from redisson_tpu.persist.journal import (
     _FRAME,
     _HEADER,
@@ -40,6 +41,36 @@ from redisson_tpu.persist.journal import (
     _list_segments,
 )
 from redisson_tpu.persist.snapshotter import STRUCTURES_FILE, find_snapshots
+
+# graftlint Tier C guarded-by audit. The tail-state attrs are confined to
+# the tail loop by a join handoff: promote()/retarget()/close() call
+# _stop.set() + _thread.join() BEFORE touching them, so the loop thread
+# is provably dead at every off-thread mutation — declared thread:, not
+# locked. Only the apply cursor crosses threads live (lag/applied_seq
+# readers), and it takes _applied_lock.
+GUARDED_BY = {
+    "JournalFollower._applied": "_applied_lock:writes",
+    "JournalFollower._records_applied": "_applied_lock:writes",
+    "JournalFollower._tail":
+        "thread:tail-loop confined; off-thread writes happen only after "
+        "_stop.set() + join() proves the loop dead",
+    "JournalFollower._bootstraps":
+        "thread:tail-loop confined via the join handoff; stats() reads are "
+        "monotonic-counter peeks",
+    "JournalFollower._full_resyncs":
+        "thread:tail-loop confined via the join handoff",
+    "JournalFollower._partial_resyncs":
+        "thread:tail-loop confined via the join handoff",
+    "JournalFollower._apply_errors":
+        "thread:tail-loop confined via the join handoff",
+    "JournalFollower._fresh_at":
+        "thread:tail-loop written; freshness() reads a monotonic float — "
+        "a torn read is impossible on CPython and a stale one only widens "
+        "the reported staleness bound",
+    "JournalFollower._queue":
+        "thread:set in attach() before start() arms the loop and in "
+        "retarget() after the join handoff; the loop only reads it",
+}
 
 
 def slots_record_filter(slots):
@@ -88,7 +119,7 @@ class _WatermarkScanner:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = make_lock("follower._WatermarkScanner._lock")
         self._seg_base: Optional[int] = None
         self._seg_path = ""
         self._offset = 0
@@ -176,11 +207,12 @@ class JournalFollower:
                              "journal the leader's ops a second time")
         self.client = RedissonTPU.create(cfg)
         self._applied = 0
-        self._applied_lock = threading.Lock()
+        self._applied_lock = make_lock(
+            "follower.JournalFollower._applied_lock")
         self._records_applied = 0
         self._apply_errors = 0
         self._queue: Optional[deque] = None  # in-process mode
-        self._queue_lock = threading.Lock()
+        self._queue_lock = make_lock("follower.JournalFollower._queue_lock")
         self._source_journal = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -43,8 +43,28 @@ from typing import Any, Callable, Dict, Iterator, List, NamedTuple, Optional, Tu
 from zlib import crc32
 
 from redisson_tpu.commands import OP_TABLE
+from redisson_tpu.concurrency import make_rlock
 from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.persist.codec import decode_payload, encode_payload
+
+# graftlint Tier C guarded-by audit: `_io` serializes appends, rotation,
+# fsync, and the stats snapshot. The `:writes` entries are flags the
+# sync-loop backstop peeks at without the lock — a stale read there means
+# at most one extra wake/linger round, and sync() rechecks under `_io`.
+GUARDED_BY = {
+    "Journal._last_seq": "_io",
+    "Journal._synced_seq": "_io",
+    "Journal._unsynced_runs": "_io",
+    "Journal._records_appended": "_io",
+    "Journal._runs_appended": "_io",
+    "Journal._bytes_appended": "_io",
+    "Journal._fsyncs": "_io",
+    "Journal._group_sum": "_io",
+    "Journal._trace": "_io",
+    "Journal._dirty": "_io:writes",
+    "Journal._closed": "_io:writes",
+    "Journal._fenced": "_io:writes",
+}
 
 MAGIC = b"RTPUWAL1"
 _HEADER = struct.Struct("<8sQ")  # magic, base_seq
@@ -227,7 +247,7 @@ class Journal:
         self._group = max(1, int(group_commit_runs))
         self._segment_max = max(1 << 16, int(segment_max_bytes))
         os.makedirs(self.path, exist_ok=True)
-        self._io = threading.RLock()
+        self._io = make_rlock("journal.Journal._io")
         # Trace manager (trace/manager.py) or None: every fsync's duration
         # is reported so slow durability shows up in LATENCY HISTORY /
         # the fsync histogram even for unsampled ops.
@@ -336,6 +356,7 @@ class Journal:
             return 0
         frames = bytearray()
         records: List[JournalRecord] = []
+        # graftlint: allow-guarded(single-appender discipline: only the executor dispatcher calls append_run, so pre-encoding frames with an unlocked _last_seq read is race-free — the commit under _io below re-publishes it)
         seq = self._last_seq
         for op in ops:
             op_kind = getattr(op, "kind", kind)
@@ -373,6 +394,7 @@ class Journal:
             self._dirty = True
             group_full = self._unsynced_runs >= self._group
             if self._f.tell() >= self._segment_max:
+                # graftlint: allow-hold(rotation must be atomic with the append that tripped the size limit — a concurrent append landing in the sealed file would be lost to tailers)
                 self._rotate_locked()
         if self._fsync == "always":
             if group_full or not defer:
@@ -440,6 +462,7 @@ class Journal:
             # a "stall" rule sleeps here and is measured as fsync time.
             fault_inject.fire("journal_fsync")
             self._f.flush()
+            # graftlint: allow-hold(group commit IS the design: appends queue behind the fsync so one disk flush covers the whole group; releasing _io here would ack unsynced records)
             os.fsync(self._f.fileno())
             if trace is not None:
                 trace.record_fsync(time.monotonic() - t0)
@@ -493,6 +516,7 @@ class Journal:
         """Seal the active segment (flushed + fsynced) and open a fresh one
         whose base is the next sequence number. Returns that base."""
         with self._io:
+            # graftlint: allow-hold(explicit rotation seals the segment atomically with respect to appends; the fsync inside is the seal)
             return self._rotate_locked()
 
     def _rotate_locked(self) -> int:
@@ -501,6 +525,7 @@ class Journal:
                 and self._f.tell() <= _HEADER.size:
             return base  # active segment still empty: nothing to seal
         self._f.flush()
+        # graftlint: allow-hold(the seal fsync must complete before any append can land in the next segment — that ordering is the segment-boundary durability contract)
         os.fsync(self._f.fileno())
         self._synced_seq = self._last_seq
         if self._unsynced_runs:
@@ -510,6 +535,7 @@ class Journal:
         self._dirty = False
         self._f.close()
         base = self._last_seq + 1
+        # graftlint: allow-hold(the fresh segment's header fsync rides the same critical section as the seal — a reader must never observe the directory without exactly one active segment)
         self._create_segment(base)
         return base
 
@@ -534,15 +560,16 @@ class Journal:
         return removed
 
     # -- introspection -------------------------------------------------------
-
-    @property
-    def last_seq(self) -> int:
-        return self._last_seq
+    # (last_seq lives with fence() above: this section once carried a
+    # second, lock-free definition that SHADOWED the locked property —
+    # the post-fence promotion watermark was read without `_io`, racing
+    # in-flight appends. One definition, under the lock.)
 
     @property
     def durable_seq(self) -> int:
         """Highest sequence number known fsynced to stable storage."""
-        return self._synced_seq
+        with self._io:
+            return self._synced_seq
 
     def segment_count(self) -> int:
         with self._io:
@@ -584,6 +611,7 @@ class Journal:
             if self._closed:
                 return
             self._f.flush()
+            # graftlint: allow-hold(close() drains durability under _io so no append can interleave between the final fsync and the fd close)
             os.fsync(self._f.fileno())
             self._synced_seq = self._last_seq
             self._dirty = False

@@ -27,9 +27,20 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from redisson_tpu import checkpoint
+from redisson_tpu.concurrency import make_lock
 from redisson_tpu.executor import Op
 from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.fault.taxonomy import classify
+
+# graftlint Tier C guarded-by audit: `_lock` serializes snapshot_now (one
+# BGSAVE at a time). `last_error` is a diagnostics string racing only its
+# own readers — a stale read shows the previous error, which is fine.
+GUARDED_BY = {
+    "Snapshotter.last_error":
+        "racy:single-writer loop thread, read-only stats consumers; a "
+        "torn observation is impossible for a str rebind and a stale one "
+        "just reports the previous period's error",
+}
 
 SNAPSHOT_PREFIX = "snap-"
 STRUCTURES_FILE = "structures.bin"
@@ -72,7 +83,7 @@ class Snapshotter:
         self._interval_s = float(interval_s)
         self._keep = max(1, int(keep))
         self._cut_timeout_s = cut_timeout_s
-        self._lock = threading.Lock()
+        self._lock = make_lock("snapshotter.Snapshotter._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # stats (persist.* gauges read these)
@@ -196,6 +207,7 @@ class Snapshotter:
             # (the previous snapshot + journal remain authoritative).
             fault_inject.fire("snapshot_io")
             fut = self._client._executor.execute_barrier(self._cut)
+            # graftlint: allow-hold(BGSAVE serialization IS the design: _lock admits one snapshot at a time and the cut barrier is the first half of it; the dispatcher never takes _lock, so no inversion is possible)
             seq, objs, blob = fut.result(timeout=self._cut_timeout_s)
             # Off the dispatcher now: materialize host copies and write.
             extra_objects = {

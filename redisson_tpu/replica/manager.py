@@ -38,9 +38,47 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from redisson_tpu.concurrency import make_lock
 from redisson_tpu.fault.taxonomy import DeviceLostFault
 from redisson_tpu.replica.replica import ServingReplica
 from redisson_tpu.replica.router import ReplicaRouter
+
+# graftlint Tier C guarded-by audit. Failover state is SINGLE-FLIGHT, not
+# lock-per-field: `_failover_lock` + the `_failed_over` once-guard admit
+# exactly one failover at a time (probe thread, fault thread, and manual
+# callers race on the guard; losers return None). Everything below the
+# guard is therefore mutated by one thread per epoch, and rejoin()/close()
+# only run in quiescent phases (prober idling on `_failed_over`, or after
+# `_stop.set()` + join). Declared thread:, with the guard as the reason.
+GUARDED_BY = {
+    "ReplicaManager.replicas":
+        "thread:single-flight — mutated only by the failover winner under "
+        "the _failed_over once-guard, by start() pre-prober, and by "
+        "rejoin()/close() in quiescent phases; router snapshots the list",
+    "ReplicaManager._promoted":
+        "thread:single-flight failover winner; close() runs post-join",
+    "ReplicaManager._retired":
+        "thread:single-flight failover winner; close() runs post-join",
+    "ReplicaManager._primary_executor":
+        "thread:single-flight — rebound only by start() and the failover "
+        "winner; the prober reads a whole-object reference and a one-probe-"
+        "stale executor just reads as dead, which is the truth",
+    "ReplicaManager._epoch":
+        "thread:single-flight failover winner only",
+    "ReplicaManager.promotions":
+        "thread:single-flight failover winner; stats readers tolerate a "
+        "one-epoch-stale count",
+    "ReplicaManager.last_failover_reason":
+        "thread:single-flight failover winner (aborts hold the lock)",
+    "ReplicaManager.last_failover_s":
+        "thread:single-flight failover winner",
+    "ReplicaManager.last_fence_seq":
+        "thread:single-flight failover winner",
+    "ReplicaManager._probe_failures":
+        "thread:prober-confined — rejoin()'s reset runs while the prober "
+        "idles on _failed_over, so the counter has no concurrent writer",
+    "ReplicaManager._failed_over": "_failover_lock:writes",
+}
 
 
 def replica_engine_config(primary_config):
@@ -83,7 +121,8 @@ class ReplicaManager:
         self._stop = threading.Event()
         self._prober: Optional[threading.Thread] = None
         self._probe_failures = 0
-        self._failover_lock = threading.Lock()
+        self._failover_lock = make_lock(
+            "manager.ReplicaManager._failover_lock")
         self._failed_over = False
         self._fault_mgr = None
         self._primary_executor = None

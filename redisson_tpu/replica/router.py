@@ -47,6 +47,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from redisson_tpu.cluster.errors import SlotMovedError
 from redisson_tpu.commands import OP_TABLE
 from redisson_tpu.executor import BatchCollector, PARKED_KINDS
+from redisson_tpu.concurrency import make_lock
 
 # bpop parks on the primary's structures; bpop_cancel must reach the same
 # engine that parked it.
@@ -63,7 +64,7 @@ class ReplicaRouter:
         self._cfg = cfg
         self._replicas: List = []
         self._rr = 0  # round-robin cursor over eligible replicas
-        self._lock = threading.Lock()
+        self._lock = make_lock("router.ReplicaRouter._lock")
         self._acked: Dict[str, int] = {}
         self.replica_reads = 0
         self.primary_fallbacks = 0

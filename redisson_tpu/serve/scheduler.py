@@ -41,6 +41,7 @@ from redisson_tpu.serve.breaker import BreakerBoard
 from redisson_tpu.serve.errors import (CircuitOpenError, DeadlineExceeded,
                                        RejectedError, RetryableError)
 from redisson_tpu.serve.policy import CostModel
+from redisson_tpu.concurrency import make_condition
 
 
 class _Timer:
@@ -51,7 +52,7 @@ class _Timer:
     that is already rejecting, turning a clean cancel into a raced error."""
 
     def __init__(self):
-        self._cv = threading.Condition()
+        self._cv = make_condition("scheduler._Timer._cv")
         self._heap: List[Tuple[float, int, Callable[[], None],
                                Optional[Callable[[], None]]]] = []
         self._seq = itertools.count()

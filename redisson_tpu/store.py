@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from redisson_tpu.ops import crc16
+from redisson_tpu.concurrency import make_rlock
 
 
 class ObjectType:
@@ -59,7 +60,7 @@ class SketchStore:
     """
 
     def __init__(self, device: Optional[jax.Device] = None):
-        self._lock = threading.RLock()
+        self._lock = make_rlock("store.SketchStore._lock")
         self._objects: Dict[str, StoredObject] = {}
         self.device = device if device is not None else jax.devices()[0]
         # memstat ledger (MemLedger-shaped). Lifecycle events fire inside

@@ -20,6 +20,7 @@ from redisson_tpu.trace.hist import HistogramSet
 from redisson_tpu.trace.monitor import Monitor
 from redisson_tpu.trace.slowlog import SlowLog
 from redisson_tpu.trace.spans import Span, Tracer
+from redisson_tpu.concurrency import make_lock
 
 
 class LatencyEvents:
@@ -38,7 +39,7 @@ class LatencyEvents:
         self.history_len = max(1, int(history_len))
         self._clock = clock
         self._rings: Dict[str, List[Tuple[float, float]]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("manager.TraceManager._lock")
 
     def observe(self, event: str, duration_s: float) -> bool:
         if duration_s < self.threshold_s:

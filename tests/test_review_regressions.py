@@ -464,3 +464,93 @@ def test_geo_hash_missing_member_is_none(client):
     out = geo.hash("Palermo", "Nowhere")
     assert out["Palermo"] == "sqc8b49rny0"
     assert out["Nowhere"] is None
+
+
+def test_op_done_token_fields_written_under_lock(client, monkeypatch):
+    """Tier C fix: _op_done used to write token.op_failed / token.fault_exc
+    WITHOUT token.lock while completer threads raced each other; a lost
+    update could drop the StateUncertainFault classification for the run.
+    Hammer _op_done from many threads and require exact convergence: the
+    failure flag set, the FIRST fault kept, and _run_completed fired once."""
+    import threading
+    from concurrent.futures import Future
+
+    from redisson_tpu.executor import _InflightRun
+    from redisson_tpu.fault.taxonomy import StateUncertainFault
+
+    ex = client._executor
+    for _ in range(20):
+        token = _InflightRun("hll_add", "regr:tok", frozenset(["regr:tok"]),
+                             False)
+        n = 16
+        token.pending = n
+        completed = []
+        monkeypatch.setattr(
+            ex, "_run_completed", lambda t: completed.append(t))
+        futs = []
+        for i in range(n):
+            f = Future()
+            if i % 2:
+                f.set_exception(
+                    StateUncertainFault(f"boom {i}", seam="test"))
+            else:
+                f.set_result(None)
+            futs.append(f)
+        start = threading.Barrier(n)
+
+        def one(f):
+            start.wait()
+            ex._op_done(token, f, None)
+
+        threads = [threading.Thread(target=one, args=(f,)) for f in futs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert token.pending == 0
+        assert token.op_failed is True
+        assert isinstance(token.fault_exc, StateUncertainFault)
+        assert len(completed) == 1 and completed[0] is token
+
+
+def test_journal_last_seq_final_after_fence(tmp_path):
+    """Tier C fix: a duplicate lock-free `last_seq` property shadowed the
+    locked one, so the post-fence promotion watermark raced in-flight
+    appends. Race an appender against fence() and require the watermark
+    read after fence() to be final and consistent with what was acked."""
+    import threading
+
+    from redisson_tpu.executor import Op
+    from redisson_tpu.persist.journal import Journal
+
+    j = Journal(str(tmp_path / "wal"), fsync="off")
+    acked = []
+    go = threading.Event()
+
+    def appender():
+        go.wait()
+        i = 0
+        while True:
+            op = Op(target="regr:fence", kind="hll_add",
+                    payload={"values": [i]}, nkeys=1)
+            try:
+                j.append_run("hll_add", [op])
+            except RuntimeError:
+                return  # fenced
+            acked.append(i)
+            i += 1
+
+    t = threading.Thread(target=appender)
+    t.start()
+    go.set()
+    while len(acked) < 50:  # let real contention build
+        pass
+    j.fence()
+    w1 = j.last_seq
+    t.join()
+    w2 = j.last_seq
+    assert w1 == w2, "post-fence watermark must be final"
+    # every acked append is <= the watermark (nothing acked past the fence)
+    assert len(acked) <= w1
+    assert j.durable_seq <= j.last_seq
+    j.close()

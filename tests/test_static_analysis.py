@@ -864,3 +864,485 @@ def test_j002_widening_is_fine():
 
     closed = jax.make_jaxpr(widen)(jnp.zeros((16,), jnp.uint8))
     assert _check_one("synthetic", closed, {}) == []
+
+
+# ---------------------------------------------------------------------------
+# 5. Tier C: concurrency discipline (G011-G014)
+# ---------------------------------------------------------------------------
+
+from tools.graftlint.concurrency import (ConcurrencyLinter,  # noqa: E402
+                                         analyze_paths)
+
+
+def clint_src(src, filename="scratch.py"):
+    """Tier C lint of an in-memory source (explicit scope: always scanned)."""
+    return ConcurrencyLinter(filename, repo_root=None, explicit=True,
+                             source=textwrap.dedent(src)).run()
+
+
+def test_repo_tier_c_clean():
+    findings, _linters, graph = analyze_paths([ENGINE_DIR], repo_root=REPO)
+    assert findings == [], (
+        "graftlint Tier C findings in redisson_tpu/ — fix, register the "
+        "discipline in GUARDED_BY, or suppress with a reasoned "
+        "`# graftlint: allow-<rule>(why)`:\n"
+        + "\n".join(f"{f.file}:{f.line} {f.rule} {f.message}"
+                    for f in findings)
+    )
+    assert graph["cycles"] == [], graph["cycles"]
+
+
+def test_g011_unlocked_access_to_registered_attr():
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"Box.items": "_lock"}
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def bad_add(self, x):
+                self.items.append(x)
+
+            def good_add(self, x):
+                with self._lock:
+                    self.items.append(x)
+    """)
+    assert rules_of(findings) == ["G011"]
+    assert len(findings) == 1
+    assert "Box.items" in findings[0].message
+
+
+def test_g011_locked_suffix_convention():
+    # *_locked methods are analyzed as if the caller already holds every
+    # convention lock of the class — no finding inside them.
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"Box.items": "_lock"}
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []
+
+            def _add_locked(self, x):
+                self.items.append(x)
+
+            def add(self, x):
+                with self._lock:
+                    self._add_locked(x)
+    """)
+    assert findings == []
+
+
+def test_g011_inline_guarded_by_comment():
+    findings = clint_src("""
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.items = []  # guarded-by: _lock
+
+            def bad(self):
+                self.items.append(1)
+    """)
+    assert rules_of(findings) == ["G011"]
+
+
+def test_g011_writes_mode_exempts_reads():
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"Box.flag": "_lock:writes"}
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.flag = False
+
+            def peek(self):
+                return self.flag  # unlocked read: fine under :writes
+
+            def trip(self):
+                self.flag = True  # unlocked write: flagged
+    """)
+    assert rules_of(findings) == ["G011"]
+    assert len(findings) == 1
+
+
+def test_g011_thread_and_racy_modes_exempt():
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {
+            "Box.a": "thread:loop-confined, mutated only pre-start",
+            "Box.b": "racy:diagnostics string, stale reads fine",
+        }
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.a = 0
+                self.b = ""
+
+            def loop(self):
+                self.a += 1
+                self.b = "x"
+    """)
+    assert findings == []
+
+
+def test_g012_two_roots_no_lock():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert rules_of(findings) == ["G012"]
+    assert "Svc.count" in findings[0].message
+
+
+def test_g012_common_lock_is_clean():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+    """)
+    assert findings == []
+
+
+def test_g012_registered_discipline_is_clean():
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"Svc.count": "thread:loop and bump never overlap"}
+
+        class Svc:
+            def __init__(self):
+                self.count = 0
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert findings == []
+
+
+def test_g012_callback_arg_is_a_root():
+    # a bound method handed to another object as a callback is a thread
+    # entry root even without a Thread(...) constructor.
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self, bus):
+                self._lock = threading.Lock()
+                self.seen = 0
+                bus.subscribe(self._on_event)
+
+            def _on_event(self, ev):
+                self.seen += 1
+
+            def poll(self):
+                self.seen += 1
+    """)
+    assert rules_of(findings) == ["G012"]
+
+
+def test_g013_future_result_under_lock():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, fut):
+                with self._lock:
+                    return fut.result()
+
+            def good(self, fut):
+                res = fut.result()
+                with self._lock:
+                    return res
+    """)
+    assert rules_of(findings) == ["G013"]
+    assert len(findings) == 1
+
+
+def test_g013_event_wait_under_lock():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ev = threading.Event()
+
+            def bad(self):
+                with self._lock:
+                    self._ev.wait()
+    """)
+    assert rules_of(findings) == ["G013"]
+
+
+def test_g013_condition_wait_is_exempt():
+    # Condition.wait releases the lock it wraps — not a hold-and-block.
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+
+            def park(self):
+                with self._cv:
+                    self._cv.wait(timeout=1.0)
+    """)
+    assert findings == []
+
+
+def test_g013_queue_get_under_lock():
+    findings = clint_src("""
+        import queue
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue()
+
+            def bad(self):
+                with self._lock:
+                    return self._q.get()
+    """)
+    assert rules_of(findings) == ["G013"]
+
+
+def test_g013_one_hop_through_private_method():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _drain(self, fut):
+                return fut.result()
+
+            def bad(self, fut):
+                with self._lock:
+                    return self._drain(fut)
+    """)
+    assert "G013" in rules_of(findings)
+
+
+def test_g013_suppression_with_reason():
+    findings = clint_src("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def serialized(self, fut):
+                with self._lock:
+                    # graftlint: allow-hold(serialization is the design; nothing else takes _lock)
+                    return fut.result()
+    """)
+    assert findings == []
+
+
+def test_g014_two_lock_inversion(tmp_path):
+    mod = tmp_path / "tangle.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        class Tangle:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def forward(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """))
+    findings, _linters, graph = analyze_paths([str(mod)], repo_root=None)
+    assert "G014" in {f.rule for f in findings}
+    assert len(graph["cycles"]) == 1
+    nodes = set(graph["cycles"][0]["nodes"])
+    assert {"tangle.Tangle._a", "tangle.Tangle._b"} <= nodes
+    # consistent ordering in a second module must NOT cycle
+    ok = tmp_path / "ordered.py"
+    ok.write_text(textwrap.dedent("""
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._a:
+                    with self._b:
+                        pass
+    """))
+    findings, _linters, graph = analyze_paths([str(ok)], repo_root=None)
+    assert findings == []
+    assert graph["edges"] and graph["cycles"] == []
+
+
+def test_g014_one_hop_edge(tmp_path):
+    # lock held across a self-call whose body takes another lock still
+    # contributes an order edge.
+    mod = tmp_path / "hop.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        class Hop:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def _inner(self):
+                with self._b:
+                    pass
+
+            def outer(self):
+                with self._a:
+                    self._inner()
+
+            def backward(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """))
+    findings, _linters, graph = analyze_paths([str(mod)], repo_root=None)
+    assert "G014" in {f.rule for f in findings}
+
+
+def test_tier_c_suppression_requires_reason():
+    src = """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                self._t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.count += 1  # graftlint: allow-shared()
+
+            def bump(self):
+                self.count += 1
+    """
+    findings = clint_src(src)
+    assert rules_of(findings) == ["G012"], "empty reason must not suppress"
+    findings = clint_src(src.replace(
+        "allow-shared()", "allow-shared(loop and bump never overlap)"))
+    assert findings == []
+
+
+def test_tier_c_rules_registered():
+    for rule in ("G011", "G012", "G013", "G014"):
+        assert rule in RULES
+    for alias in ("guarded", "shared", "hold", "lockcycle"):
+        assert alias in SUPPRESS_ALIASES
+
+
+def test_tier_c_findings_are_baselinable():
+    # Tier C findings carry the same fingerprint scheme as Tier A, so the
+    # --baseline machinery covers them uniformly.
+    from tools.graftlint.cli import collect_full
+
+    src = textwrap.dedent("""
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, fut):
+                with self._lock:
+                    return fut.result(timeout=5)
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "svc.py")
+        with open(p, "w") as fh:
+            fh.write(src)
+        dicts, tier_c = collect_full([p], jaxpr=False, repo_root=td)
+        assert [d["rule"] for d in dicts] == ["G013"]
+        assert dicts[0]["fingerprint"]
+        assert tier_c["rules"]["G013"] == 1
+        bl = os.path.join(td, "bl.json")
+        baseline_mod.write(bl, dicts)
+        grandfathered = baseline_mod.load(bl)
+        assert dicts[0]["fingerprint"] in grandfathered
+
+
+def test_cli_json_tier_c_block():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-jaxpr",
+         os.path.join(ENGINE_DIR, "persist", "journal.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert set(payload["tier_c"]["rules"]) == {"G011", "G012", "G013", "G014"}
+    assert "edges" in payload["tier_c"]["lock_graph"]
+    assert "cycles" in payload["tier_c"]["lock_graph"]
+
+
+def test_interop_is_out_of_tier_c_scope():
+    # asyncio interop runs single-writer on the event loop — documented
+    # exclusion, no thread-lock discipline to check.
+    sub = os.path.join(ENGINE_DIR, "interop")
+    if not os.path.isdir(sub):
+        pytest.skip("no interop package")
+    findings, _linters, _graph = analyze_paths([sub], repo_root=REPO)
+    assert findings == []

@@ -1,14 +1,21 @@
-"""graftlint: two-tier static analysis for the redisson_tpu engine.
+"""graftlint: three-tier static analysis for the redisson_tpu engine.
 
-Tier A (`astlint`) is an AST pass over the source with rules G001-G005
+Tier A (`astlint`) is an AST pass over the source with rules G001-G010
 for the engine's real failure modes (int32 reduction overflow, implicit
 host syncs, jit recompilation hazards, u64 lane discipline, Pallas
-contracts). Tier B (`jaxpr_audit`) traces the public ops and audits the
-jaxprs for 64-bit leaks and reduction-crossing narrowing.
+contracts, blocking/journal/fault/clock/memory discipline). Tier B
+(`jaxpr_audit`) traces the public ops and audits the jaxprs for 64-bit
+leaks and reduction-crossing narrowing. Tier C (`concurrency`) checks
+lock discipline over the threaded service stack: guarded-by registry
+violations (G011), unguarded shared mutation (G012), blocking-under-lock
+(G013), and static lock-order cycles (G014); its runtime complement is
+the OrderedLock witness in ``redisson_tpu/concurrency.py``.
 
 CLI: ``python -m tools.graftlint`` (see cli.py). Programmatic use:
-``run_lint(paths)`` returns finding dicts.
+``run_lint(paths)`` returns finding dicts; ``collect_full(paths)`` also
+returns the tier_c lock-graph block.
 """
 
 from .cli import collect as run_lint  # noqa: F401
+from .cli import collect_full  # noqa: F401
 from .findings import RULES, Finding  # noqa: F401

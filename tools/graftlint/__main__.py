@@ -1,3 +1,25 @@
+"""graftlint entry point.
+
+    python -m tools.graftlint [paths ...] [--json] [--no-jaxpr]
+                              [--no-concurrency]
+                              [--baseline FILE] [--update-baseline]
+
+Three tiers over the default ``redisson_tpu/`` target:
+
+  Tier A  AST rules G001-G010 (device-numerics, sync, journal, fault,
+          clock and memory-accounting discipline)
+  Tier B  jaxpr audit J001/J002 (traced 64-bit leaks, reduction-crossing
+          narrowing); skip with ``--no-jaxpr``
+  Tier C  concurrency discipline G011-G014 (guarded-by registry checking,
+          unguarded shared mutation, blocking-under-lock, static
+          lock-order cycle detection); skip with ``--no-concurrency``
+
+``--json`` adds a ``tier_c`` block: per-rule counts plus the static
+lock-order graph (edges and any cycles). The runtime complement is the
+``OrderedLock`` witness in ``redisson_tpu/concurrency.py``, exercised by
+``python benchmarks/suite.py --race-smoke``.
+"""
+
 import sys
 
 from .cli import run

@@ -1,11 +1,15 @@
 """graftlint CLI.
 
     python -m tools.graftlint [paths ...] [--json] [--no-jaxpr]
+                              [--no-concurrency]
                               [--baseline FILE] [--update-baseline]
 
 Exit codes: 0 clean (or baselined-only), 1 findings, 2 internal error.
 Default target is the repo's ``redisson_tpu/`` tree with the committed
-baseline; Tier B (jaxpr audit) runs unless ``--no-jaxpr``.
+baseline; Tier B (jaxpr audit) runs unless ``--no-jaxpr``; Tier C
+(concurrency discipline: G011-G014) runs unless ``--no-concurrency``.
+``--json`` output carries a ``tier_c`` block with per-rule counts and the
+static lock-order graph (edges + cycles).
 """
 
 from __future__ import annotations
@@ -23,11 +27,37 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
+TIER_C_RULES = ("G011", "G012", "G013", "G014")
 
-def collect(paths, jaxpr=True, repo_root=REPO_ROOT):
-    """Run both tiers; returns finding dicts (with fingerprints)."""
+
+def collect(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
+    """Run all tiers; returns finding dicts (with fingerprints). The
+    long-standing programmatic surface (`run_lint`) — see collect_full
+    for the tier_c lock-graph block."""
+    dicts, _ = collect_full(paths, jaxpr=jaxpr, concurrency=concurrency,
+                            repo_root=repo_root)
+    return dicts
+
+
+def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
+    """Run all tiers; returns (finding dicts with fingerprints, tier_c
+    block: per-rule counts + static lock-order graph edges/cycles)."""
     findings, linters = lint_paths(paths, repo_root=repo_root)
     sources = {lt.relpath: lt.lines for lt in linters}
+    tier_c = {"rules": {r: 0 for r in TIER_C_RULES},
+              "lock_graph": {"edges": [], "cycles": []}}
+    if concurrency:
+        from .concurrency import analyze_paths
+
+        c_findings, c_linters, graph = analyze_paths(paths,
+                                                     repo_root=repo_root)
+        findings += c_findings
+        for lt in c_linters:
+            sources.setdefault(lt.relpath, lt.lines)
+        for f in c_findings:
+            if f.rule in tier_c["rules"]:
+                tier_c["rules"][f.rule] += 1
+        tier_c["lock_graph"] = graph
     if jaxpr:
         from .jaxpr_audit import run_audits
 
@@ -38,13 +68,14 @@ def collect(paths, jaxpr=True, repo_root=REPO_ROOT):
         text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
         out.append(f.to_dict(text))
     out.sort(key=lambda d: (d["file"], d["line"], d["rule"]))
-    return out
+    return out, tier_c
 
 
 def run(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST + jaxpr static analysis for the redisson_tpu engine",
+        description="AST + jaxpr + concurrency static analysis for the "
+                    "redisson_tpu engine",
     )
     ap.add_argument("paths", nargs="*",
                     default=[os.path.join(REPO_ROOT, "redisson_tpu")],
@@ -53,6 +84,10 @@ def run(argv=None) -> int:
                     help="machine-readable output")
     ap.add_argument("--no-jaxpr", action="store_true",
                     help="skip Tier B (jaxpr audit of ops/)")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip Tier C (concurrency discipline: guarded-by, "
+                         "shared mutation, blocking-under-lock, lock-order "
+                         "graph)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered fingerprints")
     ap.add_argument("--update-baseline", action="store_true",
@@ -60,7 +95,8 @@ def run(argv=None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        dicts = collect(args.paths, jaxpr=not args.no_jaxpr)
+        dicts, tier_c = collect_full(args.paths, jaxpr=not args.no_jaxpr,
+                                     concurrency=not args.no_concurrency)
     except Exception as exc:  # noqa: BLE001
         print(f"graftlint: internal error: {type(exc).__name__}: {exc}",
               file=sys.stderr)
@@ -77,12 +113,16 @@ def run(argv=None) -> int:
 
     if args.as_json:
         print(json.dumps(
-            {"findings": fresh, "baselined": baselined}, indent=2))
+            {"findings": fresh, "baselined": baselined, "tier_c": tier_c},
+            indent=2))
     else:
         for d in fresh:
             loc = f"{d['file']}:{d['line']}" if d["line"] else d["file"]
             print(f"{loc}: {d['rule']} [{RULES[d['rule']][0] if d['rule'] in RULES else '?'}] {d['message']}")
             if d["hint"]:
                 print(f"    hint: {d['hint']}")
-        print(f"{len(fresh)} finding(s), {len(baselined)} baselined")
+        ncycles = len(tier_c["lock_graph"]["cycles"])
+        nedges = len(tier_c["lock_graph"]["edges"])
+        print(f"{len(fresh)} finding(s), {len(baselined)} baselined; "
+              f"lock-order graph: {nedges} edge(s), {ncycles} cycle(s)")
     return 1 if fresh else 0

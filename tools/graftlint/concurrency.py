@@ -1,0 +1,911 @@
+"""graftlint Tier C — concurrency-discipline analysis.
+
+Eraser-style lockset checking over the threaded service stack. Three AST
+rules plus a tree-wide lock-order graph:
+
+  G011  guarded-by violation — a registered attribute accessed outside a
+        `with <lock>:` scope. Modules declare their discipline in a
+        module-level ``GUARDED_BY`` table::
+
+            GUARDED_BY = {
+                # self.<attr> in that class must be under `with self._lock:`
+                "CommandExecutor._inflight": "_lock",
+                # writes-only mode: unlocked reads are a deliberate racy
+                # fast path (snapshot counters), writes must lock
+                "SlotOwnershipBackend._owned": "_lock:writes",
+                # name-based provenance: any `token.pending` access must
+                # hold `with token.lock:`
+                "token.pending": "lock",
+                # declared confinement / benign race: exempt from
+                # G011/G012, but the WHY is part of the audited table
+                "ReplicaManager.promotions": "thread:failover single-flight",
+                "TailFollower._fresh_at": "racy:monotonic stamp, torn read ok",
+            }
+
+        or inline on the constructing assignment::
+
+            self._queue = []  # guarded-by: _lock
+
+  G012  unguarded shared mutation — an attribute written from >=2 distinct
+        thread-entry roots (``Thread(target=...)`` targets, callbacks
+        passed to other calls — completion callbacks, timer callbacks —
+        plus the public API as one collective root) with no common lock
+        across the writes and no GUARDED_BY entry.
+
+  G013  blocking call while holding a lock — ``Future.result``,
+        ``Event.wait``, ``Queue.get``, ``fsync``/journal ``sync()``, and
+        ``backend.run`` inside a ``with <lock>:`` scope or a ``*_locked``
+        method (repo convention: the caller holds the class guard).
+        One-hop: calling a same-class method that directly blocks is
+        flagged at the call site. ``Condition.wait`` is exempt — it
+        releases its lock while waiting.
+
+  G014  static lock-order cycle — nested ``with``-acquisitions (direct and
+        one-hop through same-class calls) build a directed graph of lock
+        sites (``<module-stem>.<Class>.<attr>``); any cycle is a potential
+        deadlock, reported with both acquisition paths.
+
+Scope: modules under ``redisson_tpu/`` that import ``threading``, except
+``redisson_tpu/interop/`` (the asyncio bridge has its own discipline: the
+event loop is the single writer, threads only hand off through
+``call_soon_threadsafe``). Files passed explicitly on the CLI are always
+analyzed. Suppression uses the shared idiom:
+``# graftlint: allow-guarded(...)`` / ``allow-shared`` / ``allow-hold`` /
+``allow-lockcycle`` (or the ``g011``..``g014`` ids), reason mandatory.
+
+The runtime half lives in ``redisson_tpu/concurrency.py``: the same lock
+site names, witnessed under ``REDISSON_TPU_LOCK_WITNESS=1``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .astlint import _ITEM_RE, _rel, iter_py_files
+from .findings import Finding, SUPPRESS_ALIASES
+
+#: attribute names that read as locks even without construction provenance
+#: (cross-object acquisitions like `with ex._lock:` or `with token.lock:`)
+_LOCKISH_RE = re.compile(r"(^|_)(lock|cv|mutex|serial|io)\b|lock$")
+
+_GUARDED_BY_COMMENT_RE = re.compile(
+    r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)(?::(writes))?")
+
+_LOCK_CTORS = {"Lock", "RLock", "make_lock", "make_rlock", "allocate_lock"}
+_COND_CTORS = {"Condition", "make_condition"}
+_EVENT_CTORS = {"Event"}
+_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted repr of an attribute chain ('self._backend.run');
+    unknown roots render as '?'."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "?")
+    return ".".join(reversed(parts))
+
+
+def _is_self_attr(node) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+
+class _Guard:
+    """One GUARDED_BY entry."""
+
+    __slots__ = ("lock", "mode")
+
+    def __init__(self, spec: str):
+        # "<lock>" | "<lock>:writes" | "thread:<why>" | "racy:<why>"
+        if spec.startswith("thread:") or spec.startswith("racy:"):
+            self.lock = None  # declared confinement / benign race
+            self.mode = spec.split(":", 1)[0]
+        elif spec.endswith(":writes"):
+            self.lock = spec[: -len(":writes")]
+            self.mode = "writes"
+        else:
+            self.lock = spec
+            self.mode = "full"
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: dict[str, ast.AST] = {}
+        self.lock_attrs: set[str] = set()
+        self.cond_attrs: dict[str, str | None] = {}  # cond -> aliased lock
+        self.event_attrs: set[str] = set()
+        self.queue_attrs: set[str] = set()
+        # per-method top-level lock acquisitions (one-hop G014 feed)
+        self.toplevel_acquires: dict[str, list[str]] = {}
+        # analysis products, filled by the per-method walks:
+        self.accesses = []    # (key, is_write, node, flat, method)
+        self.self_calls = []  # (callee, node, flat, method)
+        self.blocking = []    # (desc, node, flat, method)
+        self.roots: dict[str, str] = {}  # method -> root description
+        self.call_graph: dict[str, set[str]] = {}
+        self.callsite_locks: dict[str, list] = {}  # callee -> [set, ...]
+
+    def guard_keys(self) -> set[str]:
+        return (self.lock_attrs | set(self.cond_attrs)
+                | self.event_attrs | self.queue_attrs)
+
+    def convention_locks(self) -> set[str]:
+        """What a *_locked method is assumed to hold: every class guard."""
+        out = set(self.lock_attrs) | set(self.cond_attrs)
+        for alias in self.cond_attrs.values():
+            if alias:
+                out.add(alias)
+        return out
+
+
+class _Edge:
+    __slots__ = ("a", "b", "file", "line", "where")
+
+    def __init__(self, a, b, file, line, where):
+        self.a, self.b, self.file, self.line, self.where = \
+            a, b, file, line, where
+
+
+def _cycle_in(edges) -> list[str] | None:
+    """DFS cycle search over [(a, b), ...]; returns the node cycle (first
+    node repeated at the end) or None. Iterative, deterministic."""
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    for v in adj.values():
+        v.sort()
+    WHITE, GREY, BLACK = 0, 1, 2
+    color: dict[str, int] = {}
+    for start in sorted(adj):
+        if color.get(start, WHITE) != WHITE:
+            continue
+        stack = [(start, iter(adj.get(start, ())))]
+        color[start] = GREY
+        path = [start]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for m in it:
+                c = color.get(m, WHITE)
+                if c == GREY:
+                    return path[path.index(m):] + [m]
+                if c == WHITE:
+                    color[m] = GREY
+                    path.append(m)
+                    stack.append((m, iter(adj.get(m, ()))))
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                path.pop()
+                stack.pop()
+    return None
+
+
+class ConcurrencyLinter:
+    """Tier C analysis of one module. Mirrors astlint.FileLinter's shape
+    (relpath/lines/findings/allows) so the CLI treats both tiers alike."""
+
+    def __init__(self, path: str, repo_root: str | None = None,
+                 explicit: bool = False, source: str | None = None):
+        self.path = path
+        self.relpath = _rel(path, repo_root)
+        self.explicit = explicit
+        if source is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.allows: dict[int, set[str]] = {}
+        self.edges: list[_Edge] = []
+        self.guarded: dict[str, _Guard] = {}
+        self.module_locks: set[str] = set()
+        stem = os.path.basename(self.relpath)
+        self.stem = stem[:-3] if stem.endswith(".py") else stem
+
+    # -- scope & shared plumbing -------------------------------------------
+
+    def in_scope(self, tree: ast.AST) -> bool:
+        if self.explicit:
+            return True
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        if sub.startswith("interop/"):
+            return False
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "threading"
+                       for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if (mod.split(".")[0] == "threading"
+                        or mod == "redisson_tpu.concurrency"):
+                    return True
+        return False
+
+    def _collect_allows(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            for name, reason in _ITEM_RE.findall(line):
+                rule = SUPPRESS_ALIASES.get(name.lower())
+                if rule and reason.strip():
+                    self.allows.setdefault(i, set()).add(rule)
+
+    def _allowed(self, rule: str, node) -> bool:
+        lo = getattr(node, "lineno", 1)
+        hi = getattr(node, "end_lineno", None) or lo
+        for ln in range(lo, hi + 1):
+            if rule in self.allows.get(ln, ()):
+                return True
+        prev = lo - 1
+        if prev >= 1 and prev <= len(self.lines):
+            if self.lines[prev - 1].lstrip().startswith("#"):
+                if rule in self.allows.get(prev, ()):
+                    return True
+        return False
+
+    def _emit(self, rule, node, message, hint) -> None:
+        if self._allowed(rule, node):
+            return
+        self.findings.append(Finding(
+            rule, self.relpath, getattr(node, "lineno", 1), message, hint))
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError:
+            return self.findings  # tier A already reports G000
+        if not self.in_scope(tree):
+            return self.findings
+        self._collect_allows()
+        self._collect_guarded_by(tree)
+        self._collect_module_locks(tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._analyze_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_module_func(node)
+        # dedupe identical (rule, line)
+        seen, out = set(), []
+        for f in self.findings:
+            key = (f.rule, f.file, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        self.findings = out
+        return self.findings
+
+    # -- declarations -------------------------------------------------------
+
+    def _collect_guarded_by(self, tree: ast.AST) -> None:
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "GUARDED_BY"
+                       for t in node.targets):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    self.guarded[k.value] = _Guard(v.value)
+
+    def _inline_guard(self, node, cls_name: str, attr: str) -> None:
+        """`self.X = ... # guarded-by: _lock` on the assignment line."""
+        line = self.lines[node.lineno - 1] \
+            if node.lineno <= len(self.lines) else ""
+        m = _GUARDED_BY_COMMENT_RE.search(line)
+        if m:
+            spec = m.group(1) + (":writes" if m.group(2) else "")
+            self.guarded.setdefault(f"{cls_name}.{attr}", _Guard(spec))
+
+    def _collect_module_locks(self, tree: ast.AST) -> None:
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                if self._ctor_kind(node.value) in ("lock", "cond"):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks.add(t.id)
+
+    @staticmethod
+    def _ctor_kind(call: ast.Call) -> str | None:
+        f = call.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if name in _LOCK_CTORS:
+            return "lock"
+        if name in _COND_CTORS:
+            return "cond"
+        if name in _EVENT_CTORS:
+            return "event"
+        if name in _QUEUE_CTORS:
+            return "queue"
+        return None
+
+    # -- class analysis -----------------------------------------------------
+
+    def _analyze_class(self, cnode: ast.ClassDef) -> None:
+        cls = _ClassInfo(cnode.name)
+        for item in cnode.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[item.name] = item
+        self._collect_primitives(cnode, cls)
+        self._collect_roots(cls)
+        self._collect_toplevel_acquires(cls)
+        for name, meth in cls.methods.items():
+            ctx = _WalkCtx(self, cls, name, meth)
+            held = []
+            if name.endswith("_locked"):
+                conv = cls.convention_locks()
+                if conv:
+                    held = [(sorted(conv)[0], conv)]
+            ctx.walk_body(meth.body, held)
+        self._resolve_class(cls)
+
+    def _collect_primitives(self, cnode, cls: _ClassInfo) -> None:
+        for node in ast.walk(cnode):
+            if not isinstance(node, ast.Assign):
+                continue
+            kind = (self._ctor_kind(node.value)
+                    if isinstance(node.value, ast.Call) else None)
+            for t in node.targets:
+                if not _is_self_attr(t):
+                    continue
+                if kind == "lock":
+                    cls.lock_attrs.add(t.attr)
+                elif kind == "cond":
+                    call = node.value
+                    name = (call.func.attr
+                            if isinstance(call.func, ast.Attribute)
+                            else getattr(call.func, "id", ""))
+                    lock_arg = None
+                    if name == "make_condition" and len(call.args) >= 2:
+                        lock_arg = call.args[1]
+                    elif name == "Condition" and call.args:
+                        lock_arg = call.args[0]
+                    for kw in call.keywords:
+                        if kw.arg == "lock":
+                            lock_arg = kw.value
+                    alias = lock_arg.attr \
+                        if lock_arg is not None and _is_self_attr(lock_arg) \
+                        else None
+                    cls.cond_attrs[t.attr] = alias
+                elif kind == "event":
+                    cls.event_attrs.add(t.attr)
+                elif kind == "queue":
+                    cls.queue_attrs.add(t.attr)
+                else:
+                    self._inline_guard(node, cls.name, t.attr)
+
+    @staticmethod
+    def _local_primitives(fn, want: str) -> set[str]:
+        out = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and ConcurrencyLinter._ctor_kind(node.value) == want):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def _collect_roots(self, cls: _ClassInfo) -> None:
+        """Thread-entry roots: Thread targets, self-methods escaping as
+        callback arguments (directly, via lambda, or via a local def
+        passed by name). The public API is handled as one collective
+        root at resolve time."""
+        def note(m, why):
+            if m in cls.methods:
+                cls.roots.setdefault(m, why)
+
+        def scan_escaping(body, why):
+            for n in ast.walk(body):
+                if _is_self_attr(n):
+                    note(n.attr, why)
+
+        for meth in cls.methods.values():
+            local_defs = {n.name: n for n in ast.walk(meth)
+                          if isinstance(n, ast.FunctionDef) and n is not meth}
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = _dotted(node.func)
+                is_thread = fname.endswith("Thread")
+                for val in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    why = ("Thread target" if is_thread
+                           else f"callback arg to {fname}")
+                    if _is_self_attr(val):
+                        note(val.attr, why)
+                    elif isinstance(val, ast.Lambda):
+                        scan_escaping(val.body, why)
+                    elif (isinstance(val, ast.Name)
+                          and val.id in local_defs):
+                        scan_escaping(local_defs[val.id], why)
+
+    def _collect_toplevel_acquires(self, cls: _ClassInfo) -> None:
+        """Pre-pass: which locks does each method acquire at nesting depth
+        zero? (One-hop G014: `with A: self.m()` where m acquires B at its
+        top level implies edge A -> B.)"""
+        for name, meth in cls.methods.items():
+            acquired: list[str] = []
+            scratch = _WalkCtx(self, cls, name, meth)
+
+            def visit(body, depth):
+                for stmt in body:
+                    if isinstance(stmt, ast.With):
+                        keys = []
+                        for item in stmt.items:
+                            key = scratch._lock_key(item.context_expr)
+                            if key is not None:
+                                if depth == 0 and key not in acquired:
+                                    acquired.append(key)
+                                keys.append(key)
+                        visit(stmt.body, depth + (1 if keys else 0))
+                    elif isinstance(stmt, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    else:
+                        for fname, value in ast.iter_fields(stmt):
+                            if fname in ("body", "orelse", "finalbody"):
+                                if isinstance(value, list):
+                                    visit([s for s in value
+                                           if isinstance(s, ast.stmt)],
+                                          depth)
+                            elif fname == "handlers" \
+                                    and isinstance(value, list):
+                                for h in value:
+                                    if isinstance(h, ast.ExceptHandler):
+                                        visit(h.body, depth)
+
+            visit(meth.body, 0)
+            cls.toplevel_acquires[name] = acquired
+
+    # -- resolution ---------------------------------------------------------
+
+    def _node_name(self, cls: _ClassInfo | None, key: str) -> str:
+        """Lock-graph node id. self-locks: <stem>.<Class>.<attr>;
+        var/local/module locks keep their textual key under the stem."""
+        if (cls is not None and "." not in key
+                and not cls.name.startswith("<module")):
+            alias = cls.cond_attrs.get(key)
+            if alias:
+                key = alias  # condition and its lock are ONE node
+            if (key in cls.lock_attrs or key in cls.cond_attrs
+                    or key.startswith("_")):
+                return f"{self.stem}.{cls.name}.{key}"
+        return f"{self.stem}.{key}"
+
+    def _resolve_class(self, cls: _ClassInfo) -> None:
+        # one-hop provenance (like G002): locks held at EVERY intra-class
+        # call site of a private method count as held inside it
+        always_locked: dict[str, set[str]] = {}
+        for callee, locksets in cls.callsite_locks.items():
+            common = None
+            for ls in locksets:
+                common = set(ls) if common is None else (common & set(ls))
+            always_locked[callee] = common or set()
+
+        def effective(flat, method):
+            held = set(flat)
+            if method.startswith("_") and not method.startswith("__"):
+                held |= always_locked.get(method, set())
+            return held
+
+        # G011 -------------------------------------------------------------
+        for key, is_write, node, flat, method in cls.accesses:
+            guard = self.guarded.get(key)
+            if guard is None or guard.lock is None:
+                continue
+            if guard.mode == "writes" and not is_write:
+                continue
+            if method in ("__init__", "__del__"):
+                continue
+            var_based = not key.startswith(cls.name + ".")
+            if var_based:
+                var = key.split(".", 1)[0]
+                need = f"{var}.{guard.lock}"
+                ok = need in flat
+            else:
+                need = guard.lock
+                ok = need in effective(flat, method)
+            if not ok:
+                self._emit(
+                    "G011", node,
+                    f"{'write to' if is_write else 'read of'} '{key}' "
+                    f"(guarded by '{guard.lock}') outside "
+                    f"`with {need if var_based else 'self.' + need}:`",
+                    "take the declared lock, use the *_locked naming if the "
+                    "caller holds it, or re-declare the guard in GUARDED_BY "
+                    "with a reasoned thread:/racy: mode")
+
+        # G012 -------------------------------------------------------------
+        reach = {root: self._closure(cls, root) for root in cls.roots}
+        api_reach: set[str] = set()
+        for m in cls.methods:
+            if not m.startswith("_"):
+                api_reach |= self._closure(cls, m)
+        skip = cls.guard_keys()
+        by_attr: dict[str, list] = {}
+        for key, is_write, node, flat, method in cls.accesses:
+            if not is_write or not key.startswith(cls.name + "."):
+                continue
+            attr = key.split(".", 1)[1]
+            if (attr in skip or key in self.guarded
+                    or method == "__init__"):
+                continue
+            by_attr.setdefault(attr, []).append((node, flat, method))
+        for attr, writes in by_attr.items():
+            roots_hit: set[str] = set()
+            live = []
+            for node, flat, method in writes:
+                hit = False
+                for root, why in cls.roots.items():
+                    if method in reach[root]:
+                        roots_hit.add(f"{root} [{why}]")
+                        hit = True
+                if method in api_reach:
+                    roots_hit.add("public API")
+                    hit = True
+                if hit:
+                    live.append((node, effective(flat, method)))
+            if len(roots_hit) < 2 or not live:
+                continue
+            common = None
+            for _, held in live:
+                common = held if common is None else (common & held)
+            if common:
+                continue
+            live.sort(key=lambda t: t[0].lineno)
+            target = next((n for n, held in live if not held), live[0][0])
+            self._emit(
+                "G012", target,
+                f"'{cls.name}.{attr}' written from {len(roots_hit)} "
+                f"thread-entry roots ({', '.join(sorted(roots_hit))}) "
+                "with no common lock and no GUARDED_BY entry",
+                "guard the writes with one lock and register the attribute "
+                "in GUARDED_BY, or declare the discipline with a "
+                "thread:/racy: entry explaining why it is safe")
+
+        # G013 -------------------------------------------------------------
+        blocking_methods: dict[str, str] = {}
+        for desc, node, flat, method in cls.blocking:
+            if not self._allowed("G013", node):
+                blocking_methods.setdefault(method, desc)
+        for desc, node, flat, method in cls.blocking:
+            if flat:
+                self._emit(
+                    "G013", node,
+                    f"blocking {desc} while holding {sorted(set(flat))}",
+                    "move the blocking call outside the `with` scope "
+                    "(snapshot under the lock, block after), or suppress "
+                    "with allow-hold(reason) if the serialization is the "
+                    "design")
+        for callee, node, flat, method in cls.self_calls:
+            if not flat or callee == method:
+                continue
+            inner = blocking_methods.get(callee)
+            if inner is None:
+                continue
+            self._emit(
+                "G013", node,
+                f"call to '{cls.name}.{callee}' (which blocks on {inner}) "
+                f"while holding {sorted(set(flat))}",
+                "one-hop: the callee blocks; drop the lock before calling, "
+                "or suppress with allow-hold(reason) at the call site")
+
+        # G014 one-hop edges ----------------------------------------------
+        for callee, node, flat, method in cls.self_calls:
+            if not flat or callee not in cls.methods:
+                continue
+            parent = flat[-1]
+            for key in cls.toplevel_acquires.get(callee, ()):
+                a = self._node_name(cls, parent)
+                b = self._node_name(cls, key)
+                if a != b:
+                    self.edges.append(_Edge(
+                        a, b, self.relpath, node.lineno,
+                        f"{cls.name}.{method} -> {callee}()"))
+
+    def _closure(self, cls: _ClassInfo, root: str) -> set[str]:
+        seen = {root}
+        stack = [root]
+        while stack:
+            m = stack.pop()
+            for callee in cls.call_graph.get(m, ()):
+                if callee in cls.methods and callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        return seen
+
+    # -- module-level functions --------------------------------------------
+
+    def _analyze_module_func(self, fn) -> None:
+        cls = _ClassInfo(f"<module:{fn.name}>")
+        ctx = _WalkCtx(self, cls, fn.name, fn)
+        ctx.walk_body(fn.body, [])
+        # only G013 applies outside a class (no self attrs to register)
+        for desc, node, flat, method in cls.blocking:
+            if flat:
+                self._emit(
+                    "G013", node,
+                    f"blocking {desc} while holding {sorted(set(flat))}",
+                    "move the blocking call outside the `with` scope")
+
+
+class _WalkCtx:
+    """Held-lockset walk over one method body. `held` is a list of
+    (primary_key, alias_key_set) tuples; nested function/lambda bodies
+    restart with an empty lockset (they run at call time, on whatever
+    thread invokes them), but inherit local lock provenance."""
+
+    def __init__(self, linter: ConcurrencyLinter, cls: _ClassInfo,
+                 method: str, fn):
+        self.lint = linter
+        self.cls = cls
+        self.method = method
+        L = ConcurrencyLinter._local_primitives
+        self.local_locks = (L(fn, "lock") | linter.module_locks
+                            if fn is not None else set(linter.module_locks))
+        self.local_events = L(fn, "event") if fn is not None else set()
+        self.local_queues = L(fn, "queue") if fn is not None else set()
+        self.local_conds = L(fn, "cond") if fn is not None else set()
+
+    def _spawn(self, fn) -> "_WalkCtx":
+        sub = _WalkCtx(self.lint, self.cls, self.method, None)
+        L = ConcurrencyLinter._local_primitives
+        sub.local_locks = self.local_locks | (L(fn, "lock") if fn else set())
+        sub.local_events = self.local_events | (
+            L(fn, "event") if fn else set())
+        sub.local_queues = self.local_queues | (
+            L(fn, "queue") if fn else set())
+        sub.local_conds = self.local_conds | (L(fn, "cond") if fn else set())
+        return sub
+
+    # -- lock identification ------------------------------------------------
+
+    def _lock_key(self, expr) -> str | None:
+        """Held-set key for a `with` item, or None when it isn't a lock."""
+        if _is_self_attr(expr):
+            attr = expr.attr
+            if (attr in self.cls.lock_attrs or attr in self.cls.cond_attrs
+                    or _LOCKISH_RE.search(attr)):
+                return attr
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)):
+            if _LOCKISH_RE.search(expr.attr):
+                return f"{expr.value.id}.{expr.attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_locks or expr.id in self.local_conds:
+                return expr.id
+        return None
+
+    def _keyset(self, key: str) -> set[str]:
+        """A held condition aliases its lock: holding '_cv' == '_lock'."""
+        keys = {key}
+        alias = self.cls.cond_attrs.get(key)
+        if alias:
+            keys.add(alias)
+        return keys
+
+    @staticmethod
+    def _flat(held) -> list[str]:
+        out = []
+        for primary, keys in held:
+            out.append(primary)
+            out.extend(k for k in sorted(keys) if k != primary)
+        return out
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk_body(self, body, held) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, node, held) -> None:
+        if isinstance(node, ast.With):
+            pushed = 0
+            for item in node.items:
+                self._scan_expr(item.context_expr, self._flat(held))
+                key = self._lock_key(item.context_expr)
+                if key is None:
+                    continue
+                if held:
+                    parent = held[-1][0]
+                    if parent != key:
+                        self.lint.edges.append(_Edge(
+                            self.lint._node_name(self.cls, parent),
+                            self.lint._node_name(self.cls, key),
+                            self.lint.relpath, item.context_expr.lineno,
+                            f"{self.cls.name}.{self.method}"))
+                held.append((key, self._keyset(key)))
+                pushed += 1
+            self.walk_body(node.body, held)
+            for _ in range(pushed):
+                held.pop()
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # local def: runs later, on the calling thread — empty lockset
+            self._spawn(node).walk_body(node.body, [])
+            return
+        flat = self._flat(held)
+        for name, value in ast.iter_fields(node):
+            if name in ("body", "orelse", "finalbody"):
+                if isinstance(value, list):
+                    for v in value:
+                        if isinstance(v, ast.stmt):
+                            self._stmt(v, held)
+                        elif isinstance(v, ast.AST):
+                            self._scan_expr(v, flat)
+            elif name == "handlers" and isinstance(value, list):
+                for h in value:
+                    if isinstance(h, ast.ExceptHandler):
+                        self.walk_body(h.body, held)
+            elif isinstance(value, ast.AST):
+                self._scan_expr(value, flat)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v, held)
+                    elif isinstance(v, ast.AST):
+                        self._scan_expr(v, flat)
+
+    def _scan_expr(self, expr, flat) -> None:
+        if isinstance(expr, ast.Lambda):
+            # callback body: empty lockset at its (later) run time
+            self._spawn(None)._scan_expr(expr.body, [])
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._spawn(expr).walk_body(expr.body, [])
+            return
+        if isinstance(expr, ast.Call):
+            self._check_call(expr, flat)
+        if isinstance(expr, ast.Attribute):
+            self._record_access(expr, flat)
+        for child in ast.iter_child_nodes(expr):
+            self._scan_expr(child, flat)
+
+    def _record_access(self, node, flat) -> None:
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        if _is_self_attr(node):
+            self.cls.accesses.append(
+                (f"{self.cls.name}.{node.attr}", is_write, node,
+                 list(flat), self.method))
+        elif (isinstance(node.value, ast.Name)
+              and f"{node.value.id}.{node.attr}" in self.lint.guarded):
+            self.cls.accesses.append(
+                (f"{node.value.id}.{node.attr}", is_write, node,
+                 list(flat), self.method))
+
+    def _check_call(self, call: ast.Call, flat) -> None:
+        f = call.func
+        if _is_self_attr(f):
+            callee = f.attr
+            self.cls.call_graph.setdefault(self.method, set()).add(callee)
+            self.cls.self_calls.append(
+                (callee, call, list(flat), self.method))
+            self.cls.callsite_locks.setdefault(callee, []).append(set(flat))
+        desc = self._blocking_desc(call)
+        if desc is not None:
+            self.cls.blocking.append((desc, call, list(flat), self.method))
+
+    def _blocking_desc(self, call: ast.Call) -> str | None:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = f.attr
+        recv = f.value
+        if name == "result":
+            return "Future.result()"
+        if name in ("wait", "wait_for"):
+            if _is_self_attr(recv):
+                a = recv.attr
+                if a in self.cls.cond_attrs:
+                    return None  # Condition.wait releases its lock
+                if a in self.cls.event_attrs:
+                    return "Event.wait()"
+                return None
+            if isinstance(recv, ast.Name):
+                if recv.id in self.local_conds:
+                    return None
+                if recv.id in self.local_events:
+                    return "Event.wait()"
+            return None  # unknown receiver: no provenance, no claim
+        if name == "get":
+            if _is_self_attr(recv) and recv.attr in self.cls.queue_attrs:
+                return "Queue.get()"
+            if isinstance(recv, ast.Name) and recv.id in self.local_queues:
+                return "Queue.get()"
+            return None
+        if name == "fsync":
+            return "fsync()"
+        dotted = _dotted(f)
+        if name == "sync" and "journal" in dotted.lower():
+            return "journal sync()"
+        if name == "run" and ("backend" in dotted or "_inner" in dotted):
+            return "backend.run()"
+        return None
+
+
+# -- tree-wide entry ---------------------------------------------------------
+
+
+def analyze_paths(paths, repo_root=None):
+    """Run Tier C over `paths`. Returns (findings, linters, graph) where
+    graph = {"edges": [...], "cycles": [...]} for the CLI's tier_c block.
+    G014 cycle findings are appended here (the graph is tree-wide)."""
+    findings: list[Finding] = []
+    linters: list[ConcurrencyLinter] = []
+    for p in paths:
+        explicit = os.path.isfile(p)
+        for fpath in iter_py_files(p):
+            lt = ConcurrencyLinter(fpath, repo_root=repo_root,
+                                   explicit=explicit)
+            findings.extend(lt.run())
+            linters.append(lt)
+    merged: dict[tuple[str, str], dict] = {}
+    exemplar: dict[tuple[str, str], _Edge] = {}
+    for lt in linters:
+        for e in lt.edges:
+            if e.a == e.b:
+                continue
+            key = (e.a, e.b)
+            if key in merged:
+                merged[key]["count"] += 1
+            else:
+                merged[key] = {"from": e.a, "to": e.b, "count": 1,
+                               "file": e.file, "line": e.line,
+                               "where": e.where}
+                exemplar[key] = e
+    by_file = {lt.relpath: lt for lt in linters}
+    cycle_dicts = []
+    remaining = sorted(merged)
+    for _ in range(16):  # bound: one reported cycle removed per round
+        cyc = _cycle_in(remaining)
+        if cyc is None:
+            break
+        pairs = list(zip(cyc, cyc[1:]))
+        legs = [f"{a} -> {b} at {exemplar[(a, b)].file}:"
+                f"{exemplar[(a, b)].line} ({exemplar[(a, b)].where})"
+                for a, b in pairs]
+        cycle_dicts.append({"nodes": cyc, "legs": legs})
+        first = exemplar[pairs[0]]
+        msg = ("lock-order cycle (potential deadlock): "
+               + " -> ".join(cyc) + "; acquisition paths: "
+               + "; ".join(legs))
+        hint = ("pick one global order for these locks and acquire in that "
+                "order everywhere, or collapse them into a single lock")
+        owner = by_file.get(first.file)
+        node = type("_N", (), {"lineno": first.line,
+                               "end_lineno": first.line})()
+        if owner is None or not owner._allowed("G014", node):
+            f = Finding("G014", first.file, first.line, msg, hint)
+            findings.append(f)
+            if owner is not None:
+                owner.findings.append(f)
+        drop = set(pairs)
+        remaining = [e for e in remaining if e not in drop]
+    graph = {
+        "edges": sorted(merged.values(),
+                        key=lambda d: (d["from"], d["to"])),
+        "cycles": cycle_dicts,
+    }
+    return findings, linters, graph

@@ -1,7 +1,8 @@
 """Finding model + rule registry for graftlint.
 
 Rule ids are stable (baseline fingerprints embed them). Tier A (AST) rules
-are G0xx; tier B (jaxpr) rules are J0xx. Each rule has a short alias usable
+are G001-G010; tier B (jaxpr) rules are J0xx; tier C (concurrency) rules
+are G011-G014. Each rule has a short alias usable
 in suppression comments: `# graftlint: allow-<alias>(reason)` — a reason is
 mandatory, an empty `allow-sync()` does not suppress.
 """
@@ -96,6 +97,29 @@ RULES = {
         "outside the accounted store/backend seams — the memstat ledger "
         "never sees the byte delta, so MEMORY parity drifts and the OOM "
         "watermark lies",
+    ),
+    "G011": (
+        "guarded",
+        "guarded-by violation: an attribute registered in the module's "
+        "GUARDED_BY table (or annotated `# guarded-by: <lock>`) is read or "
+        "written outside a `with <lock>:` scope",
+    ),
+    "G012": (
+        "shared",
+        "unguarded shared mutation: an attribute written from >=2 distinct "
+        "thread-entry roots (Thread targets, completion/timer callbacks, "
+        "the public API) with no common lock held and no GUARDED_BY entry",
+    ),
+    "G013": (
+        "hold",
+        "blocking call while holding a lock (Future.result, Event.wait, "
+        "Queue.get, journal fsync/sync, backend.run inside a `with <lock>:` "
+        "scope or a *_locked method) — the classic deadlock/stall shape",
+    ),
+    "G014": (
+        "lockcycle",
+        "static lock-order cycle: nested `with`-acquisitions form a cycle "
+        "in the tree-wide lock-order graph — a potential deadlock",
     ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
