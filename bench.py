@@ -1091,6 +1091,61 @@ def bench_ha(quick=False):
     return out
 
 
+def bench_wire(quick=False):
+    """RESP wire front-end (PR 16): pipelined command throughput over a
+    real TCP socket, single-command round-trip p99, and the connection
+    scheduler's achieved coalescing depth (engine ops per execute_many
+    window — the wire analogue of the pipeline overlap ratio)."""
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    n_cmds = 2_000 if quick else 20_000
+    depth = 64
+    pings = 200 if quick else 1_000
+
+    cfg = Config()
+    cfg.use_serve()
+    cfg.use_wire()
+    c = RedissonTPU(cfg)
+    out = {}
+    try:
+        cli = SyncRespClient("127.0.0.1", c.wire.port,
+                             retry_attempts=1, timeout=30.0)
+        cli.connect()
+        try:
+            # Round-trip latency: serial PINGs, one in flight at a time.
+            lat = []
+            for _ in range(pings):
+                t0 = time.perf_counter()
+                cli.execute("PING")
+                lat.append(time.perf_counter() - t0)
+            lat.sort()
+            out["wire_rtt_p99_us"] = round(
+                lat[int(0.99 * (len(lat) - 1))] * 1e6, 1)
+
+            # Pipelined throughput: engine commands at fixed client depth.
+            sent = 0
+            t0 = time.perf_counter()
+            while sent < n_cmds:
+                cmds = [("SETBIT", "bw:bits", str(sent + j), "1")
+                        for j in range(depth)]
+                cli.pipeline(cmds)
+                sent += depth
+            wall = time.perf_counter() - t0
+            out["wire_ops_per_sec"] = round(sent / wall, 1)
+            out["wire_pipeline_depth"] = round(
+                c.wire.snapshot()["avg_window_depth"], 2)
+        finally:
+            cli.close()
+    finally:
+        c.shutdown()
+    print(f"# wire: {out['wire_ops_per_sec']:,.0f} pipelined ops/s, "
+          f"rtt p99 {out['wire_rtt_p99_us']:.0f} us, "
+          f"window depth {out['wire_pipeline_depth']}", file=sys.stderr)
+    return out
+
+
 def main():
     import os
 
@@ -1229,6 +1284,10 @@ def main():
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)
     except Exception as exc:  # noqa: BLE001
         print(f"# pfmerge bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result.update(bench_wire(quick))
+    except Exception as exc:  # noqa: BLE001
+        print(f"# wire bench failed: {exc!r}", file=sys.stderr)
     try:
         result["replica"] = bench_replica(quick)
     except Exception as exc:  # noqa: BLE001

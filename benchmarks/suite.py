@@ -2450,6 +2450,211 @@ def race_smoke():
     return ok
 
 
+def wire_smoke():
+    """Wire front-end acceptance smoke (the CPU-only CI contract for the
+    RESP server PR):
+
+      1. N concurrent pipelined RESP connections push a keyed PFADD/SETBIT
+         workload through the wire server; every pipeline's replies must
+         come back dense (zero dropped) and in submission order, checked
+         with per-pipeline ECHO markers and first-write SETBIT replies
+         (a nonzero previous-bit means a reply landed on the wrong
+         command).
+      2. The final engine digest must be bit-identical to the same
+         vectors pushed through the facade directly — the wire layer may
+         reorder *across* connections but must not corrupt state.
+      3. Wire throughput must hold >= 0.5x the direct-facade rate: the
+         RESP framing + socket hop may cost at most half the engine's
+         batched throughput.
+    """
+    import threading
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    n_conns = 4
+    per_conn = max(_scale(3200), 250)  # commands per connection
+    depth = 64                         # client pipeline depth
+    n_keys = 8
+
+    def vectors(cid, i):
+        """Deterministic command #i of connection cid (both runs)."""
+        if i % 2 == 0:
+            key = f"wsm:hll{i % n_keys}"
+            vals = [f"c{cid}i{i}k{j}" for j in range(4)]
+            return ("pfadd", key, vals)
+        return ("setbit", f"wsm:bits{cid}", i)
+
+    n_warm = 256
+
+    def warm_vectors(i):
+        """Untimed JIT/codec warmup (both runs, same keys: digests still
+        have to match with the warmup state folded in)."""
+        if i % 2 == 0:
+            return ("pfadd", f"wsm:warmh{i % n_keys}", [f"w{i}"])
+        return ("setbit", "wsm:warmb", i)
+
+    def make_client(wire):
+        cfg = Config()
+        cfg.use_serve()
+        if wire:
+            cfg.use_wire()
+        return RedissonTPU(cfg)
+
+    ok = True
+
+    # -- wire run: N concurrent pipelined connections ------------------------
+    cw = make_client(True)
+    dropped = misordered = 0
+    stats_lock = threading.Lock()
+    try:
+        def worker(cid):
+            nonlocal dropped, misordered
+            cli = SyncRespClient("127.0.0.1", cw.wire.port,
+                                 retry_attempts=1, timeout=30.0)
+            cli.connect()
+            bad_drop = bad_order = 0
+            try:
+                for base in range(0, per_conn, depth):
+                    hi = min(base + depth, per_conn)
+                    marker = f"m{cid}:{base}"
+                    cmds = []
+                    for i in range(base, hi):
+                        kind, key, payload = vectors(cid, i)
+                        if kind == "pfadd":
+                            cmds.append(("PFADD", key, *payload))
+                        else:
+                            cmds.append(("SETBIT", key, str(payload), "1"))
+                    cmds.append(("ECHO", marker))
+                    out = cli.pipeline(cmds)
+                    if len(out) != len(cmds):
+                        bad_drop += 1
+                        continue
+                    # Marker must be last; engine replies must be the
+                    # expected ints (SETBIT on a fresh offset returns 0).
+                    if out[-1] != marker.encode():
+                        bad_order += 1
+                    for i, r in zip(range(base, hi), out):
+                        kind = vectors(cid, i)[0]
+                        expect0 = (kind == "setbit")
+                        if not isinstance(r, int) or (expect0 and r != 0):
+                            bad_order += 1
+                            break
+            finally:
+                cli.close()
+            with stats_lock:
+                dropped += bad_drop
+                misordered += bad_order
+
+        warm = SyncRespClient("127.0.0.1", cw.wire.port,
+                              retry_attempts=1, timeout=30.0)
+        warm.connect()
+        try:
+            for base in range(0, n_warm, depth):
+                cmds = []
+                for i in range(base, min(base + depth, n_warm)):
+                    kind, key, payload = warm_vectors(i)
+                    if kind == "pfadd":
+                        cmds.append(("PFADD", key, *payload))
+                    else:
+                        cmds.append(("SETBIT", key, str(payload), "1"))
+                warm.pipeline(cmds)
+        finally:
+            warm.close()
+
+        threads = [threading.Thread(target=worker, args=(cid,))
+                   for cid in range(n_conns)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wire_wall = time.perf_counter() - t0
+        snap = cw.wire.snapshot()
+        digest_wire = _engine_digest(cw)
+    finally:
+        cw.shutdown()
+
+    total_cmds = n_conns * per_conn
+    wire_ops = total_cmds / max(wire_wall, 1e-9)
+    if dropped or misordered:
+        print(f"#   wire run: {dropped} dropped / {misordered} misordered "
+              f"pipeline(s)", file=sys.stderr)
+        ok = False
+
+    # -- facade run: same vectors straight into the client API ---------------
+    cf = make_client(False)
+    try:
+        pending = []
+        for i in range(n_warm):
+            kind, key, payload = warm_vectors(i)
+            if kind == "pfadd":
+                pending.append(
+                    cf.get_hyper_log_log(key).add_all_async(payload))
+            else:
+                pending.append(cf.get_bit_set(key).set_bits_async([payload]))
+        for f in pending:
+            f.result()
+        pending.clear()
+        t0 = time.perf_counter()
+        for cid in range(n_conns):
+            for i in range(per_conn):
+                kind, key, payload = vectors(cid, i)
+                if kind == "pfadd":
+                    pending.append(
+                        cf.get_hyper_log_log(key).add_all_async(payload))
+                else:
+                    pending.append(
+                        cf.get_bit_set(key).set_bits_async([payload]))
+                if len(pending) >= depth * n_conns:
+                    for f in pending:
+                        f.result()
+                    pending.clear()
+        for f in pending:
+            f.result()
+        facade_wall = time.perf_counter() - t0
+        digest_facade = _engine_digest(cf)
+    finally:
+        cf.shutdown()
+
+    facade_ops = total_cmds / max(facade_wall, 1e-9)
+    ratio = wire_ops / max(facade_ops, 1e-9)
+
+    if digest_wire != digest_facade:
+        print(f"#   digest mismatch: wire {digest_wire[:16]} != "
+              f"facade {digest_facade[:16]}", file=sys.stderr)
+        ok = False
+    if ratio < 0.5:
+        print(f"#   wire throughput {wire_ops:,.0f} ops/s is "
+              f"{ratio:.2f}x facade ({facade_ops:,.0f} ops/s) < 0.5x gate",
+              file=sys.stderr)
+        ok = False
+
+    result = {
+        "conns": n_conns,
+        "commands": total_cmds,
+        "pipeline_depth": depth,
+        "wire_ops_per_sec": round(wire_ops, 1),
+        "facade_ops_per_sec": round(facade_ops, 1),
+        "throughput_ratio": round(ratio, 3),
+        "dropped": dropped,
+        "misordered": misordered,
+        "digest_match": digest_wire == digest_facade,
+        "avg_window_depth": round(snap["avg_window_depth"], 2),
+        "windows_flushed": snap["windows_flushed"],
+        "sheds": snap["sheds_total"],
+    }
+    print(json.dumps({"wire_smoke": result}), flush=True)
+    print(f"# wire-smoke: {'PASS' if ok else 'FAIL'} — "
+          f"{total_cmds} cmds over {n_conns} conns, "
+          f"{wire_ops:,.0f} ops/s wire vs {facade_ops:,.0f} facade "
+          f"({ratio:.2f}x), digest "
+          f"{'identical' if result['digest_match'] else 'MISMATCH'}, "
+          f"window depth {result['avg_window_depth']}", file=sys.stderr)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -2530,6 +2735,13 @@ def main():
                          "acyclicity, report per-site hold-time p99, and "
                          "cross-check against the static Tier C graph, "
                          "then exit")
+    ap.add_argument("--wire-smoke", action="store_true",
+                    help="RESP wire front-end acceptance: N concurrent "
+                         "pipelined connections with zero dropped/"
+                         "misordered replies, engine digest identical to "
+                         "the same vectors through the facade, and wire "
+                         "throughput >= 0.5x the direct-facade rate, "
+                         "then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -2557,6 +2769,9 @@ def main():
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)
+
+    if args.wire_smoke:
+        sys.exit(0 if wire_smoke() else 1)
 
     if args.cluster_smoke:
         sys.exit(0 if cluster_smoke() else 1)

@@ -65,12 +65,14 @@ class RedissonTPU:
             # own executor. The compute section (local/tpu) configures the
             # per-shard stacks, not this client.
             self._init_cluster_mode()
+            self._start_wire()
             return
         if mode == "redis":
             # Passthrough: every op translates to Redis commands over RESP —
             # the reference's own execution model (server executes, client
             # is stateless).
             self._init_redis_mode()
+            self._start_wire()
             return
         # Device-backed modes compile kernels: persist them across processes
         # (~7 s per cold (op, shape) on the tunneled chip otherwise).
@@ -260,6 +262,38 @@ class RedissonTPU:
                 # threads when the first dial fails.
                 self.shutdown()
                 raise
+        # RESP wire front-end (wire/): the TCP listener real redis clients
+        # connect to. Wired LAST so the first socket read finds the full
+        # stack (serve admission, persist, replicas) already standing.
+        self._start_wire()
+
+    def _start_wire(self) -> None:
+        """Start the wire front-end when `Config.wire` is set
+        (PersistenceManager-style lifecycle: failures unwind the whole
+        client). One WireServer in single-engine modes; the cluster facade
+        starts one per shard behind a shared -MOVED/-ASK address table."""
+        self.wire = None
+        wcfg = self.config.wire
+        if wcfg is None:
+            return
+        if self.cluster is not None:
+            from redisson_tpu.wire import ClusterWireFrontend
+
+            self.wire = ClusterWireFrontend(self, wcfg)
+        else:
+            from redisson_tpu.wire import WireServer
+
+            self.wire = WireServer(self, wcfg)
+        try:
+            self.wire.start()
+        except Exception:
+            self.wire = None
+            self.shutdown()
+            raise
+        if getattr(self, "metrics", None) is not None:
+            from redisson_tpu.observability import register_wire
+
+            register_wire(self.metrics, self.wire)
 
     def _build_executor(self, backend, max_batch_keys=None):
         """Build the executor waist and, when `Config.serve` is set, the QoS
@@ -1048,6 +1082,15 @@ class RedissonTPU:
             self._is_shutdown = True
 
     def _shutdown_inner(self):
+        if getattr(self, "wire", None) is not None:
+            # Wire first, in every mode: stop accepting sockets and drain
+            # the event loop before the dispatch stack underneath (serve /
+            # executor / shard clients) starts rejecting its submissions.
+            try:
+                self.wire.stop()
+            except Exception:
+                pass
+            self.wire = None
         if getattr(self, "cluster", None) is not None:
             # Cluster facade: the shard clients own every background
             # resource; the manager closes the router (its redirect worker)

@@ -43,3 +43,15 @@ class ClusterCrossSlotError(Exception):
     """A multi-key op references keys on different shards — the
     `-CROSSSLOT` analogue. Hashtags (`{tag}`) co-locate keys on purpose;
     PFMERGE and MGET/MSET are fanned out by the router instead."""
+
+
+def render_redirect(exc: SlotMovedError, addr: str) -> bytes:
+    """Render a redirect error as its real wire frame: ``-ASK <slot>
+    <host:port>`` for the cutover window, ``-MOVED`` otherwise. `addr` is
+    the wire address of the destination shard (the guard only knows shard
+    ids; the wire tier owns the id -> host:port map)."""
+    from redisson_tpu.wire import proto  # late: wire imports this module
+
+    if isinstance(exc, SlotAskError):
+        return proto.ask(exc.slot, addr)
+    return proto.moved(exc.slot, addr)

@@ -134,6 +134,14 @@ class ClusterRouter:
     def ranges(self) -> List[Tuple[int, int, int]]:
         return slot_ranges(self.slot_table())
 
+    def ask_slots(self) -> frozenset:
+        """Slots parked in the cutover (ASK) window right now, or an empty
+        set. The wire tier renders keyed commands on these slots as real
+        ``-ASK`` redirects instead of parking the event loop on the flip."""
+        with self._lock:
+            ask = self._ask
+        return ask[0] if ask is not None else frozenset()
+
     def add_shard(self, shard) -> None:
         with self._lock:
             self._shards[shard.shard_id] = shard

@@ -330,6 +330,32 @@ class ReplicaConfig:
 
 
 @dataclass
+class WireConfig:
+    """RESP2/RESP3 network front-end (redisson_tpu/wire/): a TCP server
+    real redis clients (redis-cli, redis-py, Redisson) connect to; pipelined
+    commands from all connections coalesce into `ServingLayer.execute_many`
+    windows. In cluster mode one server fronts every shard (base `port` + i,
+    or all-ephemeral when port=0) and keyed commands answer real -MOVED/-ASK
+    redirects during live slot migration."""
+
+    host: str = "127.0.0.1"
+    # 0 = bind an ephemeral port (read it back from client.wire.port).
+    port: int = 0
+    # Require AUTH/HELLO AUTH before any other command (None = open).
+    password: Optional[str] = None
+    # Accept-time shed bound: further connections get -BUSY + close
+    # (0 = unlimited).
+    max_connections: int = 1024
+    # Per-connection pipelined command cap: commands past this many
+    # unanswered get -BUSY in their reply position (RejectedError shape).
+    max_inflight_per_conn: int = 128
+    # Listen backlog handed to the OS.
+    backlog: int = 128
+    # retry-after hint rendered into wire -BUSY sheds.
+    shed_retry_after_s: float = 0.05
+
+
+@dataclass
 class Config:
     local: Optional[LocalConfig] = None
     tpu: Optional[TpuConfig] = None
@@ -349,6 +375,8 @@ class Config:
     cluster: Optional[ClusterConfig] = None
     # Read-replica fleet (None = primary serves all reads).
     replicas: Optional[ReplicaConfig] = None
+    # RESP wire front-end (None = facade-only access, no TCP listener).
+    wire: Optional[WireConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -430,6 +458,14 @@ class Config:
             self.replicas.num_replicas = num_replicas
         return self.replicas
 
+    def use_wire(self, host: str = "", port: int = -1) -> "WireConfig":
+        self.wire = self.wire or WireConfig()
+        if host:
+            self.wire.host = host
+        if port >= 0:
+            self.wire.port = port
+        return self.wire
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -466,6 +502,7 @@ class Config:
             "memory": MemConfig,
             "cluster": ClusterConfig,
             "replicas": ReplicaConfig,
+            "wire": WireConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

@@ -335,14 +335,27 @@ class CommandExecutor:
 
     def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]],
                      tenant: str = "",
-                     deadline: Optional[float] = None) -> List[Future]:
+                     deadline: Optional[float] = None,
+                     admitted_ats: Optional[Sequence[float]] = None
+                     ) -> List[Future]:
         """Enqueue a pre-staged op list under ONE lock acquisition (the
         RBatch dispatch path): per-target FIFO order follows list order, and
-        the whole batch shares one tenant + deadline budget."""
+        the whole batch shares one tenant + deadline budget.
+
+        `admitted_ats` (optional, parallel to `staged`) carries upstream
+        admission stamps — the wire tier stamps each command at socket
+        read, so a sampled span's admission stage covers network queueing
+        too. Threaded per-op through the tracer's same-thread handoff."""
         ops = [Op(target=t, kind=k, payload=p, nkeys=n, tenant=tenant,
                   deadline=deadline) for (t, k, p, n) in staged]
+        trace = self._trace
+        annotate = (trace.tracer.annotate_next
+                    if trace is not None and admitted_ats is not None
+                    else None)
         with self._cv:
-            for op in ops:
+            for i, op in enumerate(ops):
+                if annotate is not None and op.kind != BARRIER_KIND:
+                    annotate(admitted_at=admitted_ats[i])
                 self._enqueue_locked(op)
             self._cv.notify()
         return [op.future for op in ops]
@@ -521,14 +534,16 @@ class CommandExecutor:
                 del self._queues[other]
         # The linger wait releases the lock, so a submitter who found the
         # drained queue empty has re-added `target` to the round-robin —
-        # dedupe, or the next pop dispatches a deleted/empty queue.
-        in_ready = target in self._ready
+        # possibly MORE THAN ONCE: each wait/re-drain cycle empties the
+        # queue again, and the next refill appends another copy. Strip
+        # every copy, then re-add exactly one iff work remains; a single
+        # leftover duplicate would outlive the `del` below as a stale
+        # round-robin entry and KeyError the dispatcher on its next pick.
+        while target in self._ready:
+            self._ready.remove(target)
         if q:
-            if not in_ready:
-                self._ready.append(target)
+            self._ready.append(target)
         else:
-            if in_ready:
-                self._ready.remove(target)
             del self._queues[target]
         return kind, target, run
 

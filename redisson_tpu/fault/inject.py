@@ -23,6 +23,10 @@ Seams (each is one `fire()` call placed in product code):
   health_probe    replica/manager.py — the primary health probe; an
                   injected fault is a false-negative probe (drives a
                   spurious failover against a live primary)
+  wire_conn       wire/server.py — the per-connection socket read loop; an
+                  injected fault is a DROPCONN: the server kills the socket
+                  mid-pipeline (bytes read, commands not yet dispatched),
+                  exercising the reply-window's no-misattribution guarantee
 
 Cost when disabled: `fire()` reads one module global and returns — no
 lock, no allocation — so the instrumentation stays under the <1%
@@ -48,6 +52,7 @@ SEAMS = (
     "mesh_collective",
     "replica_tail",
     "health_probe",
+    "wire_conn",
 )
 
 #: fault-class name (as written in plans/config dicts) -> taxonomy class

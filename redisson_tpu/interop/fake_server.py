@@ -39,28 +39,16 @@ import numpy as np
 from redisson_tpu import native
 from redisson_tpu.interop import hyll
 from redisson_tpu.interop import mini_lua
-
-
-def _ok() -> bytes:
-    return b"+OK\r\n"
-
-
-def _err(msg: str) -> bytes:
-    return f"-ERR {msg}\r\n".encode()
-
-
-def _int(v: int) -> bytes:
-    return b":%d\r\n" % v
-
-
-def _bulk(v: Optional[bytes]) -> bytes:
-    if v is None:
-        return b"$-1\r\n"
-    return b"$%d\r\n" % len(v) + v + b"\r\n"
-
-
-def _array(items: List[bytes]) -> bytes:
-    return b"*%d\r\n" % len(items) + b"".join(items)
+from redisson_tpu.wire import proto
+# Reply rendering comes from the shared RESP frame codec (wire/proto.py):
+# the fake's hand-rolled encoders are gone, so its bytes-on-the-wire are
+# definitionally identical to the real wire server's. Local names kept —
+# they are used hundreds of times below.
+from redisson_tpu.wire.proto import array as _array
+from redisson_tpu.wire.proto import bulk as _bulk
+from redisson_tpu.wire.proto import err as _err
+from redisson_tpu.wire.proto import integer as _int
+from redisson_tpu.wire.proto import ok as _ok
 
 
 def _readonly_for_replication() -> frozenset:
@@ -164,7 +152,7 @@ class FakeRedisServer:
                       writer: asyncio.StreamWriter) -> None:
         self.connections += 1
         self._writers.add(writer)
-        parser = native.RespParser()
+        parser = proto.RespParser()
         authed = self.password is None
         asking = False  # set by ASKING, whitelists exactly the next command
         try:
@@ -296,7 +284,7 @@ class FakeRedisServer:
             self._replicate("RPOPLPUSH", [bytes(a[0]), bytes(a[1])])
             return
         # BLPOP/BRPOP reply: [key, value] — pop that key on the replicas.
-        parser = native.RespParser()
+        parser = proto.RespParser()
         try:
             vals = parser.feed(reply)
         finally:
@@ -1535,13 +1523,13 @@ class FakeRedisServer:
             raise mini_lua.LuaError(raw[1:].split(b"\r\n", 1)[0])
         if raw.startswith(b"+"):
             return {"ok": raw[1:].split(b"\r\n", 1)[0]}
-        parser = native.RespParser()
+        parser = proto.RespParser()
         try:
             vals = parser.feed(raw)
         finally:
             parser.close()
         v = vals[0]
-        if isinstance(v, native.RespError):
+        if isinstance(v, proto.RespError):
             raise mini_lua.LuaError(str(v).encode())
         return v
 

@@ -13,8 +13,10 @@ A deliberately small analogue of the reference's L0+L2 for the flush path:
   * per-command retry (retry_attempts x retry_interval) + response timeout,
     modeled on command/CommandAsyncService.java:378-512.
 
-Wire encode/parse runs in the native C++ codec (redisson_tpu.native); this
-module is orchestration only.
+Wire encode/parse runs in the native C++ codec, imported through the shared
+frame-codec module (redisson_tpu.wire.proto — one RESP implementation per
+direction, same symbols the wire server uses); this module is orchestration
+only.
 """
 
 from __future__ import annotations
@@ -25,8 +27,8 @@ import threading
 from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Any, Deque, List, Optional, Sequence, Tuple
 
-from redisson_tpu import native
-from redisson_tpu.native import RespError
+from redisson_tpu.wire import proto
+from redisson_tpu.wire.proto import RespError
 
 
 class ConnectionClosed(ConnectionError):
@@ -79,7 +81,7 @@ class RespClient:
         self.reconnect_backoff_cap = reconnect_backoff_cap
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
-        self._parser: Optional[native.RespParser] = None
+        self._parser: Optional[proto.RespParser] = None
         self._pending: Deque[asyncio.Future] = collections.deque()
         self._read_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -102,7 +104,7 @@ class RespClient:
         await self._teardown_connection()
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), self.timeout)
-        parser = native.RespParser()
+        parser = proto.RespParser()
         self._reader, self._writer, self._parser = reader, writer, parser
         self._read_task = asyncio.ensure_future(
             self._read_loop(reader, writer, parser))
@@ -208,7 +210,7 @@ class RespClient:
         fut = asyncio.get_event_loop().create_future()
         self._pending.append(fut)
         try:
-            self._writer.write(native.resp_encode(*args))
+            self._writer.write(proto.resp_encode(*args))
             await self._writer.drain()
         except (ConnectionError, OSError) as e:
             try:
@@ -285,7 +287,7 @@ class RespClient:
         loop = asyncio.get_event_loop()
         futs = [loop.create_future() for _ in commands]
         self._pending.extend(futs)
-        self._writer.write(native.resp_encode_pipeline(commands))
+        self._writer.write(proto.resp_encode_pipeline(commands))
         await self._writer.drain()
         results = await asyncio.wait_for(
             asyncio.gather(*futs, return_exceptions=True),
@@ -454,7 +456,7 @@ class PubSubRespClient:
         self.timeout = timeout
         self.reconnect_backoff_cap = reconnect_backoff_cap
         self._writer: Optional[asyncio.StreamWriter] = None
-        self._parser: Optional[native.RespParser] = None
+        self._parser: Optional[proto.RespParser] = None
         self._read_task: Optional[asyncio.Task] = None
         self._reconnect_task: Optional[asyncio.Task] = None
         self._closed = False
@@ -485,7 +487,7 @@ class PubSubRespClient:
                 pass
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(self.host, self.port), self.timeout)
-        parser = native.RespParser()
+        parser = proto.RespParser()
         self._writer, self._parser = writer, parser
         if self.password is not None:
             # AUTH is request/response even pre-subscribe: consume its reply
@@ -493,7 +495,7 @@ class PubSubRespClient:
             # rejected password (a silent bad subscribe connection would
             # degrade every lock/semaphore wait to blind timeout polling).
             try:
-                writer.write(native.resp_encode("AUTH", self.password))
+                writer.write(proto.resp_encode("AUTH", self.password))
                 await writer.drain()
                 deadline = asyncio.get_event_loop().time() + self.timeout
                 reply = None
@@ -519,9 +521,9 @@ class PubSubRespClient:
             self._read_loop(reader, writer, parser))
         # Replay desired subscriptions (reconnect reattach).
         for ch in self._channels:
-            writer.write(native.resp_encode("SUBSCRIBE", ch))
+            writer.write(proto.resp_encode("SUBSCRIBE", ch))
         for p in self._patterns:
-            writer.write(native.resp_encode("PSUBSCRIBE", p))
+            writer.write(proto.resp_encode("PSUBSCRIBE", p))
         await writer.drain()
 
     async def _read_loop(self, reader, writer, parser) -> None:
@@ -612,7 +614,7 @@ class PubSubRespClient:
         listeners.append(listener)
         self._confirmed.setdefault(channel, asyncio.Event())
         if len(listeners) == 1 and self.connected:
-            self._writer.write(native.resp_encode("SUBSCRIBE", channel))
+            self._writer.write(proto.resp_encode("SUBSCRIBE", channel))
             await self._writer.drain()
         elif not self.connected:
             self._ensure_redial()
@@ -622,7 +624,7 @@ class PubSubRespClient:
         listeners.append(listener)
         self._confirmed.setdefault(pattern, asyncio.Event())
         if len(listeners) == 1 and self.connected:
-            self._writer.write(native.resp_encode("PSUBSCRIBE", pattern))
+            self._writer.write(proto.resp_encode("PSUBSCRIBE", pattern))
             await self._writer.drain()
         elif not self.connected:
             self._ensure_redial()
@@ -637,7 +639,7 @@ class PubSubRespClient:
             self._channels.pop(channel, None)
             self._confirmed.pop(channel, None)
             if self.connected:
-                self._writer.write(native.resp_encode("UNSUBSCRIBE", channel))
+                self._writer.write(proto.resp_encode("UNSUBSCRIBE", channel))
                 await self._writer.drain()
 
     async def punsubscribe(self, pattern: str, listener=None) -> None:
@@ -650,7 +652,7 @@ class PubSubRespClient:
             self._patterns.pop(pattern, None)
             self._confirmed.pop(pattern, None)
             if self.connected:
-                self._writer.write(native.resp_encode("PUNSUBSCRIBE", pattern))
+                self._writer.write(proto.resp_encode("PUNSUBSCRIBE", pattern))
                 await self._writer.drain()
 
     async def wait_subscribed(self, name: str, timeout: float) -> bool:

@@ -466,6 +466,30 @@ def register_trace(registry: MetricsRegistry, manager) -> None:
     registry.gauge("trace.retries", lambda: manager.retries)
 
 
+def register_wire(registry: MetricsRegistry, wire) -> None:
+    """Expose the RESP wire front-end (wire/) as wire.* gauges: connection
+    population, in-flight pipeline pressure, byte throughput, shed volume
+    and the connection-scheduler's window coalescing depth. `wire` is a
+    wire.server.WireServer or (cluster facade) ClusterWireFrontend — both
+    expose the same counters; the frontend sums across shard servers."""
+    def _snap(key, default=0):
+        return lambda: wire.snapshot().get(key, default)
+
+    registry.gauge("wire.connections", wire.connections)
+    registry.gauge("wire.connections_total", _snap("total_connections"))
+    registry.gauge("wire.inflight", wire.inflight)
+    registry.gauge("wire.bytes_in", _snap("bytes_in"))
+    registry.gauge("wire.bytes_out", _snap("bytes_out"))
+    registry.gauge("wire.commands", _snap("commands_total"))
+    registry.gauge("wire.engine_commands", _snap("engine_commands"))
+    registry.gauge("wire.sheds", _snap("sheds_total"))
+    registry.gauge("wire.redirects", _snap("redirects_rendered"))
+    registry.gauge("wire.windows", _snap("windows_flushed"))
+    registry.gauge("wire.pipeline_depth", _snap("last_window_depth"))
+    registry.gauge("wire.pipeline_depth_avg", _snap("avg_window_depth", 0.0))
+    registry.gauge("wire.dropped_conns", _snap("dropped_conns"))
+
+
 def register_memstat(registry: MetricsRegistry, ledger,
                      pressure=None) -> None:
     """Expose the memstat ledger as memstat.* gauges: exact live/peak

@@ -18,8 +18,11 @@ from redisson_tpu.serve.errors import (CircuitOpenError, DeadlineExceeded,
                                        ServeError)
 from redisson_tpu.serve.policy import AdaptiveBatchPolicy, CostModel
 from redisson_tpu.serve.scheduler import ServingLayer
+from redisson_tpu.serve.windows import ConnectionWindow, ReplySlot
 
 __all__ = [
+    "ConnectionWindow",
+    "ReplySlot",
     "AdmissionController",
     "TokenBucket",
     "BreakerBoard",

@@ -255,11 +255,17 @@ class ServingLayer:
     def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]],
                      tenant: Optional[str] = None,
                      deadline: Optional[float] = None,
-                     timeout_s: Optional[float] = None) -> List[Future]:
+                     timeout_s: Optional[float] = None,
+                     admitted_ats: Optional[Sequence[float]] = None
+                     ) -> List[Future]:
         """RBatch path: ONE admission decision + one deadline for the whole
         pipeline (the batch is the unit the caller budgets for). Breakers
         fast-fail the batch on any open kind but batches are not retried
-        (the reference re-sends whole pipelines; out of scope here)."""
+        (the reference re-sends whole pipelines; out of scope here).
+
+        `admitted_ats` forwards the wire tier's per-command socket-read
+        stamps to the executor's tracer handoff (SLOWLOG then attributes
+        network + wire-window queueing to the admission stage)."""
         now = self._clock()
         tenant = self._resolve_tenant(tenant)
         deadline = self._resolve_deadline(now, deadline, timeout_s)
@@ -302,7 +308,8 @@ class ServingLayer:
             return _fail_all(exc)
         self._registry.inc("serve.admitted_total")
         inner = self._executor.execute_many(staged, tenant=tenant,
-                                            deadline=deadline)
+                                            deadline=deadline,
+                                            admitted_ats=admitted_ats)
         remaining = [len(inner)]
         rlock = threading.Lock()
 

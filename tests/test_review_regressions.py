@@ -554,3 +554,41 @@ def test_journal_last_seq_final_after_fence(tmp_path):
     assert len(acked) <= w1
     assert j.durable_seq <= j.last_seq
     j.close()
+
+
+def test_linger_refill_does_not_strand_round_robin_entry():
+    """PR 16 regression: the adaptive-linger loop in _collect_run_locked
+    releases the executor lock on every cv.wait; each wakeup re-drains the
+    queue to empty, and a submitter refilling it during the NEXT wait
+    appends another copy of the target to the round-robin.  The old tail
+    logic removed only ONE copy before deleting the queue, leaving a stale
+    _ready entry whose queue was gone — the dispatcher's next pick died
+    with KeyError and every pending future hung forever.
+
+    Reproduction: serve-mode adaptive batching (linger on) with a single
+    submitter steadily refilling a small set of hot targets.  Pre-fix this
+    crashed the dispatcher within ~2000 ops."""
+    cfg = Config()
+    cfg.use_serve()
+    c = RedissonTPU(cfg)
+    try:
+        drain_every = 128
+        pending = []
+        for i in range(4000):
+            if i % 2 == 0:
+                h = c.get_hyper_log_log(f"lr:hll{i % 8}")
+                pending.append(h.add_all_async([f"v{i}", f"w{i}"]))
+            else:
+                b = c.get_bit_set(f"lr:bits{i % 4}")
+                pending.append(b.set_bits_async([i % 512]))
+            if len(pending) >= drain_every:
+                for f in pending:
+                    # A stranded round-robin entry kills the dispatcher and
+                    # this times out instead of hanging the suite.
+                    f.result(timeout=60)
+                pending.clear()
+        for f in pending:
+            f.result(timeout=60)
+        assert c.get_hyper_log_log("lr:hll0").count() > 0
+    finally:
+        c.shutdown()

@@ -303,6 +303,29 @@ def test_g006_scoped_to_dispatch_and_serve_paths():
     assert "G006" not in rules_of(cold)
 
 
+def test_g006_g009_scoped_to_wire():
+    """The wire tier is hot-path: an untimed .result() would park the event
+    loop for every connection, and a time.time() stamp would poison the
+    admitted_at duration math — both scopes cover redisson_tpu/wire/."""
+    block_src = """
+        def wait(f):
+            return f.result()
+    """
+    clock_src = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    wire = os.path.join(REPO, "redisson_tpu", "wire", "server.py")
+    blocked = FileLinter(wire, repo_root=REPO,
+                         source=textwrap.dedent(block_src)).run()
+    clocked = FileLinter(wire, repo_root=REPO,
+                         source=textwrap.dedent(clock_src)).run()
+    assert "G006" in rules_of(blocked)
+    assert "G009" in rules_of(clocked)
+
+
 def test_g006_suppression_with_reason():
     findings = lint_src("""
         def wait(f):
@@ -913,6 +936,45 @@ def test_g011_unlocked_access_to_registered_attr():
     assert rules_of(findings) == ["G011"]
     assert len(findings) == 1
     assert "Box.items" in findings[0].message
+
+
+def test_tier_c_wire_window_discipline_seeded():
+    """The wire reply window's GUARDED_BY contract is enforceable: dropping
+    the lock around the slots deque is a G011 — the same table
+    serve/windows.py registers for the real ConnectionWindow."""
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"ConnectionWindow._slots": "_lock"}
+
+        class ConnectionWindow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._slots = []
+
+            def drain(self):
+                out = list(self._slots)
+                return out
+
+            def complete(self, data):
+                with self._lock:
+                    self._slots.append(data)
+    """)
+    assert "G011" in rules_of(findings)
+
+
+def test_tier_c_wire_files_in_scope():
+    """serve/windows.py and wire/server.py must stay under Tier C analysis
+    (they import the concurrency seam / threading) — a refactor that drops
+    them out of scope silently un-checks the wire tier's shared state."""
+    import ast as _ast
+    for rel in (os.path.join("redisson_tpu", "serve", "windows.py"),
+                os.path.join("redisson_tpu", "wire", "server.py")):
+        path = os.path.join(REPO, rel)
+        linter = ConcurrencyLinter(path, repo_root=REPO, explicit=False)
+        with open(path) as f:
+            tree = _ast.parse(f.read())
+        assert linter.in_scope(tree), rel
 
 
 def test_g011_locked_suffix_convention():

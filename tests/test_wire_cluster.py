@@ -1,0 +1,219 @@
+"""Cluster-mode wire front-end: per-shard servers, -MOVED/-ASK
+rendering, CLUSTER introspection, and redirect-following across a live
+slot migration."""
+
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.config import Config
+from redisson_tpu.interop.resp_client import SyncRespClient
+from redisson_tpu.ops.crc16 import key_slot
+from redisson_tpu.wire import proto
+from redisson_tpu.wire.server import ShardWireContext
+
+
+def _cluster_wire(tmp_path, num_shards=2):
+    cfg = Config()
+    cfg.use_cluster(num_shards=num_shards, dir=str(tmp_path / "cl"))
+    cfg.use_wire()
+    return RedissonTPU.create(cfg)
+
+
+def _connect_addr(addr):
+    host, _, port = addr.rpartition(":")
+    cli = SyncRespClient(host or "127.0.0.1", int(port), retry_attempts=1)
+    cli.connect()
+    return cli
+
+
+def _key_owned_by(table, shard_id, prefix="wk"):
+    i = 0
+    while True:
+        k = f"{prefix}{i}"
+        if table[key_slot(k)] == shard_id:
+            return k
+        i += 1
+
+
+def _parse_redirect(exc):
+    """'MOVED 8579 127.0.0.1:4447' -> (kind, slot, addr)."""
+    kind, slot, addr = str(exc).split()
+    return kind, int(slot), addr
+
+
+class TestClusterWire:
+    def test_moved_redirect_is_followable(self, tmp_path):
+        c = _cluster_wire(tmp_path)
+        try:
+            table = c.cluster.router.slot_table()
+            key = _key_owned_by(table, 1)
+            slot = key_slot(key)
+
+            wrong = _connect_addr(c.wire.addr_of(0))
+            try:
+                with pytest.raises(proto.RespError) as ei:
+                    wrong.execute("PFADD", key, "a", "b")
+                kind, got_slot, addr = _parse_redirect(ei.value)
+                assert kind == "MOVED"
+                assert got_slot == slot
+                assert addr == c.wire.addr_of(1)
+            finally:
+                wrong.close()
+
+            # A redirect-following client lands on the owner and succeeds.
+            right = _connect_addr(addr)
+            try:
+                assert right.execute("PFADD", key, "a", "b") == 1
+                assert right.execute("PFCOUNT", key) == 2
+            finally:
+                right.close()
+            # State is visible through the facade too.
+            assert c.get_hyper_log_log(key).count() == 2
+        finally:
+            c.shutdown()
+
+    def test_cluster_introspection_over_wire(self, tmp_path):
+        c = _cluster_wire(tmp_path)
+        try:
+            cli = _connect_addr(c.wire.addr_of(0))
+            try:
+                assert cli.execute("CLUSTER", "KEYSLOT", "foo") == key_slot(
+                    b"foo"
+                )
+                info = cli.execute("CLUSTER", "INFO")
+                assert b"cluster_enabled:1" in info
+                assert b"cluster_state:ok" in info
+
+                slots = cli.execute("CLUSTER", "SLOTS")
+                assert slots
+                covered = set()
+                for entry in slots:
+                    start, end, master = entry[0], entry[1], entry[2]
+                    covered.update(range(start, end + 1))
+                    host, port = master[0], master[1]
+                    sid = int(master[2].split(b"-")[-1])
+                    assert c.wire.addr_of(sid) == (
+                        f"{host.decode()}:{port}"
+                    )
+                assert covered == set(range(16384))
+
+                # HELLO reports cluster mode on a shard server.
+                h = cli.execute("HELLO", "2")
+                flat = dict(zip(h[::2], h[1::2]))
+                assert flat[b"mode"] == b"cluster"
+            finally:
+                cli.close()
+        finally:
+            c.shutdown()
+
+    def test_live_migration_moves_ownership_on_the_wire(self, tmp_path):
+        c = _cluster_wire(tmp_path)
+        try:
+            table = c.cluster.router.slot_table()
+            key = _key_owned_by(table, 0, prefix="mig")
+            slot = key_slot(key)
+
+            old = _connect_addr(c.wire.addr_of(0))
+            try:
+                assert old.execute("PFADD", key, "x", "y", "z") == 1
+                before = old.execute("PFCOUNT", key)
+
+                c.cluster.migrate_slots([slot], 1)
+
+                # The old owner now bounces the key to shard 1...
+                with pytest.raises(proto.RespError) as ei:
+                    old.execute("PFCOUNT", key)
+                kind, got_slot, addr = _parse_redirect(ei.value)
+                assert kind == "MOVED"
+                assert got_slot == slot
+                assert addr == c.wire.addr_of(1)
+            finally:
+                old.close()
+
+            # ...and the new owner serves the migrated value.
+            new = _connect_addr(c.wire.addr_of(1))
+            try:
+                assert new.execute("PFCOUNT", key) == before
+            finally:
+                new.close()
+            snap = c.wire.snapshot()
+            assert snap["redirects_rendered"] >= 1
+        finally:
+            c.shutdown()
+
+    def test_wire_frontend_snapshot_sums_shards(self, tmp_path):
+        c = _cluster_wire(tmp_path)
+        try:
+            cli = _connect_addr(c.wire.addr_of(0))
+            try:
+                cli.execute("PING")
+            finally:
+                cli.close()
+            snap = c.wire.snapshot()
+            assert snap["shards"] == 2
+            assert snap["commands_total"] >= 1
+        finally:
+            c.shutdown()
+
+
+class TestAskRendering:
+    """-ASK rendering pinned against stub cluster state: the router parks
+    the slot in its cutover window while the importing shard's guard
+    carries the migrate mark."""
+
+    class _StubGuard:
+        def __init__(self, slots):
+            self._slots = set(slots)
+
+        def migrating_slots(self):
+            return self._slots
+
+    class _StubShard:
+        def __init__(self, slots):
+            self.guard = TestAskRendering._StubGuard(slots)
+
+    class _StubRouter:
+        def __init__(self, table, ask):
+            self._table = table
+            self._ask = frozenset(ask)
+
+        def slot_table(self):
+            return self._table
+
+        def ask_slots(self):
+            return self._ask
+
+    class _StubManager:
+        def __init__(self, table, ask, importing):
+            self.router = TestAskRendering._StubRouter(table, ask)
+            self.shards = {
+                0: TestAskRendering._StubShard(()),
+                1: TestAskRendering._StubShard(importing),
+            }
+
+    def _ctx(self, ask=(), importing=()):
+        table = [0] * 16384
+        table[5] = 1  # slot 5 owned elsewhere
+        ctx = ShardWireContext(0, self._StubManager(table, ask, importing))
+        ctx.addrs = {0: "127.0.0.1:7000", 1: "127.0.0.1:7001"}
+        return ctx
+
+    def test_ask_during_cutover_window(self):
+        ctx = self._ctx(ask={7}, importing={7})
+        assert ctx.redirect_for(7) == proto.ask(7, "127.0.0.1:7001")
+
+    def test_moved_for_foreign_slot(self):
+        ctx = self._ctx()
+        assert ctx.redirect_for(5) == proto.moved(5, "127.0.0.1:7001")
+
+    def test_owned_slot_passes(self):
+        ctx = self._ctx()
+        assert ctx.redirect_for(42) is None
+
+    def test_ask_addr_prefers_import_target(self):
+        ctx = self._ctx(ask={7}, importing={7})
+        assert ctx.ask_addr(7) == "127.0.0.1:7001"
+        # Without an importing shard the ask address degrades to the
+        # table owner (slot 7 is still owned by shard 0 mid-cutover).
+        ctx2 = self._ctx(ask={7})
+        assert ctx2.ask_addr(7) == "127.0.0.1:7000"

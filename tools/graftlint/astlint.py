@@ -16,9 +16,11 @@ failure modes (see findings.RULES). Scope notes:
   helper calls and module-level named-constant assignments.
 * G005 only fires in files that import ``jax.experimental.pallas``.
 * G006 (block) only applies to the dispatch/serve paths under
-  ``redisson_tpu/`` (executor.py, routing.py, serve/) — unless the file
-  was passed explicitly. The models' sync facades are the *documented*
-  blocking API and stay out of scope.
+  ``redisson_tpu/`` (executor.py, routing.py, serve/, wire/) — unless the
+  file was passed explicitly. The models' sync facades are the *documented*
+  blocking API and stay out of scope; the wire server's event loop must
+  never park on an untimed ``.result()`` (one wedged future would stall
+  every connection), so wire/ is in scope.
 * G008 (bare) applies to the device/persist fault boundaries under
   ``redisson_tpu/`` (top-level ``backend*`` files, ``parallel/backend*``,
   executor.py, persist/) — unless the file was passed explicitly; the
@@ -30,8 +32,9 @@ failure modes (see findings.RULES). Scope notes:
   Handlers that deliberately swallow (completer isolation, background
   fsync backstops) carry reasoned ``allow-bare`` suppressions.
 * G009 (wallclock) applies to the latency-measuring paths under
-  ``redisson_tpu/`` (executor.py, serve/, persist/, trace/) — unless the
-  file was passed explicitly. ``time.time()`` there poisons duration math
+  ``redisson_tpu/`` (executor.py, serve/, persist/, trace/, wire/ — the
+  wire tier stamps admitted_at at socket read, which feeds span duration
+  math) — unless the file was passed explicitly. ``time.time()`` there poisons duration math
   (NTP steps, slew); durations must come from ``time.monotonic()``.
   Display-only wall timestamps (e.g. the slowlog's human-readable entry
   time) carry reasoned ``allow-wallclock`` suppressions.
@@ -219,6 +222,7 @@ class FileLinter:
         return (
             sub in ("executor.py", "routing.py")
             or sub.startswith("serve/")
+            or sub.startswith("wire/")
         )
 
     def _in_fault_scope(self) -> bool:
@@ -243,6 +247,7 @@ class FileLinter:
             or sub.startswith("serve/")
             or sub.startswith("persist/")
             or sub.startswith("trace/")
+            or sub.startswith("wire/")
         )
 
     def _in_journal_scope(self) -> bool:
