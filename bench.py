@@ -1095,10 +1095,14 @@ def bench_wire(quick=False):
     """RESP wire front-end (PR 16): pipelined command throughput over a
     real TCP socket, single-command round-trip p99, and the connection
     scheduler's achieved coalescing depth (engine ops per execute_many
-    window — the wire analogue of the pipeline overlap ratio)."""
+    window — the wire analogue of the pipeline overlap ratio). Also
+    force-arms the loop-stall witness (PR 17) so BENCH json carries
+    loop_lag_p99_us next to wire_rtt_p99_us: tail latency attributable
+    to loop stalls vs engine time."""
     from redisson_tpu.client import RedissonTPU
     from redisson_tpu.config import Config
     from redisson_tpu.interop.resp_client import SyncRespClient
+    from redisson_tpu.loopwitness import loop_gauges, uninstall, watch_loop
 
     n_cmds = 2_000 if quick else 20_000
     depth = 64
@@ -1110,6 +1114,7 @@ def bench_wire(quick=False):
     c = RedissonTPU(cfg)
     out = {}
     try:
+        watch_loop(c.wire._loop, "bench-wire", force=True)
         cli = SyncRespClient("127.0.0.1", c.wire.port,
                              retry_attempts=1, timeout=30.0)
         cli.connect()
@@ -1136,12 +1141,16 @@ def bench_wire(quick=False):
             out["wire_ops_per_sec"] = round(sent / wall, 1)
             out["wire_pipeline_depth"] = round(
                 c.wire.snapshot()["avg_window_depth"], 2)
+            out["loop_lag_p99_us"] = loop_gauges(
+                c.wire._loop)["loop_lag_p99_us"]
         finally:
             cli.close()
     finally:
         c.shutdown()
+        uninstall()  # restore Handle._run for the rest of the bench
     print(f"# wire: {out['wire_ops_per_sec']:,.0f} pipelined ops/s, "
           f"rtt p99 {out['wire_rtt_p99_us']:.0f} us, "
+          f"loop lag p99 {out['loop_lag_p99_us']} us, "
           f"window depth {out['wire_pipeline_depth']}", file=sys.stderr)
     return out
 

@@ -2655,6 +2655,147 @@ def wire_smoke():
     return ok
 
 
+#: clean-run loop-lag p99 budget for --aio-smoke, in ms. Observed ~25ms
+#: p99 on a loaded CPU-CI engine (GIL contention with executor threads);
+#: the gate catches order-of-magnitude regressions — an accidental sync
+#: engine call or fsync landing on the wire loop, exactly what graftlint
+#: G015 proves absent statically.
+AIO_LAG_BUDGET_MS = 100.0
+
+
+def aio_smoke():
+    """Event-loop discipline smoke — the runtime half of graftlint Tier D.
+
+    Runs the wire pipelined workload in-process under the loop-stall
+    witness (REDISSON_TPU_LOOP_WITNESS=1) and gates on:
+
+      1. clean phase: pipelined PFADD/SETBIT load (post-warmup) keeps the
+         wire loop's lag p99 under AIO_LAG_BUDGET_MS, and the witness saw
+         real traffic (heartbeats + WireServer callback sites);
+      2. injected phase: a FaultRule(seam="wire_conn", fault="stall",
+         delay_s=0.08) sleeps 80ms inside the connection read loop — the
+         MERGED witness snapshot must attribute a >=60ms stall to the
+         WireServer._handle coroutine (site-level attribution, not just
+         "the loop was slow"), and wire.loop_stalls must tick.
+    """
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.fault import inject
+    from redisson_tpu.fault.inject import (FaultInjector, FaultPlan,
+                                           FaultRule)
+    from redisson_tpu.interop.resp_client import SyncRespClient
+    from redisson_tpu.loopwitness import (ENV_FLAG, loop_witness_reset,
+                                          loop_witness_snapshot,
+                                          merge_loop_snapshots, uninstall)
+
+    depth = 64
+    n_cmds = max(_scale(2048), 512)
+    prior_flag = os.environ.get(ENV_FLAG)
+    os.environ[ENV_FLAG] = "1"  # before the wire server starts its loop
+
+    cfg = Config()
+    cfg.use_serve()
+    cfg.use_wire()
+    ok = True
+    c = RedissonTPU(cfg)
+    try:
+        loop_name = f"wire:127.0.0.1:{c.wire.port}"
+        cli = SyncRespClient("127.0.0.1", c.wire.port,
+                             retry_attempts=1, timeout=30.0)
+        cli.connect()
+
+        def load(prefix, count):
+            for base in range(0, count, depth):
+                cmds = []
+                for i in range(base, min(base + depth, count)):
+                    if i % 2 == 0:
+                        cmds.append(("PFADD", f"{prefix}h{i % 8}",
+                                     f"v{i}a", f"v{i}b"))
+                    else:
+                        cmds.append(("SETBIT", f"{prefix}b", str(i % 512),
+                                     "1"))
+                cli.pipeline(cmds)
+
+        # untimed warmup: jit + codec compile paths must not count as lag
+        load("aio:warm:", 256)
+        loop_witness_reset()
+
+        # -- phase 1: clean load under the witness ------------------------
+        load("aio:", n_cmds)
+        clean = loop_witness_snapshot()
+        cdata = clean["loops"].get(loop_name)
+        if cdata is None:
+            print(f"# aio-smoke: loop {loop_name!r} not in witness "
+                  f"snapshot ({list(clean['loops'])})", file=sys.stderr)
+            ok = False
+            cdata = {"lag": {"beats": 0, "p99_s": 0.0}, "callbacks": {},
+                     "stalls": []}
+        lag_p99_ms = cdata["lag"]["p99_s"] * 1e3
+        wire_sites = [s for s in cdata["callbacks"] if "WireServer" in s]
+        if cdata["lag"]["beats"] < 10 or not wire_sites:
+            print(f"# aio-smoke: witness saw no traffic (beats="
+                  f"{cdata['lag']['beats']}, wire sites={wire_sites})",
+                  file=sys.stderr)
+            ok = False
+        if lag_p99_ms > AIO_LAG_BUDGET_MS:
+            print(f"# aio-smoke: clean loop-lag p99 {lag_p99_ms:.1f}ms "
+                  f"over the {AIO_LAG_BUDGET_MS:.0f}ms budget",
+                  file=sys.stderr)
+            ok = False
+
+        # -- phase 2: injected 80ms stall must be attributed --------------
+        loop_witness_reset()
+        inj = FaultInjector(FaultPlan(rules=[
+            FaultRule(seam="wire_conn", fault="stall", nth=1, times=1,
+                      delay_s=0.08)]))
+        inject.install(inj)
+        try:
+            assert cli.execute("PING") == b"PONG"
+        finally:
+            inject.uninstall()
+        stalled = loop_witness_snapshot()
+        merged = merge_loop_snapshots([clean, stalled])
+        mdata = merged["loops"].get(loop_name, {"stalls": []})
+        attributed = [s for s in mdata["stalls"]
+                      if "_handle" in s["site"] and s["ms"] >= 60.0]
+        if not attributed:
+            print(f"# aio-smoke: injected 80ms stall NOT attributed to "
+                  f"_handle; stall log: {mdata['stalls'][:5]}",
+                  file=sys.stderr)
+            ok = False
+        snap = c.wire.snapshot()
+        if snap["loop_stalls"] < 1:
+            print(f"# aio-smoke: wire.loop_stalls gauge did not tick "
+                  f"({snap['loop_stalls']})", file=sys.stderr)
+            ok = False
+        cli.close()
+    finally:
+        c.shutdown()
+        uninstall()
+        if prior_flag is None:
+            os.environ.pop(ENV_FLAG, None)
+        else:
+            os.environ[ENV_FLAG] = prior_flag
+
+    result = {
+        "commands": n_cmds,
+        "pipeline_depth": depth,
+        "lag_budget_ms": AIO_LAG_BUDGET_MS,
+        "clean_lag_p99_ms": round(lag_p99_ms, 3),
+        "clean_lag_beats": cdata["lag"]["beats"],
+        "wire_callback_sites": len(wire_sites),
+        "injected_stall_ms": 80.0,
+        "attributed_stalls": attributed[:3],
+        "loop_stalls_gauge": snap["loop_stalls"],
+    }
+    print(json.dumps({"aio_smoke": result}), flush=True)
+    print(f"# aio-smoke: {'PASS' if ok else 'FAIL'} — clean lag p99 "
+          f"{lag_p99_ms:.1f}ms (budget {AIO_LAG_BUDGET_MS:.0f}ms), "
+          f"{len(attributed)} attributed stall(s) "
+          f"{[s['site'] for s in attributed[:1]]}", file=sys.stderr)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -2742,6 +2883,13 @@ def main():
                          "the same vectors through the facade, and wire "
                          "throughput >= 0.5x the direct-facade rate, "
                          "then exit")
+    ap.add_argument("--aio-smoke", action="store_true",
+                    help="event-loop discipline smoke: wire pipelined "
+                         "load under REDISSON_TPU_LOOP_WITNESS=1 — clean "
+                         "loop-lag p99 under budget, and an injected "
+                         "80ms wire_conn stall attributed to its "
+                         "_handle call site in the merged witness "
+                         "snapshot, then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -2773,6 +2921,9 @@ def main():
     if args.wire_smoke:
         sys.exit(0 if wire_smoke() else 1)
 
+    if args.aio_smoke:
+        sys.exit(0 if aio_smoke() else 1)
+
     if args.cluster_smoke:
         sys.exit(0 if cluster_smoke() else 1)
 
@@ -2789,16 +2940,26 @@ def main():
         sys.exit(0 if trace_smoke() else 1)
 
     if args.lint_smoke:
-        from tools.graftlint import run_lint
+        from tools.graftlint.cli import collect_tiers
 
         targets = [os.path.join(REPO, "redisson_tpu"),
                    os.path.join(REPO, "benchmarks"),
                    os.path.join(REPO, "bench.py")]
-        dicts = run_lint(targets, jaxpr=False)
+        dicts, tiers = collect_tiers(targets, jaxpr=False)
         for d in dicts:
             print(f"{d['file']}:{d['line']}: {d['rule']} {d['message']}")
-        print(f"# lint-smoke: {len(dicts)} finding(s)", file=sys.stderr)
-        sys.exit(1 if dicts else 0)
+        # Tier D must be present AND clean: the asyncio tier is the most
+        # traffic-exposed subsystem, so a lint run that silently skipped
+        # it (import failure, scope regression) must fail the gate.
+        tier_d = tiers.get("tier_d")
+        bad_tier_d = (tier_d is None or tier_d.get("modules", 0) < 1
+                      or any(tier_d.get("rules", {"": 1}).values()))
+        if bad_tier_d:
+            print(f"# lint-smoke: tier_d missing/unclean: {tier_d}",
+                  file=sys.stderr)
+        print(f"# lint-smoke: {len(dicts)} finding(s); tier_d="
+              f"{tier_d}", file=sys.stderr)
+        sys.exit(1 if (dicts or bad_tier_d) else 0)
 
     global _INGEST
     _INGEST = args.ingest

@@ -33,6 +33,26 @@ from typing import Any, Callable, List, Optional, Sequence
 from redisson_tpu.interop.resp_client import ConnectionClosed, RespClient
 
 
+# graftlint Tier D (G017): every key below is owned by the pool's private
+# event-loop thread — mutations must come from coroutine/callback context
+# on that loop; the blocking facade marshals through
+# run_coroutine_threadsafe/call_soon_threadsafe. The var-based
+# `_pool.*` keys cover the RespConnectionPool facade's reach-ins.
+LOOP_CONFINED = {
+    "_AsyncPool._conns": "live-connection list",
+    "_AsyncPool._listeners": "connect/disconnect listener fan-out list",
+    "_AsyncPool._failures": "consecutive connect-failure counter",
+    "_AsyncPool._frozen": "endpoint freeze latch",
+    "_AsyncPool._probe_task": "re-probe loop task ref",
+    "_AsyncPool._reaper_task": "idle-reaper loop task ref",
+    "_AsyncPool._bg_tasks": "held refs for fire-and-forget closes",
+    "_AsyncPool._closed": "pool shutdown latch",
+    "_AsyncPool._last_used": "idle-reap bookkeeping",
+    "_pool._listeners": "facade view of the listener list",
+    "_pool._conns": "facade view of the connection list",
+}
+
+
 class EndpointFrozen(ConnectionError):
     """The endpoint accumulated failed_attempts connect failures and is
     frozen; the re-probe loop will unfreeze it when PING succeeds."""
@@ -66,6 +86,10 @@ class _AsyncPool:
         self.reaped = 0  # observability: idle connections retired
         self._reaper_task: Optional[asyncio.Task] = None
         self._last_used: dict = {}  # id(conn) -> monotonic seconds
+        # Strong refs for fire-and-forget close() tasks: the loop keeps
+        # only a weak reference to a task, so without these the GC can
+        # collect a close mid-flight and leak the socket (graftlint G016).
+        self._bg_tasks: set = set()
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -105,12 +129,18 @@ class _AsyncPool:
                         self._conns.remove(conn)
                         self._last_used.pop(id(conn), None)
                         self.reaped += 1
-                        asyncio.ensure_future(conn.close())
+                        self._close_later(conn)
 
     def _touch(self, conn: RespClient) -> None:
         import time as _time
 
         self._last_used[id(conn)] = _time.monotonic()
+
+    def _close_later(self, conn: RespClient) -> None:
+        """Fire-and-forget close with a held reference (G016 fix)."""
+        task = asyncio.ensure_future(conn.close())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     async def _dial_one(self, register: bool = True) -> RespClient:
         """Dial a fresh connection; register=False keeps it OUT of the
@@ -217,7 +247,7 @@ class _AsyncPool:
             self._conns.append(conn)
             self._touch(conn)
         else:
-            asyncio.ensure_future(conn.close())
+            self._close_later(conn)
 
     # -- ops ----------------------------------------------------------------
 
@@ -284,6 +314,10 @@ class _AsyncPool:
             except Exception:  # noqa: BLE001
                 pass
         self._conns.clear()
+        if self._bg_tasks:
+            await asyncio.gather(*tuple(self._bg_tasks),
+                                 return_exceptions=True)
+            self._bg_tasks.clear()
 
     @property
     def live_count(self) -> int:
@@ -306,6 +340,10 @@ class RespConnectionPool:
             target=self._loop.run_forever, name="rtpu-pool-io", daemon=True)
         self._thread.start()
         self._pool = _AsyncPool(host, port, **kwargs)
+        # loop-stall witness (no-op unless REDISSON_TPU_LOOP_WITNESS=1)
+        from redisson_tpu.loopwitness import watch_loop
+
+        watch_loop(self._loop, f"pool:{host}:{port}")
 
     def _run(self, coro, timeout: float = 60.0):
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
@@ -342,8 +380,15 @@ class RespConnectionPool:
         return self._run(self._pool.pipeline(commands), timeout=120.0)
 
     def add_listener(self, fn: Callable[[str], None]) -> None:
-        """Events: connect / freeze / unfreeze (ConnectionEventsHub)."""
-        self._pool._listeners.append(fn)
+        """Events: connect / freeze / unfreeze (ConnectionEventsHub).
+
+        The listener list is loop-confined (`_fire` iterates it on the
+        pool's IO thread); appending from the caller's thread raced the
+        iteration (graftlint G017). call_soon_threadsafe keeps the loop
+        the single writer, and FIFO ordering means the listener is
+        registered before any event fired after this call returns to the
+        loop."""
+        self._loop.call_soon_threadsafe(self._pool._listeners.append, fn)
 
     @property
     def live_count(self) -> int:
@@ -366,6 +411,9 @@ class RespConnectionPool:
         return self._loop.is_closed()
 
     def close(self) -> None:
+        from redisson_tpu.loopwitness import unwatch_loop
+
+        unwatch_loop(self._loop)
         try:
             self._run(self._pool.close())
         finally:

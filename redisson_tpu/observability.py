@@ -488,6 +488,10 @@ def register_wire(registry: MetricsRegistry, wire) -> None:
     registry.gauge("wire.pipeline_depth", _snap("last_window_depth"))
     registry.gauge("wire.pipeline_depth_avg", _snap("avg_window_depth", 0.0))
     registry.gauge("wire.dropped_conns", _snap("dropped_conns"))
+    # loop-stall witness feed: zeros unless REDISSON_TPU_LOOP_WITNESS=1
+    # armed the witness for this server's loop
+    registry.gauge("wire.loop_lag_p99_us", _snap("loop_lag_p99_us"))
+    registry.gauge("wire.loop_stalls", _snap("loop_stalls"))
 
 
 def register_memstat(registry: MetricsRegistry, ledger,

@@ -15,6 +15,10 @@ frame (RejectedError semantics) without ever touching admission.
 Thread model: slots are reserved on the wire event loop; completions may
 arrive from executor/completer threads (future done-callbacks), so the
 deque is lock-guarded. ``drain()`` is called from the event loop only.
+The reservation order itself (``_next_seq``) is loop-affine on top of
+that: only the wire loop reserves, so the sequence is dense in socket
+arrival order — declared in ``LOOP_CONFINED`` below so graftlint Tier D
+(G017) flags any future reservation path rooted off the loop.
 """
 
 from __future__ import annotations
@@ -33,6 +37,16 @@ GUARDED_BY = {
     "ReplySlot.data": "thread:written once by the completing thread, read "
                       "by drain() only after the lock-guarded done flag "
                       "flips under ConnectionWindow._lock",
+}
+
+# The lock above covers cross-thread completion/introspection; the
+# reservation counter additionally has a single sanctioned writer — the
+# wire event loop. Tier D (G017) enforces that no Thread target or
+# done-callback ever reserves a slot directly.
+LOOP_CONFINED = {
+    "ConnectionWindow._next_seq": "reply-order sequence; wire-loop "
+                                  "reservation paths only "
+                                  "(try_reserve/reserve_immediate)",
 }
 
 

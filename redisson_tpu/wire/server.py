@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from redisson_tpu.cluster.errors import (SlotAskError, SlotMovedError,
                                          render_redirect)
 from redisson_tpu.fault.inject import fire
+from redisson_tpu.loopwitness import loop_gauges, unwatch_loop, watch_loop
 from redisson_tpu.ops.crc16 import key_slot
 from redisson_tpu.serve.errors import (CircuitOpenError, DeadlineExceeded,
                                        RejectedError)
@@ -80,6 +81,34 @@ GUARDED_BY = {
     "_WireConn.proto_ver": "thread:event-loop confined",
     "_WireConn.authed": "thread:event-loop confined",
     "_WireConn.name": "thread:event-loop confined",
+}
+
+# Tier D enforcement of the "thread:event-loop confined" prose above:
+# graftlint G017 checks that every mutation of these keys happens from
+# loop context (async handlers, call_soon targets, and their same-class
+# callees). lifecycle= names the sync methods allowed to touch a field
+# strictly before the first / after the last loop callback. The var-based
+# `conn.*` keys cover WireServer's mutations of its per-connection
+# _WireConn helpers.
+LOOP_CONFINED = {
+    "WireServer._conns": "accepted-connection set",
+    "WireServer._pending_ops": "flush staging buffer",
+    "WireServer._pending_ats": "flush staging buffer",
+    "WireServer._pending_targets": "flush staging buffer",
+    "WireServer._flush_scheduled": "call_soon(_flush) dedup flag",
+    "WireServer._accepts_admitted": "execute_many signature probe cache",
+    "WireServer._server": "asyncio listener; lifecycle=start,stop",
+    "WireServer._loop": "private loop handle; lifecycle=start,stop",
+    "WireServer._thread": "loop thread handle; lifecycle=start,stop",
+    "WireServer.port": "bound port; lifecycle=start,stop",
+    "_WireConn.closing": "kill() latch",
+    "_WireConn.proto_ver": "RESP protocol version",
+    "_WireConn.authed": "AUTH state",
+    "_WireConn.name": "CLIENT SETNAME identity",
+    "conn.closing": "kill() latch (WireServer's view)",
+    "conn.proto_ver": "RESP protocol version (WireServer's view)",
+    "conn.authed": "AUTH state (WireServer's view)",
+    "conn.name": "CLIENT SETNAME identity (WireServer's view)",
 }
 
 _conn_ids = itertools.count(1)
@@ -203,6 +232,10 @@ class WireServer:
         except Exception:
             self.stop()
             raise
+        # Loop-stall witness (no-op unless REDISSON_TPU_LOOP_WITNESS=1):
+        # feeds wire.loop_lag_p99_us / wire.loop_stalls and the
+        # --aio-smoke gate's stall attribution.
+        watch_loop(self._loop, f"wire:{self.host}:{self.port}")
 
     async def _bind(self) -> None:
         self._server = await asyncio.start_server(
@@ -215,6 +248,7 @@ class WireServer:
         loop, self._loop = self._loop, None
         if loop is None:
             return
+        unwatch_loop(loop)
         try:
             asyncio.run_coroutine_threadsafe(
                 self._shutdown(), loop).result(10.0)
@@ -741,6 +775,8 @@ class WireServer:
             "avg_window_depth": (self.ops_flushed
                                  / max(1, self.windows_flushed)),
             "dropped_conns": self.dropped_conns,
+            # zeros unless the loop-stall witness is watching this loop
+            **loop_gauges(self._loop),
         }
 
 

@@ -1,0 +1,165 @@
+"""Runtime loop-stall witness (PR 17): install/uninstall hygiene, stall
+attribution to call sites, gauges, reset, and snapshot merging.
+
+The witness mirrors the lock witness from the Tier C work: a single
+monkeypatch of asyncio's Handle._run, per-callback hold times with
+deterministic p99 sampling, and a heartbeat that measures scheduling lag
+— the user-visible symptom of a blocked loop."""
+
+import asyncio
+import asyncio.events
+import copy
+import threading
+import time
+
+import pytest
+
+from redisson_tpu import loopwitness as lw
+
+
+@pytest.fixture
+def io_loop():
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield loop
+    lw.uninstall()
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+    loop.close()
+    lw.loop_witness_reset()
+
+
+def _spin(loop):
+    """One loop round-trip so queued callbacks have run."""
+    asyncio.run_coroutine_threadsafe(asyncio.sleep(0), loop).result(5)
+
+
+def test_witness_disabled_by_default(io_loop, monkeypatch):
+    monkeypatch.delenv(lw.ENV_FLAG, raising=False)
+    orig = asyncio.events.Handle._run
+    assert lw.watch_loop(io_loop, "off") is False
+    assert asyncio.events.Handle._run is orig  # nothing patched
+    assert lw.loop_gauges(io_loop) == {"loop_lag_p99_us": 0,
+                                       "loop_stalls": 0}
+
+
+def test_witness_stall_attribution_and_uninstall(io_loop):
+    orig = asyncio.events.Handle._run
+    assert lw.watch_loop(io_loop, "unit", force=True) is True
+    assert asyncio.events.Handle._run is not orig
+
+    def block_the_loop():
+        time.sleep(0.05)  # > 20ms default stall threshold
+
+    _spin(io_loop)
+    io_loop.call_soon_threadsafe(block_the_loop)
+    _spin(io_loop)
+
+    gauges = lw.loop_gauges(io_loop)
+    assert gauges["loop_stalls"] >= 1
+    assert gauges["loop_lag_p99_us"] >= 0
+
+    snap = lw.loop_witness_snapshot()
+    assert "unit" in snap["loops"]
+    stats = snap["loops"]["unit"]
+    assert any("block_the_loop" in s["site"] and s["ms"] >= 40.0
+               for s in stats["stalls"]), stats["stalls"]
+    assert any(site.startswith("cb:") and "block_the_loop" in site
+               for site in stats["callbacks"]), list(stats["callbacks"])
+    assert stats["stall_threshold_ms"] == pytest.approx(20.0)
+
+    # uninstall restores the pristine Handle._run and forgets the loop
+    lw.uninstall()
+    assert asyncio.events.Handle._run is orig
+    assert lw._ORIG_RUN is None
+    assert lw.loop_gauges(io_loop) == {"loop_lag_p99_us": 0,
+                                       "loop_stalls": 0}
+
+
+def test_witness_task_sites_and_heartbeat(io_loop):
+    assert lw.watch_loop(io_loop, "hb", force=True)
+
+    async def worker():
+        for _ in range(3):
+            await asyncio.sleep(0.005)
+
+    asyncio.run_coroutine_threadsafe(worker(), io_loop).result(5)
+    time.sleep(0.05)  # let a few heartbeats land
+    stats = lw.loop_witness_snapshot()["loops"]["hb"]
+    assert stats["lag"]["beats"] >= 2
+    assert any(site.startswith("task:") and "worker" in site
+               for site in stats["callbacks"]), list(stats["callbacks"])
+
+
+def test_witness_reset_keeps_loop_watched(io_loop):
+    assert lw.watch_loop(io_loop, "reset", force=True)
+
+    def stall():
+        time.sleep(0.03)
+
+    io_loop.call_soon_threadsafe(stall)
+    _spin(io_loop)
+    assert lw.loop_gauges(io_loop)["loop_stalls"] >= 1
+
+    lw.loop_witness_reset()
+    assert lw.loop_gauges(io_loop) == {"loop_lag_p99_us": 0,
+                                       "loop_stalls": 0}
+    # still watched: a fresh stall is recorded post-reset
+    io_loop.call_soon_threadsafe(stall)
+    _spin(io_loop)
+    assert lw.loop_gauges(io_loop)["loop_stalls"] >= 1
+
+
+def test_witness_unwatch_retires_stats(io_loop):
+    assert lw.watch_loop(io_loop, "retired", force=True)
+    _spin(io_loop)
+    lw.unwatch_loop(io_loop)
+    # gauges go to zero (loop no longer live-watched)...
+    assert lw.loop_gauges(io_loop) == {"loop_lag_p99_us": 0,
+                                       "loop_stalls": 0}
+    # ...but the stats stay visible to the end-of-run snapshot
+    assert "retired" in lw.loop_witness_snapshot()["loops"]
+
+
+def test_merge_loop_snapshots():
+    a = {"version": 1, "loops": {"x": {
+        "callbacks": {"cb:f (m.py)": {"runs": 1, "total_s": 0.1,
+                                      "max_s": 0.1, "p99_s": 0.1}},
+        "lag": {"beats": 10, "max_s": 0.01, "p99_s": 0.005},
+        "stalls": [{"site": "cb:f (m.py)", "ms": 50.0}],
+        "stall_threshold_ms": 20.0,
+    }}}
+    b = copy.deepcopy(a)
+    b["loops"]["x"]["callbacks"]["cb:f (m.py)"] = {
+        "runs": 2, "total_s": 0.3, "max_s": 0.2, "p99_s": 0.15}
+    b["loops"]["x"]["lag"] = {"beats": 5, "max_s": 0.03, "p99_s": 0.001}
+    b["loops"]["x"]["stalls"] = [{"site": "cb:g (m.py)", "ms": 75.0}]
+    b["loops"]["y"] = copy.deepcopy(a["loops"]["x"])
+
+    m = lw.merge_loop_snapshots([a, b])
+    x = m["loops"]["x"]
+    cb = x["callbacks"]["cb:f (m.py)"]
+    assert cb["runs"] == 3
+    assert cb["total_s"] == pytest.approx(0.4)
+    assert cb["max_s"] == pytest.approx(0.2)
+    assert cb["p99_s"] == pytest.approx(0.15)
+    assert x["lag"]["beats"] == 15
+    assert x["lag"]["max_s"] == pytest.approx(0.03)
+    assert len(x["stalls"]) == 2
+    assert "y" in m["loops"]  # loop present in only one snapshot survives
+
+
+def test_dump_writes_mergeable_json(io_loop, tmp_path):
+    assert lw.watch_loop(io_loop, "dumped", force=True)
+    _spin(io_loop)
+    out = tmp_path / "witness.json"
+    lw.dump_loop_witness(str(out))
+    import json
+
+    snap = json.loads(out.read_text())
+    assert snap["version"] == 1
+    assert "dumped" in snap["loops"]
+    # round-trips through the merge helper unchanged in shape
+    merged = lw.merge_loop_snapshots([snap, snap])
+    assert "dumped" in merged["loops"]

@@ -592,3 +592,84 @@ def test_linger_refill_does_not_strand_round_robin_entry():
         assert c.get_hyper_log_log("lr:hll0").count() > 0
     finally:
         c.shutdown()
+
+
+def test_pool_fire_and_forget_close_holds_task_ref():
+    # graftlint G016 fix (PR 17): _AsyncPool used to drop the
+    # ensure_future(conn.close()) handle, so the GC could collect the task
+    # mid-close and leak the socket. The pool now parks it in _bg_tasks
+    # until the done-callback discards it.
+    import asyncio
+    import time
+
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.pool import RespConnectionPool
+
+    with EmbeddedRedis() as server:
+        pool = RespConnectionPool(port=server.port, size=1, min_idle=1)
+        pool.connect()
+        try:
+            ap = pool._pool
+            # Dial a spare outside the rotation, then release it: with the
+            # rotation already at size budget, _release_exclusive must take
+            # the _close_later path.
+            fut = asyncio.run_coroutine_threadsafe(
+                ap._dial_one(register=False), pool._loop)
+            conn = fut.result(5.0)
+            assert conn.connected
+            pool._loop.call_soon_threadsafe(ap._release_exclusive, conn)
+            deadline = time.time() + 5
+            while (conn.connected or ap._bg_tasks) and time.time() < deadline:
+                time.sleep(0.01)
+            assert not conn.connected, "spare connection never closed"
+            assert ap._bg_tasks == set(), "close task not discarded when done"
+            # ordinary traffic unaffected
+            assert pool.execute("PING") == b"PONG"
+        finally:
+            pool.close()
+
+
+def test_pool_close_drains_background_close_tasks():
+    # Shutdown immediately after a fire-and-forget close: close() must
+    # gather _bg_tasks rather than abandon them on a dying loop.
+    import asyncio
+
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.pool import RespConnectionPool
+
+    with EmbeddedRedis() as server:
+        pool = RespConnectionPool(port=server.port, size=1, min_idle=1)
+        pool.connect()
+        ap = pool._pool
+        conn = asyncio.run_coroutine_threadsafe(
+            ap._dial_one(register=False), pool._loop).result(5.0)
+        pool._loop.call_soon_threadsafe(ap._release_exclusive, conn)
+        pool.close()  # no wait: close() itself must drain the task
+        assert ap._bg_tasks == set()
+        assert not conn.connected
+
+
+def test_pool_add_listener_marshals_to_io_thread():
+    # graftlint G017 fix (PR 17): add_listener appended to the loop-confined
+    # listener list straight from the caller's thread, racing _fire's
+    # iteration on the IO loop. It now marshals via call_soon_threadsafe —
+    # and the listener must still observe events end-to-end.
+    import time
+
+    from redisson_tpu.interop.fake_server import EmbeddedRedis
+    from redisson_tpu.interop.pool import RespConnectionPool
+
+    with EmbeddedRedis() as server:
+        events = []
+        pool = RespConnectionPool(port=server.port, size=2, min_idle=1)
+        pool.add_listener(events.append)  # from this thread, pre-connect
+        pool.connect()
+        try:
+            deadline = time.time() + 5
+            while "connect" not in events and time.time() < deadline:
+                time.sleep(0.01)
+            assert "connect" in events
+            # the registration itself landed on the loop-owned list
+            assert events.append in pool._pool._listeners
+        finally:
+            pool.close()

@@ -1408,3 +1408,418 @@ def test_interop_is_out_of_tier_c_scope():
         pytest.skip("no interop package")
     findings, _linters, _graph = analyze_paths([sub], repo_root=REPO)
     assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# 5. Tier D: asyncio/event-loop discipline (asynclint)
+# ---------------------------------------------------------------------------
+
+from tools.graftlint.asynclint import (AsyncLinter,  # noqa: E402
+                                       analyze_paths as analyze_async)
+from tools.graftlint.findings import tier_of  # noqa: E402
+
+
+def alint_src(src, filename="scratch.py"):
+    """Tier D lint of an in-memory source (explicit scope: always scanned)."""
+    return AsyncLinter(filename, repo_root=None, explicit=True,
+                       source=textwrap.dedent(src)).run()
+
+
+def test_repo_tier_d_clean():
+    findings, _linters = analyze_async([ENGINE_DIR], repo_root=REPO)
+    assert findings == [], (
+        "graftlint Tier D findings in redisson_tpu/ — fix, declare the "
+        "affinity in LOOP_CONFINED (lifecycle= for setup/teardown), or "
+        "suppress with a reasoned `# graftlint: allow-<rule>(why)`:\n"
+        + "\n".join(f"{f.file}:{f.line} {f.rule} {f.message}"
+                    for f in findings)
+    )
+
+
+def test_tier_d_scans_wire_and_interop():
+    # Tier D's implicit scope is exactly the event-loop packages; the Tier C
+    # exclusion of interop/ is complemented here, not contradicted.
+    for sub in ("wire", "interop"):
+        d = os.path.join(ENGINE_DIR, sub)
+        if not os.path.isdir(d):
+            pytest.skip(f"no {sub} package")
+        _findings, linters = analyze_async([d], repo_root=REPO)
+        assert any(lt.scoped for lt in linters), f"{sub}/ not scanned"
+
+
+def test_g015_blocking_call_in_coroutine():
+    findings = alint_src("""
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self):
+                time.sleep(0.5)
+    """)
+    assert "G015" in rules_of(findings)
+
+
+def test_g015_one_hop_through_private_sync_helper():
+    findings = alint_src("""
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self):
+                self._drain()
+
+            def _drain(self):
+                time.sleep(0.1)
+    """)
+    assert "G015" in rules_of(findings)
+    assert any("_drain" in f.message for f in findings)
+
+
+def test_g015_await_and_executor_dispatch_exempt():
+    findings = alint_src("""
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self, loop):
+                await asyncio.sleep(0.1)
+                await loop.run_in_executor(None, self._fsync_all)
+                await asyncio.to_thread(self._fsync_all)
+
+            def _fsync_all(self):
+                import os
+                os.fsync(3)
+    """)
+    assert "G015" not in rules_of(findings)
+
+
+def test_g015_lock_provenance_thread_vs_asyncio():
+    # Only locks with threading provenance block the loop; an asyncio.Lock
+    # acquire is loop-native and must not be flagged.
+    findings = alint_src("""
+        import asyncio
+        import threading
+
+        class Mixed:
+            def __init__(self):
+                self._alock = asyncio.Lock()
+                self._tlock = threading.Lock()
+
+            async def bad(self):
+                self._tlock.acquire()
+
+            async def fine(self):
+                self._alock.acquire()
+    """)
+    g015 = [f for f in findings if f.rule == "G015"]
+    assert len(g015) == 1
+    assert "lock.acquire" in g015[0].message
+
+
+def test_g016_discarded_coroutine():
+    findings = alint_src("""
+        import asyncio
+
+        class Svc:
+            async def _notify(self):
+                pass
+
+            def kick(self):
+                self._notify()
+    """)
+    assert "G016" in rules_of(findings)
+
+
+def test_g016_dropped_task_reference():
+    findings = alint_src("""
+        import asyncio
+
+        async def work():
+            pass
+
+        class Svc:
+            def kick(self):
+                asyncio.ensure_future(work())
+    """)
+    assert "G016" in rules_of(findings)
+    assert any("weak reference" in f.message for f in findings)
+
+
+def test_g016_held_reference_pattern_clean():
+    # The blessed idiom: keep a strong ref, discard on completion.
+    findings = alint_src("""
+        import asyncio
+
+        class Svc:
+            def __init__(self):
+                self._tasks = set()
+
+            async def _notify(self):
+                pass
+
+            def kick(self):
+                t = asyncio.ensure_future(self._notify())
+                self._tasks.add(t)
+                t.add_done_callback(self._tasks.discard)
+    """)
+    assert "G016" not in rules_of(findings)
+
+
+def test_g017_mutation_from_thread_root():
+    findings = alint_src("""
+        import asyncio
+        import threading
+
+        LOOP_CONFINED = {"Srv._conns": "connection registry; lifecycle=start"}
+
+        class Srv:
+            def __init__(self):
+                self._conns = {}
+
+            def start(self):
+                self._conns = {}
+                threading.Thread(target=self._bg, daemon=True).start()
+
+            def _bg(self):
+                self._conns["x"] = 1
+
+            async def register(self, c):
+                self._conns["c"] = c
+    """)
+    g017 = [f for f in findings if f.rule == "G017"]
+    # only the thread-entry mutation fires: __init__ and lifecycle=start are
+    # exempt, and the async method IS the loop.
+    assert len(g017) == 1
+    assert "_bg" in g017[0].message
+
+
+def test_g017_mutation_from_done_callback_root():
+    findings = alint_src("""
+        import asyncio
+
+        LOOP_CONFINED = {"Srv._pending": "in-flight ops; loop-owned"}
+
+        class Srv:
+            def __init__(self):
+                self._pending = {}
+
+            def submit(self, ex, op):
+                f = ex.submit(op)
+                f.add_done_callback(self._done)
+
+            def _done(self, f):
+                self._pending.pop(id(f), None)
+    """)
+    assert "G017" in rules_of(findings)
+
+
+def test_g017_var_based_key_flags_cross_thread_facade():
+    findings = alint_src("""
+        import asyncio
+
+        LOOP_CONFINED = {"_pool._listeners": "listener list; loop-owned"}
+
+        class Facade:
+            def add_listener(self, fn):
+                self._pool._listeners.append(fn)
+
+            def add_listener_ok(self, fn):
+                self._loop.call_soon_threadsafe(
+                    self._pool._listeners.append, fn)
+    """)
+    g017 = [f for f in findings if f.rule == "G017"]
+    assert len(g017) == 1
+    assert "add_listener" in g017[0].message
+
+
+def test_g018_future_completion_from_done_callback():
+    findings = alint_src("""
+        import asyncio
+
+        class Bridge:
+            def submit(self, ex, fut, op):
+                cf = ex.submit(op)
+                cf.add_done_callback(self._done)
+                self._fut = fut
+
+            def _done(self, cf):
+                self._fut.set_result(cf.result())
+    """)
+    assert "G018" in rules_of(findings)
+
+
+def test_g018_marshalled_completion_clean():
+    findings = alint_src("""
+        import asyncio
+
+        class Bridge:
+            def submit(self, ex, fut, op):
+                cf = ex.submit(op)
+                cf.add_done_callback(self._done)
+                self._fut = fut
+
+            def _done(self, cf):
+                self._loop.call_soon_threadsafe(
+                    self._fut.set_result, cf.result())
+    """)
+    assert "G018" not in rules_of(findings)
+
+
+def test_g018_asyncio_task_done_callback_is_loop_context():
+    # add_done_callback on an asyncio Task runs ON the loop — completing a
+    # future there is fine; only concurrent.futures callbacks are off-loop.
+    findings = alint_src("""
+        import asyncio
+
+        class T:
+            def start(self):
+                self._t = asyncio.create_task(self._run())
+                self._t.add_done_callback(self._finish)
+
+            async def _run(self):
+                pass
+
+            def _finish(self, t):
+                self._fut.set_result(1)
+    """)
+    assert "G018" not in rules_of(findings)
+
+
+def test_tier_d_suppression_requires_reason():
+    base = """
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self):
+                time.sleep(0.5){allow}
+    """
+    bare = alint_src(base.format(allow="  # graftlint: allow-loop"))
+    assert "G015" in rules_of(bare)
+    reasoned = alint_src(
+        base.format(allow="  # graftlint: allow-loop(startup probe only)"))
+    assert "G015" not in rules_of(reasoned)
+
+
+def test_tier_d_rules_registered():
+    for rule in ("G015", "G016", "G017", "G018"):
+        assert rule in RULES
+        assert tier_of(rule) == "d"
+    for alias in ("loop", "unawaited", "affinity", "handoff"):
+        assert alias in SUPPRESS_ALIASES
+    assert tier_of("G011") == "c"
+    assert tier_of("G002") == "a"
+    assert tier_of("J001") == "b"
+
+
+def test_tier_d_findings_are_baselinable():
+    from tools.graftlint.cli import collect_tiers
+
+    src = textwrap.dedent("""
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self):
+                time.sleep(0.5)
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "conn.py")
+        with open(p, "w") as fh:
+            fh.write(src)
+        dicts, tiers = collect_tiers([p], jaxpr=False, repo_root=td)
+        assert [d["rule"] for d in dicts] == ["G015"]
+        assert dicts[0]["fingerprint"]
+        assert tiers["tier_d"]["rules"]["G015"] == 1
+        assert tiers["tier_d"]["modules"] >= 1
+        bl = os.path.join(td, "bl.json")
+        baseline_mod.write(bl, dicts)
+        assert dicts[0]["fingerprint"] in baseline_mod.load(bl)
+
+
+def test_tier_scoped_baseline_update_preserves_other_tiers():
+    # The satellite-6 pin: `--update-baseline --tier d` must not launder a
+    # Tier A regression into the baseline, and must not drop entries the
+    # other tiers already hold.
+    from tools.graftlint.cli import collect_full
+
+    a_src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def count(bits):
+            return int(jnp.sum(bits, axis=0)[0])
+    """)
+    d_src = textwrap.dedent("""
+        import asyncio
+        import time
+
+        class Conn:
+            async def handle(self):
+                time.sleep(0.5)
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        pa = os.path.join(td, "hot.py")
+        pd = os.path.join(td, "conn.py")
+        with open(pa, "w") as fh:
+            fh.write(a_src)
+        with open(pd, "w") as fh:
+            fh.write(d_src)
+        dicts, _ = collect_full([pa, pd], jaxpr=False, repo_root=td)
+        by_rule = {d["rule"]: d for d in dicts}
+        assert "G002" in by_rule and "G015" in by_rule
+        bl = os.path.join(td, "bl.json")
+
+        # A d-only update must NOT baseline the seeded G002.
+        baseline_mod.write(bl, dicts, tiers=("d",))
+        grand = baseline_mod.load(bl)
+        assert by_rule["G015"]["fingerprint"] in grand
+        assert by_rule["G002"]["fingerprint"] not in grand
+
+        # And once tier A holds entries, a d-only rewrite keeps them.
+        baseline_mod.write(bl, dicts)
+        assert by_rule["G002"]["fingerprint"] in baseline_mod.load(bl)
+        baseline_mod.write(bl, [by_rule["G015"]], tiers=("d",))
+        grand2 = baseline_mod.load(bl)
+        assert by_rule["G002"]["fingerprint"] in grand2
+        assert by_rule["G015"]["fingerprint"] in grand2
+
+
+def test_baseline_v1_flat_format_still_loads():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        bl = os.path.join(td, "bl.json")
+        with open(bl, "w") as fh:
+            json.dump({"findings": [{"fingerprint": "abc123",
+                                     "rule": "G002", "file": "x.py"}]}, fh)
+        assert "abc123" in baseline_mod.load(bl)
+
+
+def test_cli_json_tier_d_block():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-jaxpr",
+         os.path.join(ENGINE_DIR, "interop", "pool.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert set(payload["tier_d"]["rules"]) == {"G015", "G016", "G017", "G018"}
+    assert payload["tier_d"]["modules"] >= 1
+    assert payload["tier_d"]["async_defs"] >= 1
+    assert payload["tier_d"]["confined_keys"] >= 1
+
+
+def test_cli_no_async_skips_tier_d():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-jaxpr",
+         "--no-async", os.path.join(ENGINE_DIR, "interop", "pool.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["tier_d"]["modules"] == 0

@@ -1,0 +1,917 @@
+"""graftlint Tier D — asyncio/event-loop discipline analysis.
+
+The wire front-end (``redisson_tpu/wire/``) and the redis interop tier
+(``redisson_tpu/interop/``) put an asyncio event loop on a private thread
+and bridge it into the threaded executor. Tier C's lock rules cannot see
+this tier's failure modes: a single blocking call on the loop thread
+stalls every connection at once, a dropped task reference gets the
+coroutine garbage-collected mid-flight, and an unmarshalled cross-thread
+completion is a data race with no lock anywhere near it. Four rules:
+
+  G015  loop-block — a blocking call reachable from coroutine context
+        (an ``async def`` body, a ``call_soon``/``call_soon_threadsafe``
+        callback, or one hop into a private sync helper called from one):
+        ``Future.result``, ``lock.acquire``/``Event.wait`` with threading
+        provenance, ``queue.Queue.get/put``, ``time.sleep``, ``os.fsync``,
+        sync socket IO, builtin ``open``, and engine ``execute_sync``.
+        ``await``-ed calls and anything dispatched through
+        ``run_in_executor``/``asyncio.to_thread`` are exempt.
+
+  G016  unawaited — a coroutine called as a bare expression statement
+        (it never runs), or a ``create_task``/``ensure_future`` result
+        discarded without a held reference (the event loop keeps only a
+        weak reference: the GC can collect the task mid-flight).
+
+  G017  loop-affinity — mutation of state declared loop-confined in a
+        module-level ``LOOP_CONFINED`` table (the asyncio dual of Tier
+        C's ``GUARDED_BY``) from a non-loop thread-entry root (a
+        ``Thread`` target or a ``concurrent.futures`` done-callback)
+        without marshalling through ``call_soon_threadsafe`` /
+        ``run_coroutine_threadsafe``::
+
+            LOOP_CONFINED = {
+                # self._conns in WireServer: loop callbacks only
+                "WireServer._conns": "connection set",
+                # lifecycle= names sync methods allowed to touch the
+                # field around the loop's lifetime (start/stop)
+                "WireServer._server": "listener; lifecycle=start,stop",
+                # var-based: `<anything>._pool._listeners` in THIS module
+                # must only be mutated from loop context
+                "_pool._listeners": "facade view of the listener list",
+            }
+
+        Class-qualified keys are checked against thread-entry roots
+        discovered Tier C-style (Thread targets, done-callback args —
+        the reachability closure through same-class calls); var-based
+        keys (cross-object facade access) must mutate from loop context
+        only. ``__init__``/``__del__`` and ``lifecycle=`` methods are
+        exempt; reads are never flagged (racy gauge reads are the
+        documented idiom).
+
+  G018  handoff — completing a future (``set_result``/``set_exception``),
+        touching a transport (``write``/``writelines``/``drain``), or
+        calling a loop-confined method directly from a
+        ``concurrent.futures`` done-callback. Executor threads resolve
+        those futures, so the callback runs off-loop: it must marshal
+        through ``call_soon_threadsafe``. Done-callbacks attached to
+        asyncio tasks (``create_task``/``ensure_future`` provenance) run
+        on the loop and are exempt.
+
+Scope: modules under ``redisson_tpu/wire/`` and ``redisson_tpu/interop/``
+that import asyncio (or contain an ``async def``), plus any module that
+declares a ``LOOP_CONFINED`` table, plus files passed explicitly on the
+CLI. Suppression uses the shared idiom: ``# graftlint:
+allow-loop(reason)`` / ``allow-unawaited`` / ``allow-affinity`` /
+``allow-handoff`` (or the ``g015``..``g018`` ids), reason mandatory.
+
+The runtime half lives in ``redisson_tpu/loopwitness.py``: the loop-stall
+witness armed by ``REDISSON_TPU_LOOP_WITNESS=1`` measures what these
+rules prove — per-callback hold times and loop lag — on the interleavings
+the suite actually runs (``benchmarks/suite.py --aio-smoke``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .astlint import _ITEM_RE, _rel, iter_py_files
+from .findings import Finding, SUPPRESS_ALIASES
+
+#: container methods that mutate their receiver (G017 mutation detection)
+_MUTATORS = {
+    "append", "appendleft", "add", "remove", "discard", "pop", "popleft",
+    "clear", "extend", "insert", "update", "setdefault", "popitem",
+}
+
+#: attr calls that complete a future / touch a transport (G018)
+_HANDOFF_CALLS = {"set_result", "set_exception", "write", "writelines",
+                  "drain"}
+
+_THREAD_LOCK_CTORS = {"Lock", "RLock", "make_lock", "make_rlock",
+                      "allocate_lock"}
+_THREAD_COND_CTORS = {"Condition", "make_condition"}
+_THREAD_EVENT_CTORS = {"Event"}
+_SYNC_QUEUE_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+_TASK_CTORS = {"create_task", "ensure_future"}
+_EXECUTOR_DISPATCH = {"run_in_executor", "to_thread"}
+_LOOP_SCHEDULERS = {"call_soon", "call_soon_threadsafe", "call_later",
+                    "call_at"}
+
+
+def _dotted(node) -> str:
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    parts.append(node.id if isinstance(node, ast.Name) else "?")
+    return ".".join(reversed(parts))
+
+
+def _is_self_attr(node) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+
+def _ctor_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _ctor_module(call: ast.Call) -> str:
+    """'asyncio' for `asyncio.Lock()`, 'threading' for `threading.Lock()`,
+    '' for a bare `Lock()` (resolved via from-imports)."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return ""
+
+
+class _Confined:
+    """One LOOP_CONFINED entry: description + lifecycle-exempt methods."""
+
+    __slots__ = ("desc", "lifecycle")
+
+    def __init__(self, spec: str):
+        self.desc = spec
+        self.lifecycle: set[str] = set()
+        for seg in spec.split(";"):
+            seg = seg.strip()
+            if seg.startswith("lifecycle="):
+                self.lifecycle = {m.strip()
+                                  for m in seg[len("lifecycle="):].split(",")
+                                  if m.strip()}
+
+
+class _AsyncClassInfo:
+    """Per-class analysis state for one pass."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.methods: dict[str, ast.AST] = {}
+        self.async_methods: set[str] = set()
+        # attrs with threading (blocking) provenance
+        self.thread_locks: set[str] = set()
+        self.thread_events: set[str] = set()
+        self.sync_queues: set[str] = set()
+        # attrs/locals holding asyncio tasks (create_task/ensure_future)
+        self.task_attrs: set[str] = set()
+        # context discovery products
+        self.loop_methods: set[str] = set()   # run ON the loop
+        self.loop_methods_note: dict[str, str] = {}  # escaped via lambdas
+        self.loop_lambdas: set[int] = set()   # node ids of loop lambdas
+        self.done_roots: dict[str, str] = {}  # cf done-callback methods
+        self.done_lambdas: set[int] = set()
+        self.thread_roots: dict[str, str] = {}
+        self.call_graph: dict[str, set[str]] = {}
+        # walk products
+        self.blocking = []   # (desc, node, method, ctx, exempt)
+        self.mutations = []  # (key, node, method, ctx)
+        self.discards = []   # (what, node, method)
+        self.handoffs = []   # (desc, node, method)
+        self.self_calls = []  # (callee, node, method, ctx)
+        self.direct_blocking: dict[str, str] = {}  # sync method -> desc
+
+    def closure(self, roots) -> set[str]:
+        seen = set(roots)
+        stack = list(roots)
+        while stack:
+            m = stack.pop()
+            for callee in self.call_graph.get(m, ()):
+                if callee in self.methods and callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        return seen
+
+
+class AsyncLinter:
+    """Tier D analysis of one module. Mirrors FileLinter's shape
+    (relpath/lines/findings/allows) so the CLI treats all tiers alike."""
+
+    def __init__(self, path: str, repo_root: str | None = None,
+                 explicit: bool = False, source: str | None = None):
+        self.path = path
+        self.relpath = _rel(path, repo_root)
+        self.explicit = explicit
+        if source is None:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        self.source = source
+        self.lines = source.splitlines()
+        self.findings: list[Finding] = []
+        self.allows: dict[int, set[str]] = {}
+        self.confined: dict[str, _Confined] = {}
+        self.thread_import_names: set[str] = set()
+        self.module_async: set[str] = set()
+        self.module_blocking: dict[str, str] = {}
+        self.scoped = False
+        self.n_async_defs = 0
+
+    # -- scope & shared plumbing -------------------------------------------
+
+    def in_scope(self, tree: ast.AST) -> bool:
+        if self.explicit:
+            return True
+        if self._declares_confined(tree):
+            return True
+        rel = self.relpath
+        if not rel.startswith("redisson_tpu/"):
+            return False
+        sub = rel[len("redisson_tpu/"):]
+        if not (sub.startswith("wire/") or sub.startswith("interop/")):
+            return False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.AsyncFunctionDef,)):
+                return True
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "asyncio"
+                       for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "asyncio":
+                    return True
+        return False
+
+    @staticmethod
+    def _declares_confined(tree: ast.AST) -> bool:
+        return any(isinstance(n, ast.Assign)
+                   and any(isinstance(t, ast.Name) and t.id == "LOOP_CONFINED"
+                           for t in n.targets)
+                   for n in tree.body)
+
+    def _collect_allows(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            if "graftlint" not in line:
+                continue
+            for name, reason in _ITEM_RE.findall(line):
+                rule = SUPPRESS_ALIASES.get(name.lower())
+                if rule and reason.strip():
+                    self.allows.setdefault(i, set()).add(rule)
+
+    def _allowed(self, rule: str, node) -> bool:
+        lo = getattr(node, "lineno", 1)
+        hi = getattr(node, "end_lineno", None) or lo
+        for ln in range(lo, hi + 1):
+            if rule in self.allows.get(ln, ()):
+                return True
+        prev = lo - 1
+        if prev >= 1 and prev <= len(self.lines):
+            if self.lines[prev - 1].lstrip().startswith("#"):
+                if rule in self.allows.get(prev, ()):
+                    return True
+        return False
+
+    def _emit(self, rule, node, message, hint) -> None:
+        if self._allowed(rule, node):
+            return
+        self.findings.append(Finding(
+            rule, self.relpath, getattr(node, "lineno", 1), message, hint))
+
+    # -- entry --------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError:
+            return self.findings  # tier A reports the parse failure
+        if not self.in_scope(tree):
+            return self.findings
+        self.scoped = True
+        self._collect_allows()
+        self._collect_confined(tree)
+        self._collect_imports(tree)
+        self._collect_module_funcs(tree)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._analyze_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._analyze_module_func(node)
+        seen, out = set(), []
+        for f in self.findings:
+            key = (f.rule, f.file, f.line)
+            if key not in seen:
+                seen.add(key)
+                out.append(f)
+        self.findings = out
+        return self.findings
+
+    # -- declarations -------------------------------------------------------
+
+    def _collect_confined(self, tree: ast.AST) -> None:
+        for node in tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "LOOP_CONFINED"
+                       for t in node.targets):
+                continue
+            if not isinstance(node.value, ast.Dict):
+                continue
+            for k, v in zip(node.value.keys, node.value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    self.confined[k.value] = _Confined(v.value)
+
+    def _collect_imports(self, tree: ast.AST) -> None:
+        """Bare names imported from threading/queue — provenance for bare
+        `Lock()` / `Queue()` constructor calls."""
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] in ("threading",
+                                                         "queue"):
+                    for a in node.names:
+                        self.thread_import_names.add(a.asname or a.name)
+
+    def _collect_module_funcs(self, tree: ast.AST) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.AsyncFunctionDef):
+                self.module_async.add(node.name)
+            elif isinstance(node, ast.FunctionDef):
+                desc = self._first_direct_blocking(node, None)
+                if desc is not None and node.name.startswith("_"):
+                    self.module_blocking[node.name] = desc
+
+    # -- provenance classification ------------------------------------------
+
+    def _sync_ctor_kind(self, call: ast.Call) -> str | None:
+        """'lock'/'event'/'queue' for THREADING primitives; None for
+        asyncio primitives and everything else."""
+        name = _ctor_name(call)
+        mod = _ctor_module(call)
+        if mod == "asyncio":
+            return None
+        if name in ("make_lock", "make_rlock"):
+            return "lock"
+        if mod in ("threading", "queue"):
+            if name in _THREAD_LOCK_CTORS | _THREAD_COND_CTORS:
+                return "lock"
+            if name in _THREAD_EVENT_CTORS:
+                return "event"
+            if name in _SYNC_QUEUE_CTORS:
+                return "queue"
+            return None
+        if mod == "":
+            if name in self.thread_import_names:
+                if name in _THREAD_LOCK_CTORS | _THREAD_COND_CTORS:
+                    return "lock"
+                if name in _THREAD_EVENT_CTORS:
+                    return "event"
+                if name in _SYNC_QUEUE_CTORS:
+                    return "queue"
+        return None
+
+    @staticmethod
+    def _is_task_ctor(call: ast.Call) -> bool:
+        return _ctor_name(call) in _TASK_CTORS
+
+    # -- class analysis -----------------------------------------------------
+
+    def _analyze_class(self, cnode: ast.ClassDef) -> None:
+        cls = _AsyncClassInfo(cnode.name)
+        for item in cnode.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cls.methods[item.name] = item
+                if isinstance(item, ast.AsyncFunctionDef):
+                    cls.async_methods.add(item.name)
+                    self.n_async_defs += 1
+        self._collect_primitives(cnode, cls)
+        self._collect_contexts(cls)
+        # call-graph pre-pass: the reachability closures (loop_ctx here,
+        # off_reach in _resolve_class) need the edges before the walk.
+        # Nested lambdas/defs run in their own context (call_soon target,
+        # done-callback...), so their calls are NOT edges from the
+        # enclosing method — _collect_contexts classifies them instead.
+        for name, meth in cls.methods.items():
+            stack = list(ast.iter_child_nodes(meth))
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(node, ast.Call) and _is_self_attr(node.func):
+                    cls.call_graph.setdefault(name, set()).add(node.func.attr)
+                stack.extend(ast.iter_child_nodes(node))
+        loop_ctx = cls.closure(cls.loop_methods)
+        for name, meth in cls.methods.items():
+            if name in loop_ctx:
+                ctx = "loop"
+            elif name in cls.done_roots:
+                ctx = "done"
+            elif name in cls.thread_roots:
+                ctx = "off"
+            else:
+                ctx = "plain"
+            _Walk(self, cls, name, ctx).walk(meth.body)
+        # direct-blocking pre-pass for one-hop (private sync helpers)
+        for name, meth in cls.methods.items():
+            if name in cls.async_methods:
+                continue
+            desc = self._first_direct_blocking(meth, cls)
+            if desc is not None:
+                cls.direct_blocking[name] = desc
+        self._resolve_class(cls, loop_ctx)
+
+    def _collect_primitives(self, cnode, cls: _AsyncClassInfo) -> None:
+        for node in ast.walk(cnode):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            kind = self._sync_ctor_kind(node.value)
+            is_task = self._is_task_ctor(node.value)
+            for t in node.targets:
+                if not _is_self_attr(t):
+                    continue
+                if kind == "lock":
+                    cls.thread_locks.add(t.attr)
+                elif kind == "event":
+                    cls.thread_events.add(t.attr)
+                elif kind == "queue":
+                    cls.sync_queues.add(t.attr)
+                elif is_task:
+                    cls.task_attrs.add(t.attr)
+
+    def _collect_contexts(self, cls: _AsyncClassInfo) -> None:
+        """Classify how each method gets entered: on the loop (async def,
+        call_soon/_threadsafe/_later targets), as a concurrent.futures
+        done-callback, or from a foreign thread (Thread target)."""
+        cls.loop_methods |= cls.async_methods
+
+        def note(table, m, why):
+            if m in cls.methods:
+                table.setdefault(m, why)
+
+        def scan_escaping(body, table, why):
+            for n in ast.walk(body):
+                if _is_self_attr(n):
+                    note(table, n.attr, why)
+
+        for meth in cls.methods.values():
+            local_defs = {n.name: n for n in ast.walk(meth)
+                          if isinstance(n, ast.FunctionDef) and n is not meth}
+            for node in ast.walk(meth):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                fname = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                dotted = _dotted(f) if isinstance(
+                    f, (ast.Attribute, ast.Name)) else ""
+                argvals = list(node.args) + [kw.value for kw in node.keywords]
+                if fname in _LOOP_SCHEDULERS:
+                    for val in argvals:
+                        if _is_self_attr(val) and val.attr in cls.methods:
+                            cls.loop_methods.add(val.attr)
+                        elif isinstance(val, ast.Lambda):
+                            cls.loop_lambdas.add(id(val))
+                            scan_escaping(val.body, cls.loop_methods_note,
+                                          "call_soon lambda")
+                        elif (isinstance(val, ast.Name)
+                              and val.id in local_defs):
+                            cls.loop_lambdas.add(id(local_defs[val.id]))
+                            scan_escaping(local_defs[val.id],
+                                          cls.loop_methods_note,
+                                          "call_soon local def")
+                elif fname == "add_done_callback":
+                    recv = f.value if isinstance(f, ast.Attribute) else None
+                    if self._is_asyncio_task(recv, cls, meth):
+                        # asyncio task callbacks run on the loop
+                        for val in argvals:
+                            if _is_self_attr(val) and val.attr in cls.methods:
+                                cls.loop_methods.add(val.attr)
+                            elif isinstance(val, ast.Lambda):
+                                cls.loop_lambdas.add(id(val))
+                        continue
+                    why = f"done-callback on {_dotted(recv) if recv is not None else '?'}"
+                    for val in argvals:
+                        if _is_self_attr(val):
+                            note(cls.done_roots, val.attr, why)
+                        elif isinstance(val, ast.Lambda):
+                            cls.done_lambdas.add(id(val))
+                            scan_escaping(val.body, cls.done_roots, why)
+                        elif (isinstance(val, ast.Name)
+                              and val.id in local_defs):
+                            cls.done_lambdas.add(id(local_defs[val.id]))
+                            scan_escaping(local_defs[val.id],
+                                          cls.done_roots, why)
+                elif dotted.endswith("Thread"):
+                    for val in argvals:
+                        if _is_self_attr(val):
+                            note(cls.thread_roots, val.attr, "Thread target")
+                        elif isinstance(val, ast.Lambda):
+                            scan_escaping(val.body, cls.thread_roots,
+                                          "Thread target")
+                        elif (isinstance(val, ast.Name)
+                              and val.id in local_defs):
+                            scan_escaping(local_defs[val.id],
+                                          cls.thread_roots, "Thread target")
+        cls.loop_methods |= set(cls.loop_methods_note)
+
+    def _is_asyncio_task(self, recv, cls: _AsyncClassInfo, meth) -> bool:
+        """True when `recv.add_done_callback` attaches to an asyncio task
+        (create_task/ensure_future provenance) — those callbacks run on
+        the loop, not on an executor thread."""
+        if recv is None:
+            return False
+        if _is_self_attr(recv) and recv.attr in cls.task_attrs:
+            return True
+        if isinstance(recv, ast.Name):
+            for node in ast.walk(meth):
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)
+                        and self._is_task_ctor(node.value)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id == recv.id:
+                            return True
+        if isinstance(recv, ast.Call) and self._is_task_ctor(recv):
+            return True
+        return False
+
+    # -- blocking-call identification ---------------------------------------
+
+    def _blocking_desc(self, call: ast.Call,
+                       cls: _AsyncClassInfo | None) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id == "open":
+                return "sync file IO (open())"
+            return None
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = f.attr
+        recv = f.value
+        dotted = _dotted(f)
+        if dotted == "time.sleep":
+            return "time.sleep()"
+        if dotted == "os.fsync" or name == "fsync":
+            return "fsync()"
+        if name == "result":
+            return "Future.result()"
+        if name == "execute_sync":
+            return "engine execute_sync()"
+        if dotted.startswith("socket."):
+            if name in ("create_connection", "socketpair",
+                        "getaddrinfo", "gethostbyname"):
+                return f"sync socket IO ({dotted}())"
+            return None
+        if name == "acquire":
+            if cls is not None and _is_self_attr(recv) \
+                    and recv.attr in cls.thread_locks:
+                return "threading lock.acquire()"
+            return None
+        if name in ("wait", "wait_for"):
+            if cls is not None and _is_self_attr(recv) \
+                    and recv.attr in cls.thread_events:
+                return "threading Event.wait()"
+            return None
+        if name in ("get", "put"):
+            if cls is not None and _is_self_attr(recv) \
+                    and recv.attr in cls.sync_queues:
+                return f"queue.Queue.{name}()"
+            return None
+        return None
+
+    def _first_direct_blocking(self, fn, cls) -> str | None:
+        """First unexempted blocking call directly in `fn` (one-hop feed).
+        Awaited calls, executor-dispatched args, and allow-loop'd lines
+        don't count; nested defs/lambdas run elsewhere and don't count."""
+        found: list[str] = []
+
+        def visit(node, awaited_ids, exempt):
+            if found:
+                return
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                child_exempt = exempt
+                if isinstance(child, ast.Call):
+                    fname = child.func.attr \
+                        if isinstance(child.func, ast.Attribute) else ""
+                    if fname in _EXECUTOR_DISPATCH:
+                        child_exempt = True
+                    elif not exempt and id(child) not in awaited_ids:
+                        desc = self._blocking_desc(child, cls)
+                        if desc is not None \
+                                and not self._allowed("G015", child):
+                            found.append(desc)
+                            return
+                if isinstance(child, ast.Await) \
+                        and isinstance(child.value, ast.Call):
+                    awaited_ids = awaited_ids | {id(child.value)}
+                visit(child, awaited_ids, child_exempt)
+
+        visit(fn, set(), False)
+        return found[0] if found else None
+
+    # -- resolution ---------------------------------------------------------
+
+    def _resolve_class(self, cls: _AsyncClassInfo, loop_ctx) -> None:
+        # G015: direct blocking in loop context ---------------------------
+        for desc, node, method, ctx, exempt in cls.blocking:
+            if ctx == "loop" and not exempt:
+                self._emit(
+                    "G015", node,
+                    f"blocking {desc} on the event loop "
+                    f"(in loop-confined '{cls.name}.{method}') stalls every "
+                    "connection on this loop",
+                    "await the async equivalent, or push the call off-loop "
+                    "via loop.run_in_executor/asyncio.to_thread")
+        # G015 one-hop: loop context calls a private sync helper that
+        # blocks directly.
+        for callee, node, method, ctx in cls.self_calls:
+            if ctx != "loop":
+                continue
+            if not callee.startswith("_") or callee.startswith("__"):
+                continue
+            if callee in cls.async_methods or callee in loop_ctx:
+                continue  # its own body is already walked as loop context
+            desc = cls.direct_blocking.get(callee)
+            if desc is not None:
+                self._emit(
+                    "G015", node,
+                    f"call to '{cls.name}.{callee}' (which blocks on "
+                    f"{desc}) from loop context '{cls.name}.{method}'",
+                    "one-hop: the helper blocks; await an async variant or "
+                    "dispatch through run_in_executor")
+
+        # G016: discarded coroutines / task references --------------------
+        for what, node, method in cls.discards:
+            self._emit(
+                "G016", node, what,
+                "await the coroutine, or keep a strong reference to the "
+                "task (self._tasks.add(t); t.add_done_callback("
+                "self._tasks.discard)) so the GC cannot collect it "
+                "mid-flight")
+
+        # G017: loop-affinity over LOOP_CONFINED --------------------------
+        off_reach = cls.closure(set(cls.thread_roots) | set(cls.done_roots))
+        root_desc = dict(cls.thread_roots)
+        root_desc.update(cls.done_roots)
+        for key, node, method, ctx in cls.mutations:
+            spec = self.confined.get(key)
+            if spec is None:
+                continue
+            if method in ("__init__", "__del__") or method in spec.lifecycle:
+                continue
+            if ctx == "loop":
+                continue
+            class_based = key.startswith(cls.name + ".")
+            if class_based:
+                if ctx in ("done", "off") or method in off_reach:
+                    roots = sorted(f"{r} [{w}]"
+                                   for r, w in root_desc.items()
+                                   if method == r or method in
+                                   cls.closure({r}))
+                    via = roots[0] if roots else f"{method} [{ctx}]"
+                    self._emit(
+                        "G017", node,
+                        f"mutation of loop-confined '{key}' from non-loop "
+                        f"entry root {via} without call_soon_threadsafe",
+                        "marshal the mutation onto the loop "
+                        "(loop.call_soon_threadsafe / "
+                        "run_coroutine_threadsafe), or list the method in "
+                        "the declaration's lifecycle= clause if it runs "
+                        "strictly before/after the loop")
+            else:
+                # var-based (cross-object facade): loop contexts only
+                self._emit(
+                    "G017", node,
+                    f"mutation of loop-confined '{key}' from "
+                    f"'{cls.name}.{method}' which is not loop context",
+                    "marshal through loop.call_soon_threadsafe / "
+                    "run_coroutine_threadsafe — the owning loop is the "
+                    "single writer")
+
+        # G018: unmarshalled handoff from done-callbacks ------------------
+        for desc, node, method in cls.handoffs:
+            self._emit(
+                "G018", node,
+                f"{desc} from concurrent.futures done-callback "
+                f"'{cls.name}.{method}' runs on the resolving executor "
+                "thread, not the loop",
+                "hand the completion to the loop: "
+                "loop.call_soon_threadsafe(fut.set_result, value) / "
+                "run_coroutine_threadsafe")
+        for callee, node, method, ctx in cls.self_calls:
+            if ctx != "done":
+                continue
+            if callee in cls.loop_methods and callee not in cls.done_roots:
+                self._emit(
+                    "G018", node,
+                    f"direct call to loop-confined '{cls.name}.{callee}' "
+                    f"from done-callback '{cls.name}.{method}'",
+                    "marshal: loop.call_soon_threadsafe("
+                    f"self.{callee}, ...)")
+
+    # -- module-level functions ---------------------------------------------
+
+    def _analyze_module_func(self, fn) -> None:
+        cls = _AsyncClassInfo(f"<module:{fn.name}>")
+        cls.methods[fn.name] = fn
+        is_async = isinstance(fn, ast.AsyncFunctionDef)
+        if is_async:
+            self.n_async_defs += 1
+        ctx = "loop" if is_async else "plain"
+        _Walk(self, cls, fn.name, ctx).walk(fn.body)
+        for desc, node, method, wctx, exempt in cls.blocking:
+            if wctx == "loop" and not exempt:
+                self._emit(
+                    "G015", node,
+                    f"blocking {desc} on the event loop (in coroutine "
+                    f"'{fn.name}')",
+                    "await the async equivalent, or dispatch through "
+                    "run_in_executor/asyncio.to_thread")
+        for what, node, method in cls.discards:
+            self._emit(
+                "G016", node, what,
+                "await the coroutine, or keep a strong reference to the "
+                "task so the GC cannot collect it mid-flight")
+
+
+class _Walk:
+    """Context-carrying walk over one method/function body."""
+
+    def __init__(self, linter: AsyncLinter, cls: _AsyncClassInfo,
+                 method: str, ctx: str):
+        self.lint = linter
+        self.cls = cls
+        self.method = method
+        self.ctx = ctx
+
+    def walk(self, body) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    # -- statements ----------------------------------------------------------
+
+    def _stmt(self, node) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub_ctx = "loop" if (isinstance(node, ast.AsyncFunctionDef)
+                                 or id(node) in self.cls.loop_lambdas) else (
+                "done" if id(node) in self.cls.done_lambdas else "plain")
+            _Walk(self.lint, self.cls, self.method, sub_ctx).walk(node.body)
+            return
+        if isinstance(node, ast.Expr):
+            self._check_discard(node.value)
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.Delete,
+                             ast.AnnAssign)):
+            self._check_mutation(node)
+        for name, value in ast.iter_fields(node):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v)
+                    elif isinstance(v, ast.ExceptHandler):
+                        self.walk(v.body)
+                    elif isinstance(v, ast.AST):
+                        self._expr(v, False)
+            elif isinstance(value, ast.AST):
+                self._expr(value, False)
+
+    # -- G016: discarded coroutine / task -------------------------------------
+
+    def _check_discard(self, value) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        f = value.func
+        if _is_self_attr(f) and f.attr in self.cls.async_methods:
+            self.cls.discards.append((
+                f"coroutine '{self.cls.name}.{f.attr}' called but never "
+                "awaited — the coroutine object is discarded and never "
+                "runs", value, self.method))
+            return
+        if isinstance(f, ast.Name) and f.id in self.lint.module_async:
+            self.cls.discards.append((
+                f"coroutine '{f.id}' called but never awaited",
+                value, self.method))
+            return
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if fname in _TASK_CTORS:
+            self.cls.discards.append((
+                f"{fname}() result dropped — the loop holds only a weak "
+                "reference, so the GC can collect the task mid-flight",
+                value, self.method))
+
+    # -- G017: mutation recording ---------------------------------------------
+
+    def _mutation_key(self, node) -> str | None:
+        if not isinstance(node, ast.Attribute):
+            return None
+        if _is_self_attr(node):
+            return f"{self.cls.name}.{node.attr}"
+        d = _dotted(node)
+        if d.startswith("self."):
+            return d[len("self."):]
+        if "?" in d:
+            return None
+        return d
+
+    def _note_mutation(self, attr_node) -> None:
+        key = self._mutation_key(attr_node)
+        if key is not None and key in self.lint.confined:
+            self.cls.mutations.append(
+                (key, attr_node, self.method, self.ctx))
+
+    def _check_mutation(self, stmt) -> None:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.Delete):
+            targets = stmt.targets
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+            elif isinstance(t, ast.Attribute):
+                self._note_mutation(t)
+            elif isinstance(t, ast.Subscript):
+                if isinstance(t.value, ast.Attribute):
+                    self._note_mutation(t.value)
+
+    # -- expressions -----------------------------------------------------------
+
+    def _expr(self, expr, exempt: bool) -> None:
+        if isinstance(expr, ast.Lambda):
+            sub_ctx = ("loop" if id(expr) in self.cls.loop_lambdas else
+                       "done" if id(expr) in self.cls.done_lambdas else
+                       "plain")
+            sub = _Walk(self.lint, self.cls, self.method, sub_ctx)
+            sub._expr(expr.body, exempt)
+            return
+        if isinstance(expr, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._stmt(expr)
+            return
+        if isinstance(expr, ast.Await):
+            # the awaited call itself is exempt; its arguments are not
+            if isinstance(expr.value, ast.Call):
+                self._call_body(expr.value, exempt, awaited=True)
+            else:
+                self._expr(expr.value, exempt)
+            return
+        if isinstance(expr, ast.Call):
+            self._call_body(expr, exempt, awaited=False)
+            return
+        for child in ast.iter_child_nodes(expr):
+            self._expr(child, exempt)
+
+    def _call_body(self, call: ast.Call, exempt: bool,
+                   awaited: bool) -> None:
+        f = call.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else "")
+        if _is_self_attr(f):
+            self.cls.call_graph.setdefault(
+                self.method, set()).add(f.attr)
+            self.cls.self_calls.append(
+                (f.attr, call, self.method, self.ctx))
+        if not awaited and not exempt:
+            desc = self.lint._blocking_desc(call, self.cls)
+            if desc is not None:
+                self.cls.blocking.append(
+                    (desc, call, self.method, self.ctx, exempt))
+        if self.ctx == "done" and fname in _HANDOFF_CALLS \
+                and fname not in ("write", "writelines", "drain"):
+            self.cls.handoffs.append((
+                f"completing a future via .{fname}()", call, self.method))
+        elif self.ctx == "done" and fname in ("write", "writelines",
+                                              "drain"):
+            self.cls.handoffs.append((
+                f"transport .{fname}()", call, self.method))
+        # mutator method calls are mutations of their receiver
+        if fname in _MUTATORS and isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Attribute):
+            self._note_mutation(f.value)
+        arg_exempt = exempt or fname in _EXECUTOR_DISPATCH
+        self._expr(f.value, exempt) if isinstance(f, ast.Attribute) else None
+        for a in call.args:
+            self._expr(a, arg_exempt)
+        for kw in call.keywords:
+            self._expr(kw.value, arg_exempt)
+
+
+# -- tree-wide entry ---------------------------------------------------------
+
+
+def analyze_paths(paths, repo_root=None):
+    """Run Tier D over `paths`. Returns (findings, linters); the CLI folds
+    per-rule counts into the --json tier_d block."""
+    findings: list[Finding] = []
+    linters: list[AsyncLinter] = []
+    for p in paths:
+        explicit = os.path.isfile(p)
+        for fpath in iter_py_files(p):
+            lt = AsyncLinter(fpath, repo_root=repo_root, explicit=explicit)
+            findings.extend(lt.run())
+            linters.append(lt)
+    return findings, linters

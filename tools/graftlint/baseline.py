@@ -9,6 +9,12 @@ future large refactor land incrementally via `--update-baseline`.
 Fingerprints hash (rule, file, normalized source line) so edits elsewhere
 in the file don't invalidate entries; moving or editing the flagged line
 does, on purpose.
+
+Format v2 keeps a section per tier (`{"version": 2, "tiers": {"a": [...],
+"b": [...], "c": [...], "d": [...]}}`) so `--update-baseline --tier d`
+rewrites only the Tier D section: adopting a new tier can never silently
+re-baseline a regression in an older tier. v1 flat files
+(`{"findings": [...]}`) still load.
 """
 
 from __future__ import annotations
@@ -16,29 +22,62 @@ from __future__ import annotations
 import json
 import os
 
+from .findings import tier_of
+
+TIERS = ("a", "b", "c", "d")
+
+
+def _read(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _tier_entries(data: dict) -> dict[str, list[dict]]:
+    """Normalize either format to {tier: [entry, ...]}."""
+    out: dict[str, list[dict]] = {t: [] for t in TIERS}
+    if data.get("version", 1) >= 2:
+        for t in TIERS:
+            out[t] = list(data.get("tiers", {}).get(t, []))
+    else:
+        for e in data.get("findings", []):
+            out[tier_of(e.get("rule", "G000"))].append(e)
+    return out
+
 
 def load(path: str) -> set[str]:
-    if not os.path.exists(path):
+    data = _read(path)
+    if not data:
         return set()
-    with open(path, "r", encoding="utf-8") as f:
-        data = json.load(f)
-    return {e["fingerprint"] for e in data.get("findings", [])}
+    return {e["fingerprint"]
+            for entries in _tier_entries(data).values()
+            for e in entries}
 
 
-def write(path: str, finding_dicts: list[dict]) -> None:
-    data = {
-        "version": 1,
-        "findings": [
-            {
+def write(path: str, finding_dicts: list[dict],
+          tiers: tuple[str, ...] | None = None) -> None:
+    """Write the baseline. With `tiers`, only those sections are replaced
+    from `finding_dicts`; the other tiers' entries are carried over from
+    the existing file untouched (and finding_dicts entries outside the
+    requested tiers are ignored)."""
+    existing = _tier_entries(_read(path))
+    selected = tuple(tiers) if tiers else TIERS
+    fresh: dict[str, list[dict]] = {t: [] for t in TIERS}
+    for d in sorted(finding_dicts,
+                    key=lambda d: (d["file"], d["rule"], d["line"])):
+        t = tier_of(d["rule"])
+        if t in selected:
+            fresh[t].append({
                 "fingerprint": d["fingerprint"],
                 "rule": d["rule"],
                 "file": d["file"],
                 "note": d["message"],
-            }
-            for d in sorted(
-                finding_dicts, key=lambda d: (d["file"], d["rule"], d["line"])
-            )
-        ],
+            })
+    data = {
+        "version": 2,
+        "tiers": {t: (fresh[t] if t in selected else existing[t])
+                  for t in TIERS},
     }
     with open(path, "w", encoding="utf-8") as f:
         json.dump(data, f, indent=2)

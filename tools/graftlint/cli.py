@@ -1,15 +1,21 @@
 """graftlint CLI.
 
     python -m tools.graftlint [paths ...] [--json] [--no-jaxpr]
-                              [--no-concurrency]
+                              [--no-concurrency] [--no-async]
                               [--baseline FILE] [--update-baseline]
+                              [--tier {a,b,c,d}]
 
 Exit codes: 0 clean (or baselined-only), 1 findings, 2 internal error.
 Default target is the repo's ``redisson_tpu/`` tree with the committed
 baseline; Tier B (jaxpr audit) runs unless ``--no-jaxpr``; Tier C
-(concurrency discipline: G011-G014) runs unless ``--no-concurrency``.
-``--json`` output carries a ``tier_c`` block with per-rule counts and the
-static lock-order graph (edges + cycles).
+(concurrency discipline: G011-G014) runs unless ``--no-concurrency``;
+Tier D (asyncio/event-loop discipline: G015-G018) runs unless
+``--no-async``. ``--json`` output carries a ``tier_c`` block (per-rule
+counts + the static lock-order graph) and a ``tier_d`` block (per-rule
+counts + scoped-module stats). ``--update-baseline`` rewrites the whole
+baseline by default; ``--tier`` (repeatable) restricts the rewrite to
+that tier's section so adopting one tier cannot re-baseline another's
+regressions.
 """
 
 from __future__ import annotations
@@ -28,24 +34,38 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "baseline.json")
 
 TIER_C_RULES = ("G011", "G012", "G013", "G014")
+TIER_D_RULES = ("G015", "G016", "G017", "G018")
 
 
-def collect(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
+def collect(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
+            asynciol=True):
     """Run all tiers; returns finding dicts (with fingerprints). The
-    long-standing programmatic surface (`run_lint`) — see collect_full
-    for the tier_c lock-graph block."""
+    long-standing programmatic surface (`run_lint`) — see collect_tiers
+    for the tier_c/tier_d stat blocks."""
     dicts, _ = collect_full(paths, jaxpr=jaxpr, concurrency=concurrency,
-                            repo_root=repo_root)
+                            repo_root=repo_root, asynciol=asynciol)
     return dicts
 
 
-def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
-    """Run all tiers; returns (finding dicts with fingerprints, tier_c
-    block: per-rule counts + static lock-order graph edges/cycles)."""
+def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
+                 asynciol=True):
+    """Compat wrapper: returns (finding dicts, tier_c block)."""
+    dicts, tiers = collect_tiers(paths, jaxpr=jaxpr, concurrency=concurrency,
+                                 repo_root=repo_root, asynciol=asynciol)
+    return dicts, tiers["tier_c"]
+
+
+def collect_tiers(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
+                  asynciol=True):
+    """Run all tiers; returns (finding dicts with fingerprints,
+    {"tier_c": per-rule counts + lock-order graph,
+     "tier_d": per-rule counts + scoped-module stats})."""
     findings, linters = lint_paths(paths, repo_root=repo_root)
     sources = {lt.relpath: lt.lines for lt in linters}
     tier_c = {"rules": {r: 0 for r in TIER_C_RULES},
               "lock_graph": {"edges": [], "cycles": []}}
+    tier_d = {"rules": {r: 0 for r in TIER_D_RULES},
+              "modules": 0, "async_defs": 0, "confined_keys": 0}
     if concurrency:
         from .concurrency import analyze_paths
 
@@ -58,6 +78,20 @@ def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
             if f.rule in tier_c["rules"]:
                 tier_c["rules"][f.rule] += 1
         tier_c["lock_graph"] = graph
+    if asynciol:
+        from .asynclint import analyze_paths as analyze_async
+
+        d_findings, d_linters = analyze_async(paths, repo_root=repo_root)
+        findings += d_findings
+        for lt in d_linters:
+            sources.setdefault(lt.relpath, lt.lines)
+            if lt.scoped:
+                tier_d["modules"] += 1
+                tier_d["async_defs"] += lt.n_async_defs
+                tier_d["confined_keys"] += len(lt.confined)
+        for f in d_findings:
+            if f.rule in tier_d["rules"]:
+                tier_d["rules"][f.rule] += 1
     if jaxpr:
         from .jaxpr_audit import run_audits
 
@@ -68,14 +102,14 @@ def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT):
         text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
         out.append(f.to_dict(text))
     out.sort(key=lambda d: (d["file"], d["line"], d["rule"]))
-    return out, tier_c
+    return out, {"tier_c": tier_c, "tier_d": tier_d}
 
 
 def run(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.graftlint",
-        description="AST + jaxpr + concurrency static analysis for the "
-                    "redisson_tpu engine",
+        description="AST + jaxpr + concurrency + asyncio static analysis "
+                    "for the redisson_tpu engine",
     )
     ap.add_argument("paths", nargs="*",
                     default=[os.path.join(REPO_ROOT, "redisson_tpu")],
@@ -88,23 +122,35 @@ def run(argv=None) -> int:
                     help="skip Tier C (concurrency discipline: guarded-by, "
                          "shared mutation, blocking-under-lock, lock-order "
                          "graph)")
+    ap.add_argument("--no-async", action="store_true", dest="no_async",
+                    help="skip Tier D (asyncio/event-loop discipline: "
+                         "loop-block, unawaited, loop-affinity, handoff)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered fingerprints")
     ap.add_argument("--update-baseline", action="store_true",
                     help="write current findings to the baseline and exit 0")
+    ap.add_argument("--tier", action="append", choices=list(baseline_mod.TIERS),
+                    help="with --update-baseline: rewrite only this tier's "
+                         "baseline section (repeatable); other tiers' "
+                         "entries are preserved verbatim")
     args = ap.parse_args(argv)
 
     try:
-        dicts, tier_c = collect_full(args.paths, jaxpr=not args.no_jaxpr,
-                                     concurrency=not args.no_concurrency)
+        dicts, tiers = collect_tiers(args.paths, jaxpr=not args.no_jaxpr,
+                                     concurrency=not args.no_concurrency,
+                                     asynciol=not args.no_async)
     except Exception as exc:  # noqa: BLE001
         print(f"graftlint: internal error: {type(exc).__name__}: {exc}",
               file=sys.stderr)
         return 2
+    tier_c, tier_d = tiers["tier_c"], tiers["tier_d"]
 
     if args.update_baseline:
-        baseline_mod.write(args.baseline, dicts)
-        print(f"baseline updated: {len(dicts)} finding(s) -> {args.baseline}")
+        baseline_mod.write(args.baseline, dicts,
+                           tiers=tuple(args.tier) if args.tier else None)
+        scope = ",".join(args.tier) if args.tier else "all tiers"
+        print(f"baseline updated ({scope}): {len(dicts)} finding(s) "
+              f"collected -> {args.baseline}")
         return 0
 
     grandfathered = baseline_mod.load(args.baseline)
@@ -113,7 +159,8 @@ def run(argv=None) -> int:
 
     if args.as_json:
         print(json.dumps(
-            {"findings": fresh, "baselined": baselined, "tier_c": tier_c},
+            {"findings": fresh, "baselined": baselined,
+             "tier_c": tier_c, "tier_d": tier_d},
             indent=2))
     else:
         for d in fresh:
@@ -124,5 +171,8 @@ def run(argv=None) -> int:
         ncycles = len(tier_c["lock_graph"]["cycles"])
         nedges = len(tier_c["lock_graph"]["edges"])
         print(f"{len(fresh)} finding(s), {len(baselined)} baselined; "
-              f"lock-order graph: {nedges} edge(s), {ncycles} cycle(s)")
+              f"lock-order graph: {nedges} edge(s), {ncycles} cycle(s); "
+              f"tier D: {tier_d['modules']} module(s), "
+              f"{tier_d['async_defs']} async def(s), "
+              f"{tier_d['confined_keys']} confined key(s)")
     return 1 if fresh else 0

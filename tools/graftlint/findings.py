@@ -2,7 +2,8 @@
 
 Rule ids are stable (baseline fingerprints embed them). Tier A (AST) rules
 are G001-G010; tier B (jaxpr) rules are J0xx; tier C (concurrency) rules
-are G011-G014. Each rule has a short alias usable
+are G011-G014; tier D (asyncio/event-loop discipline) rules are
+G015-G018. Each rule has a short alias usable
 in suppression comments: `# graftlint: allow-<alias>(reason)` — a reason is
 mandatory, an empty `allow-sync()` does not suppress.
 """
@@ -121,10 +122,56 @@ RULES = {
         "static lock-order cycle: nested `with`-acquisitions form a cycle "
         "in the tree-wide lock-order graph — a potential deadlock",
     ),
+    "G015": (
+        "loop",
+        "blocking call reachable from event-loop context (Future.result, "
+        "threading lock.acquire/Event.wait, queue.Queue.get/put, "
+        "time.sleep, fsync, sync socket/file IO, engine execute_sync) — "
+        "one blocked callback stalls every connection on the loop; "
+        "await/run_in_executor/to_thread are the sanctioned escapes",
+    ),
+    "G016": (
+        "unawaited",
+        "coroutine called but never awaited (the body never runs), or a "
+        "create_task/ensure_future result dropped without a held "
+        "reference — the loop keeps only a weak ref, so the GC can "
+        "collect the task mid-flight",
+    ),
+    "G017": (
+        "affinity",
+        "loop-affinity violation: state declared in the module's "
+        "LOOP_CONFINED table is mutated from a non-loop thread-entry "
+        "root (Thread target, concurrent.futures done-callback) without "
+        "call_soon_threadsafe/run_coroutine_threadsafe",
+    ),
+    "G018": (
+        "handoff",
+        "unmarshalled handoff: completing an asyncio future "
+        "(set_result/set_exception), touching a transport, or calling a "
+        "loop-confined method directly from a concurrent.futures "
+        "done-callback — the callback runs on the resolving executor "
+        "thread, not the loop",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
 }
+
+
+def tier_of(rule: str) -> str:
+    """Baseline section for a rule id: 'a' (AST G001-G010), 'b' (jaxpr
+    J0xx), 'c' (concurrency G011-G014), 'd' (asyncio G015-G018)."""
+    if rule.startswith("J"):
+        return "b"
+    try:
+        n = int(rule[1:])
+    except ValueError:
+        return "a"
+    if n >= 15:
+        return "d"
+    if n >= 11:
+        return "c"
+    return "a"
 
 #: suppression-comment name -> rule id (both the id and the alias work)
 SUPPRESS_ALIASES = {}
