@@ -1006,6 +1006,75 @@ def bench_replica(quick=False):
     return out
 
 
+def bench_geo(quick=False):
+    """Active-active geo numbers (PR 18): geo_convergence_p99_s — wall
+    time from an acked semilattice write batch at site A to its delivery
+    and retirement at site B (version-vector catch-up + every dispatched
+    remote apply done), and geo_link_bytes_per_op — folded/sparse wire
+    bytes per shipped journal record, against the raw payload bytes the
+    journal itself carries for the same records."""
+    import os
+    import shutil
+    import tempfile
+
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.geo import connect_sites, converge
+
+    rounds = 20 if quick else 60
+    batch = 256 if quick else 2048
+    tmp = tempfile.mkdtemp(prefix="rtpu-bench-geo-")
+    out = {}
+
+    def site(sid):
+        cfg = Config()
+        cfg.use_local()
+        cfg.use_persist(os.path.join(tmp, sid)).fsync = "always"
+        g = cfg.use_geo(sid)
+        g.poll_interval_s = 0.002
+        g.anti_entropy_interval_s = 0.2
+        return RedissonTPU.create(cfg)
+
+    a, b = site("A"), site("B")
+    try:
+        connect_sites([a, b])
+        hll = a.get_hyper_log_log("geo:h")
+        bits = a.get_bit_set("geo:bits")
+        hll.add_all([f"warm{i}" for i in range(batch)])
+        assert converge([a, b], 60), "geo bench mesh never settled"
+        lat = []
+        applier_b = b.geo.applier
+        for r in range(rounds):
+            hll.add_all([f"r{r}:{i}" for i in range(batch)])
+            bits.set_bits(range(r, batch, rounds))
+            head = a.geo.journal_last_seq()
+            t0 = time.perf_counter()
+            while (applier_b.vv.get("A", 0) < head or applier_b.pending()):
+                time.sleep(0.0005)
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        link = a.geo.links["B"].stats
+        shipped = max(link["shipped_records"], 1)
+        out = {
+            "geo_convergence_p50_s": round(lat[len(lat) // 2], 4),
+            "geo_convergence_p99_s": round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
+            "geo_link_bytes_per_op": round(link["link_bytes"] / shipped, 1),
+            "geo_raw_bytes_per_op": round(link["raw_bytes"] / shipped, 1),
+            "rounds": rounds,
+            "batch_writes": batch,
+        }
+    finally:
+        a.shutdown()
+        b.shutdown()
+        shutil.rmtree(tmp, ignore_errors=True)
+    print(f"# geo: convergence p50 {out['geo_convergence_p50_s'] * 1e3:.1f}"
+          f"ms / p99 {out['geo_convergence_p99_s'] * 1e3:.1f}ms; "
+          f"{out['geo_link_bytes_per_op']:,.0f}B/op on the link vs "
+          f"{out['geo_raw_bytes_per_op']:,.0f}B/op raw", file=sys.stderr)
+    return out
+
+
 def bench_ha(quick=False):
     """Shard-level HA numbers (PR 14): cluster_failover_s — wall time
     from killing a shard's primary to the first acked write on its
@@ -1305,6 +1374,10 @@ def main():
         result["ha"] = bench_ha(quick)
     except Exception as exc:  # noqa: BLE001
         print(f"# ha bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["geo"] = bench_geo(quick)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# geo bench failed: {exc!r}", file=sys.stderr)
     try:
         mem = bench_memstat(1 << 12 if quick else 1 << 18)
         result["hbm_live_bytes"] = mem["hbm_live_bytes"]

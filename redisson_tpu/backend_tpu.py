@@ -473,14 +473,18 @@ class TpuBackend:
     one device call carries keys for many sketches via a per-key row
     vector, like the pod tier's bank_insert)."""
 
-    GLOBAL_COALESCE = frozenset({"hll_add", "bloom_add", "bitset_set"})
+    GLOBAL_COALESCE = frozenset({"hll_add", "bloom_add", "bitset_set",
+                                 "geo_merge"})
 
     #: Cross-target steal aliasing for the executor: all three delta kinds
     #: share one gate group, so one pipeline window may stack hll_add,
     #: bloom_add and bitset_set runs for many targets into a SINGLE fused
     #: delta-merge launch (ingest/delta.py + engine.delta_merge_stack).
+    #: geo_merge (pre-folded remote site planes, geo/) shares the group:
+    #: remote convergence rides the same fused launch as local writes —
+    #: one batched semilattice max per window regardless of remote op count.
     COALESCE_GROUPS = {"hll_add": "delta", "bloom_add": "delta",
-                       "bitset_set": "delta"}
+                       "bitset_set": "delta", "geo_merge": "delta"}
 
     #: run() commits all observable state (store swaps, bank mutation, row
     #: versions) on the dispatcher thread before returning — only result
@@ -579,6 +583,8 @@ class TpuBackend:
             "tape_runs": 0,       # windows retired via the tape megakernel
             "window_launches": 0,  # device dispatches issued retiring those
             "launch_us": 0.0,     # host wall time spent issuing them
+            "geo_planes": 0,      # remote site planes through the fused path
+            "geo_classic": 0,     # remote planes absorbed via the fallback
         }
         # Executor window handoff: last window sequence seen by run().
         self.last_window = None
@@ -799,6 +805,29 @@ class TpuBackend:
         through the classic handlers, which isolate them per target) and
         the planner must pick 'delta' or 'tape' for this batch size.
         Returns the planned path name, or None for the classic path."""
+        if kind == "geo_merge":
+            # Remote planes arrive pre-folded: shipping them is sunk cost,
+            # so no planner consult — only the per-target type gates. Never
+            # 'tape' (the megakernel's per-op completion contract — newly
+            # bits, pre-merge SETBIT reads — doesn't apply to remote
+            # planes); _delta_dispatch keeps geo groups off tape windows.
+            inner = tops[0].payload["inner"]
+            if inner == "hll_add":
+                if (tname not in self._rows
+                        and self.store.get(tname) is not None):
+                    return None  # name holds a bitset/bloom: WRONGTYPE
+                return "delta"
+            if tname in self._rows:
+                return None  # name holds an hll: WRONGTYPE
+            obj = self.store.get(tname)
+            if inner == "bloom_add":
+                if obj is not None and (obj.otype != ObjectType.BLOOM
+                                        or obj.meta.get("blocked")):
+                    return None
+                return "delta"
+            if obj is not None and obj.otype != ObjectType.BITSET:
+                return None
+            return "delta"
         nkeys = sum(op.nkeys or delta_mod.payload_nkeys(kind, op.payload)
                     for op in tops)
         if kind == "hll_add":
@@ -854,7 +883,17 @@ class TpuBackend:
             else:
                 classic.extend(tops)
         if delta_groups:
-            self._delta_window(delta_groups, tape=use_tape)
+            geo = [g for g in delta_groups if g[1] == "geo_merge"]
+            rest = [g for g in delta_groups if g[1] != "geo_merge"]
+            if use_tape and geo:
+                # Geo planes never ride the tape arena (see _delta_planned):
+                # local groups keep the megakernel, remote planes retire in
+                # their own single fused merge launch for the window.
+                if rest:
+                    self._delta_window(rest, tape=True)
+                self._delta_window(geo, tape=False)
+            else:
+                self._delta_window(delta_groups, tape=use_tape)
         if classic:
             self._classic_group_run(classic)
 
@@ -985,6 +1024,8 @@ class TpuBackend:
         row's pre-merge bits in its own packed output plane."""
         from redisson_tpu import native as native_mod
 
+        if kind == "geo_merge":
+            return self._geo_fold_group(tname, tops)
         payloads = [op.payload for op in tops]
         nkeys = sum(delta_mod.payload_nkeys(kind, p) for p in payloads)
         raw = sum(delta_mod.payload_raw_bytes(kind, p) for p in payloads)
@@ -1039,6 +1080,100 @@ class TpuBackend:
         dp = delta_mod.encode(kind, tname, plane, cells=nbits, packed=True,
                               nkeys=nkeys, raw_bytes=raw)
         return dp, {"kind": kind, "ops": tops, "old_packed": old_packed}
+
+    # -- Geo remote planes (cross-site convergence, geo/) -------------------
+    #
+    # A geo_merge payload is a delta plane another site already folded:
+    # {"inner": hll_add|bloom_add|bitset_set, "cells", dense "plane" or
+    # sparse "idx"/"val"/"plane_bytes", "meta", "seq"/"site" stamp}. The
+    # fold below only combines same-target planes (elementwise max / OR)
+    # and re-encodes them as an ordinary DeltaPlane carrying the INNER
+    # kind, so _delta_merge_chunk's old-row gather and writeback logic
+    # serve remote applies unchanged — one fused launch per window no
+    # matter how many remote ops the planes summarize.
+
+    @staticmethod
+    def _geo_plane(payload) -> np.ndarray:
+        """Materialize a geo payload's dense byte plane (the link ships
+        sparse (idx, val) pairs when the touched fraction is small)."""
+        if "plane" in payload:
+            return np.asarray(payload["plane"], np.uint8)
+        plane = np.zeros((int(payload["plane_bytes"]),), np.uint8)
+        idx = np.asarray(payload["idx"], np.int64)
+        if idx.size:
+            np.maximum.at(plane, idx, np.asarray(payload["val"], np.uint8))
+        return plane
+
+    def _geo_bloom_ensure(self, target: str, meta: dict):
+        """Create the local twin of a remote bloom filter on first sight
+        (the origin's init params ship with every merge plane)."""
+        self._check_not_hll(target, ObjectType.BLOOM)
+        obj = self.store.get(target, ObjectType.BLOOM)
+        if obj is not None:
+            return obj
+        m = int(meta.get("size", 0))
+        if m <= 0:
+            raise RuntimeError(
+                f"geo bloom plane for uninitialized filter '{target}' "
+                "carries no size meta")
+        obj = self.store.get_or_create(
+            target, ObjectType.BLOOM, lambda: bitset_ops.make(m),
+            {k: v for k, v in meta.items()})
+        self._touch(target)
+        return obj
+
+    def _geo_fold_group(self, tname: str, tops: List[Op]):
+        """Fold one target's remote planes into a DeltaPlane + geo spec
+        for the fused merge (the geo_merge half of _delta_fold_group)."""
+        payloads = [op.payload for op in tops]
+        inner = payloads[0]["inner"]
+        nkeys = sum(int(p.get("nkeys", 0)) for p in payloads)
+        raw = sum(int(p.get("raw", 0)) for p in payloads)
+        self.counters["geo_planes"] += len(payloads)
+        spec = {"kind": "geo_merge", "geo": True, "ops": tops}
+        if inner == "hll_add":
+            self._hll_row(tname)  # allocate the bank row (may grow bank)
+            plane = np.zeros((delta_mod.HLL_M,), np.uint8)
+            for p in payloads:
+                np.maximum(plane, self._geo_plane(p), out=plane)
+            dp = delta_mod.encode("hll_add", tname, plane,
+                                  cells=delta_mod.HLL_M, packed=False,
+                                  nkeys=nkeys, raw_bytes=raw)
+            return dp, spec
+        if inner == "bloom_add":
+            obj = self._geo_bloom_ensure(tname, payloads[0].get("meta") or {})
+            m = obj.meta["size"]
+            # Pending host-mirror bits must reach the device BEFORE the
+            # fused merge swaps the store state (the mirror is dropped in
+            # the writeback, so bits still parked there would be lost).
+            self._bloom_device_sync(tname)
+            plane = np.zeros(((m + 7) >> 3,), np.uint8)
+            for p in payloads:
+                if int(p["cells"]) != m:
+                    raise RuntimeError(
+                        f"geo bloom plane for '{tname}' sized {p['cells']} "
+                        f"bits vs local filter {m} — sites must init bloom "
+                        "filters with identical parameters")
+                np.bitwise_or(plane, self._geo_plane(p), out=plane)
+            dp = delta_mod.encode("bloom_add", tname, plane, cells=m,
+                                  packed=True, nkeys=nkeys, raw_bytes=raw)
+            return dp, spec
+        # bitset_set
+        obj = self._bitset(tname, nbits=1024)
+        mx = max(int(p["cells"]) for p in payloads) - 1
+        obj = self._grow_for(obj, max(mx, 0))
+        ext = max(int((p.get("meta") or {}).get("max_idx", -1))
+                  for p in payloads)
+        if ext >= 0:
+            self._extend(obj, ext)
+        nbits = obj.state.shape[0]
+        plane = np.zeros(((nbits + 7) >> 3,), np.uint8)
+        for p in payloads:
+            src = self._geo_plane(p)
+            np.bitwise_or(plane[:src.shape[0]], src, out=plane[:src.shape[0]])
+        dp = delta_mod.encode("bitset_set", tname, plane, cells=nbits,
+                              packed=True, nkeys=nkeys, raw_bytes=raw)
+        return dp, spec
 
     def _delta_merge_chunk(self, planes, specs) -> None:
         """Retire one chunk of delta planes in a single fused merge: build
@@ -1119,10 +1254,17 @@ class TpuBackend:
             self.store.swap(p.target, merged[i, :p.cells])
             self._touch(p.target)
             if p.kind == "bloom_add":
-                # device == mirror + this batch == scratch, by construction
-                mir = specs[i]["mirror"]
-                mir["bits"] = specs[i]["scratch"]
-                mir["synced_dev"] = self.store.get(p.target).version
+                if specs[i].get("geo"):
+                    # Remote bits merged device-side only: drop the host
+                    # mirror (rebuilt from the device on next use) rather
+                    # than guess at its post-merge contents.
+                    self._bloom_mirrors.pop(p.target, None)
+                else:
+                    # device == mirror + this batch == scratch, by
+                    # construction
+                    mir = specs[i]["mirror"]
+                    mir["bits"] = specs[i]["scratch"]
+                    mir["synced_dev"] = self.store.get(p.target).version
         # Observed dispatch cost (bench's launches_per_window /
         # launch_us_per_window): named kernel entry points issued above +
         # the host wall time spent issuing them (non-blocking — this is
@@ -1139,7 +1281,8 @@ class TpuBackend:
                 host_changed = np.asarray(flag)
                 host_old = {i: np.asarray(spec["old_packed"])
                             for i, p, spec in chunk_specs
-                            if p.kind == "bitset_set"}
+                            if p.kind == "bitset_set"
+                            and spec.get("old_packed") is not None}
             except Exception as exc:  # noqa: BLE001
                 exc = classify(exc, seam="d2h_complete")
                 for _i, _p, spec in chunk_specs:
@@ -1148,7 +1291,13 @@ class TpuBackend:
                             op.future.set_exception(exc)
                 return
             for i, p, spec in chunk_specs:
-                if p.kind == "hll_add":
+                if spec.get("geo"):
+                    # Remote planes carry no per-key result contract: the
+                    # applier only needs the apply acknowledged.
+                    for op in spec["ops"]:
+                        if not op.future.done():
+                            op.future.set_result(True)
+                elif p.kind == "hll_add":
                     # Per-target PFADD bool: did ANY register of this row
                     # rise this window (hostfold precedent).
                     v = bool(host_changed[i])
@@ -2554,3 +2703,142 @@ class TpuBackend:
         self._account_bank()
         for op in ops:
             op.future.set_result(None)
+
+    # -- geo remote apply (cross-site replication, geo/) --------------------
+
+    def _op_geo_merge(self, target: str, ops: List[Op]) -> None:
+        """Classic fallback absorb for remote delta planes — non-delta
+        ingest configs and targets the delta gate rejected (blocked
+        blooms, WRONGTYPE probes). Merges each plane into local state on
+        the host; blocks the dispatcher on a D2H readback, so the fused
+        _geo_fold_group path is the hot path."""
+        self.counters["geo_classic"] += len(ops)
+        for op in ops:
+            try:
+                self._geo_merge_one(target, op.payload)
+            except Exception as exc:  # noqa: BLE001 — per-op isolation
+                op.future.set_exception(classify(exc, seam="kernel_launch"))
+                continue
+            op.future.set_result(True)
+
+    def _geo_merge_one(self, target: str, payload: dict) -> None:
+        import jax
+
+        inner = payload["inner"]
+        plane = self._geo_plane(payload)
+        if inner == "hll_add":
+            row = self._hll_row(target)  # WRONGTYPE if a store object
+            # graftlint: allow-sync(classic geo fallback — deliberately blocks the dispatcher on the readback; the fused _geo_fold_group path is the hot path and never lands here)
+            cur = np.asarray(
+                engine.hll_bank_row(self._ensure_bank(), np.int32(row)))
+            regs = np.maximum(cur.astype(np.uint8), plane).astype(np.int32)
+            self.bank = engine.hll_bank_set_row(
+                self.bank, jax.device_put(regs, self.store.device),
+                np.int32(row))
+            self._bump(target)
+            return
+        if inner == "bloom_add":
+            obj = self._geo_bloom_ensure(target, payload.get("meta") or {})
+            m = obj.meta["size"]
+            if int(payload["cells"]) != m:
+                raise RuntimeError(
+                    f"geo bloom plane for '{target}' sized "
+                    f"{payload['cells']} bits vs local filter {m}")
+            self._bloom_device_sync(target)
+            obj = self.store.get(target, ObjectType.BLOOM)
+            merged = np.asarray(obj.state).astype(np.uint8)
+            cells = np.unpackbits(plane)[:m]
+            np.maximum(merged, cells, out=merged)
+            self.store.swap(
+                target, jax.device_put(merged, self.store.device))
+            self._bloom_mirrors.pop(target, None)
+            self._touch(target)
+            return
+        # bitset_set
+        obj = self._bitset(target, nbits=1024)
+        cells = int(payload["cells"])
+        obj = self._grow_for(obj, max(cells - 1, 0))
+        ext = int((payload.get("meta") or {}).get("max_idx", -1))
+        if ext >= 0:
+            self._extend(obj, ext)
+        merged = np.asarray(obj.state).astype(np.uint8)
+        unp = np.unpackbits(plane)
+        n = min(unp.shape[0], merged.shape[0])
+        np.maximum(merged[:n], unp[:n], out=merged[:n])
+        self.store.swap(target, jax.device_put(merged, self.store.device))
+        self._touch(target)
+
+    def _op_geo_replace(self, target: str, ops: List[Op]) -> None:
+        """Stamped full-state overwrite — the LWW half of the geo contract
+        (bitset clears, tombstone resurrections, anti-entropy snapshot
+        repair). The applier (geo/applier.py) decides WHETHER the stamp
+        wins before dispatching; this handler only installs the state."""
+        import jax
+
+        for op in ops:
+            try:
+                payload = op.payload
+                inner = payload["inner"]
+                plane = self._geo_plane(payload)
+                if inner == "hll_add":
+                    row = self._hll_row(target)
+                    self.bank = engine.hll_bank_set_row(
+                        self._ensure_bank(),
+                        jax.device_put(plane.astype(np.int32),
+                                       self.store.device),
+                        np.int32(row))
+                    self._bump(target)
+                else:
+                    otype = (ObjectType.BLOOM if inner == "bloom_add"
+                             else ObjectType.BITSET)
+                    self._check_not_hll(target, otype)
+                    cells = int(payload["cells"])
+                    host = np.unpackbits(plane)[:cells].astype(np.uint8)
+                    meta = dict(payload.get("meta") or {})
+                    arr = jax.device_put(host, self.store.device)
+                    if otype == ObjectType.BITSET:
+                        meta.setdefault("nbits", cells)
+                        meta.pop("max_idx", None)
+                        meta.setdefault("extent_bits", cells)
+                    obj = self.store.get_or_create(
+                        target, otype, lambda: arr, meta)
+                    if obj.otype != otype:
+                        raise WrongTypeError(
+                            f"key '{target}' holds {obj.otype}, geo "
+                            f"replace carries {otype}")
+                    self.store.swap(target, arr)
+                    obj.meta.update(meta)
+                    self._bloom_mirrors.pop(target, None)
+                    self._touch(target)
+                self.read_cache.invalidate(target)
+            except Exception as exc:  # noqa: BLE001 — per-op isolation
+                op.future.set_exception(classify(exc, seam="kernel_launch"))
+                continue
+            op.future.set_result(True)
+
+    def _op_geo_delete(self, target: str, ops: List[Op]) -> None:
+        """Stamped tombstone delete: state-wise identical to _op_delete;
+        the (origin_seq, site) stamp in the payload exists for the journal
+        (crash replay) and the applier's LWW bookkeeping."""
+        self._op_delete(target, ops)
+
+    def _op_geo_flush(self, target: str, ops: List[Op]) -> None:
+        """Stamped keyspace flush: deletes the CONCRETE key list the
+        applier resolved against its LWW floors (keys with writes newer
+        than the flush stamp survive) — replay-deterministic, unlike
+        re-enumerating the keyspace at recovery time."""
+        for op in ops:
+            wiped = 0
+            for name in op.payload.get("keys", ()):
+                row = self._alloc.release(name)
+                if row is not None:
+                    self.bank = engine.hll_bank_zero_row(
+                        self.bank, np.int32(row))
+                    wiped += 1
+                else:
+                    self._bloom_mirrors.pop(name, None)
+                    if self.store.delete(name):
+                        wiped += 1
+                self._touch(name)
+                self.read_cache.invalidate(name)
+            op.future.set_result(wiped)

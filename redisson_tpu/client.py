@@ -254,6 +254,29 @@ class RedissonTPU:
             from redisson_tpu.observability import register_replica
 
             register_replica(self.metrics, self._replicas)
+        # Geo-replication site (geo/): this engine becomes one active site
+        # in a cross-site mesh; its journal ships to peers as CRDT delta
+        # planes. Wired after replicas (both tail the same journal) —
+        # peering happens at runtime via geo.connect_sites(...).
+        self._geo = None
+        gcfg = self.config.geo
+        if gcfg is not None:
+            if self._persist is None:
+                self.shutdown()
+                raise ValueError(
+                    "Config.geo requires Config.persist with a dir — the "
+                    "persist journal is the geo replication transport")
+            from redisson_tpu.geo import GeoManager
+
+            self._geo = GeoManager(self, gcfg)
+            try:
+                self._geo.start()
+            except Exception:
+                self.shutdown()
+                raise
+            from redisson_tpu.observability import register_geo
+
+            register_geo(self.metrics, self._geo)
         if self.config.redis is not None and mode != "redis":
             try:
                 self._connect_durability()
@@ -629,6 +652,11 @@ class RedissonTPU:
     def replicas(self):
         """The ReplicaManager when Config.replicas is set, else None."""
         return getattr(self, "_replicas", None)
+
+    @property
+    def geo(self):
+        """The GeoManager when Config.geo is set, else None."""
+        return getattr(self, "_geo", None)
 
     def wait_for_replicas(self, n: int, timeout_s: float = 5.0) -> int:
         """Redis WAIT analogue: block until n replicas have applied at
@@ -1060,6 +1088,16 @@ class RedissonTPU:
             sections["memory"] = self._memreport.info_memory()
         if getattr(self, "_persist", None) is not None:
             sections["persistence"] = self._persist.stats()
+        replication = None
+        if getattr(self, "_geo", None) is not None:
+            replication = self._geo.info()
+        elif getattr(self, "_replicas", None) is not None:
+            replication = {"role": "primary"}
+        if replication is not None:
+            if getattr(self, "_replicas", None) is not None:
+                replication["connected_replicas"] = len(
+                    self._replicas.replicas)
+            sections["replication"] = replication
         if self.cluster is not None:
             sections["cluster"] = self.cluster.cluster_info()
         if section is not None:
@@ -1109,6 +1147,16 @@ class RedissonTPU:
             except Exception:
                 pass
             self._fault = None
+        if getattr(self, "_geo", None) is not None:
+            # Geo site before replicas/persist: link threads read this
+            # journal and peer appliers dispatch into this executor; both
+            # must quiesce (and the LWW sidecar flush) while the stack
+            # under them still accepts work.
+            try:
+                self._geo.close()
+            except Exception:
+                pass
+            self._geo = None
         if getattr(self, "_replicas", None) is not None:
             # Replica fleet next: the prober must stop before the executor
             # it polls drains, and each replica shuts its own client down

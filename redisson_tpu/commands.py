@@ -227,6 +227,17 @@ OP_TABLE = {d.kind: d for d in [
     # Barrier flushing host-mirror bloom bits into device state before a
     # device-side read (durability/checkpoint); internal, no wire analogue.
     _d("bloom_sync", "-", True, "tpu"),
+    # -- geo tier (geo/; active-active cross-site replication) --------------
+    # Remote mutations arrive as these kinds, NOT as replayed origin ops:
+    # journaling them locally (write=True) makes crash recovery replay the
+    # remote state, and the SiteLink never re-ships geo_* records, which
+    # breaks the full-mesh echo loop. geo_merge is group-coalesced with
+    # the local delta kinds, so a window of remote planes plus local
+    # writes retires in ONE fused delta_merge_stack launch.
+    _d("geo_merge", "-", True, "tpu"),     # stamped semilattice delta plane
+    _d("geo_replace", "-", True, "tpu"),   # stamped full-state overwrite (LWW)
+    _d("geo_delete", "-", True, "tpu"),    # stamped tombstone delete (LWW)
+    _d("geo_flush", "-", True, "tpu"),     # stamped keyspace flush (key list)
     # -- cluster tier (cluster/; ClusterConnectionManager.java semantics) ---
     # Slot-ownership transitions are journaled WRITES: the migrate_flip
     # record is the cutover point in the source shard's journal (everything

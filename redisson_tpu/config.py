@@ -330,6 +330,35 @@ class ReplicaConfig:
 
 
 @dataclass
+class GeoConfig:
+    """Active-active geo-replication (redisson_tpu/geo/): this site is one
+    of N independent full engine stacks ("sites") that each accept local
+    writes and asynchronously converge. The persist journal IS the
+    replication transport (exactly as it is for `replica/`): per-peer
+    SiteLinks tail the local journal, fold the sketch-tier write stream
+    into stamped delta planes, and ship them; the receiving site applies
+    them through the fused delta/tape merge path as `geo_*` op kinds.
+    Requires `Config.persist` with a dir and the native fold library
+    (same precondition as ingest='delta'). Peering is wired at runtime
+    with `geo.connect_sites([...])` / `client.geo.connect(peers)` — the
+    config names the site and tunes the link/anti-entropy cadence."""
+
+    # Unique site name in the fleet ("" = derived from the client id).
+    # Stamps are (origin_seq, site_id); ties break on the id string, so
+    # give sites stable, distinct names.
+    site_id: str = ""
+    # Link tail cadence + max journal records folded per poll batch.
+    poll_interval_s: float = 0.01
+    batch_records: int = 4096
+    # Anti-entropy cadence: version-vector exchange (peer-restart rewind),
+    # JournalGap snapshot repair, and sidecar meta persistence.
+    anti_entropy_interval_s: float = 0.5
+    # Bound on unresolved remote-apply futures tracked per applier (the
+    # convergence watermark window; older entries are dropped once done).
+    apply_window: int = 4096
+
+
+@dataclass
 class WireConfig:
     """RESP2/RESP3 network front-end (redisson_tpu/wire/): a TCP server
     real redis clients (redis-cli, redis-py, Redisson) connect to; pipelined
@@ -377,6 +406,8 @@ class Config:
     replicas: Optional[ReplicaConfig] = None
     # RESP wire front-end (None = facade-only access, no TCP listener).
     wire: Optional[WireConfig] = None
+    # Active-active geo-replication (None = this engine is not a site).
+    geo: Optional[GeoConfig] = None
     # Durability: flush sketch state to redis every N seconds (0 = off).
     flush_interval_s: float = 0.0
     codec: str = "json"  # default value codec, reference Config.java:53-55
@@ -466,6 +497,12 @@ class Config:
             self.wire.port = port
         return self.wire
 
+    def use_geo(self, site_id: str = "") -> "GeoConfig":
+        self.geo = self.geo or GeoConfig()
+        if site_id:
+            self.geo.site_id = site_id
+        return self.geo
+
     # -- (de)serialization (ConfigSupport.java analogue) --------------------
 
     def to_dict(self) -> Dict[str, Any]:
@@ -503,6 +540,7 @@ class Config:
             "cluster": ClusterConfig,
             "replicas": ReplicaConfig,
             "wire": WireConfig,
+            "geo": GeoConfig,
         }
         for key, value in d.items():
             sec = section_types.get(key)

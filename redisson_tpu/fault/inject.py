@@ -27,6 +27,10 @@ Seams (each is one `fire()` call placed in product code):
                   injected fault is a DROPCONN: the server kills the socket
                   mid-pipeline (bytes read, commands not yet dispatched),
                   exercising the reply-window's no-misattribution guarantee
+  geo_link        geo/link.py — a site link's journal-tail poll; an injected
+                  fault models a cross-site PARTITION (the link ships nothing
+                  for `times` polls, its cursor holds, anti-entropy repairs
+                  the backlog after heal); `target` matches the PEER site id
 
 Cost when disabled: `fire()` reads one module global and returns — no
 lock, no allocation — so the instrumentation stays under the <1%
@@ -53,6 +57,7 @@ SEAMS = (
     "replica_tail",
     "health_probe",
     "wire_conn",
+    "geo_link",
 )
 
 #: fault-class name (as written in plans/config dicts) -> taxonomy class

@@ -157,6 +157,11 @@ def foldable(kind: str, payload) -> bool:
     eligibility for every native-backed form requires the library."""
     if payload is None or not isinstance(payload, dict):
         return False
+    if kind == "geo_merge":
+        # Remote planes arrive pre-folded by the origin site (dense
+        # "plane" bytes or a sparse idx/val pair) — nothing to hash, so
+        # geo eligibility does not require the native library.
+        return "plane" in payload or "idx" in payload
     if kind == "bitset_set":
         return "idx" in payload
     if "device_packed" in payload:

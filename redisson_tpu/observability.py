@@ -548,3 +548,34 @@ def register_replica(registry: MetricsRegistry, manager) -> None:
     registry.gauge("replica.moved_retries",
                    lambda: (manager.router.replica_moved_retries
                             if manager.router else 0))
+
+
+def register_geo(registry: MetricsRegistry, manager) -> None:
+    """Geo-replication site gauges (geo/manager.py): cross-site link
+    health (worst-case lag in records and seconds), LWW arbitration
+    counters (applies / suppressions / DEL-race resurrections), and
+    total bytes shipped vs what the raw key batches would have cost —
+    the CRDT-plane compression the link exists for."""
+    registry.gauge("geo.peers", lambda: len(manager.links))
+    registry.gauge("geo.applied", lambda: manager.applier.applied)
+    registry.gauge("geo.suppressed", lambda: manager.applier.suppressed)
+    registry.gauge("geo.resurrections",
+                   lambda: manager.applier.resurrections)
+
+    def _worst(field):
+        def read():
+            lags = [l.lag()[field] for l in list(manager.links.values())]
+            return max(lags) if lags else 0
+        return read
+
+    def _total(stat):
+        def read():
+            return sum(l.stats[stat] for l in list(manager.links.values()))
+        return read
+
+    registry.gauge("geo.max_lag_records", _worst("records"))
+    registry.gauge("geo.max_lag_seconds", _worst("seconds"))
+    registry.gauge("geo.link_bytes", _total("link_bytes"))
+    registry.gauge("geo.raw_bytes", _total("raw_bytes"))
+    registry.gauge("geo.partitions", _total("partitions"))
+    registry.gauge("geo.repairs", _total("repairs"))

@@ -1,0 +1,475 @@
+"""geo/ — active-active geo-replication over the persist journal.
+
+Layers:
+
+1. Wiring — geo requires persist (the journal IS the transport), config
+   round-trip, kind-set contract against OP_TABLE.
+2. Convergence — two sites converge to bit-identical sketch state
+   through the FUSED delta path (geo_planes > 0, geo_classic == 0), and
+   the link ships fewer bytes than the raw journal payloads.
+3. Destructive LWW — DEL wins when newer, loses (with add-wins
+   resurrection) when older; rename, bitset_clear, flushall all settle
+   to the same state everywhere. These pin the documented tombstone
+   contract (geo/__init__.py).
+4. Repair — geo_link partition + heal, whole-site kill + rejoin on the
+   same dir, and journal-gap snapshot fallback after segment GC.
+5. Chaos property test — seeded concurrent writers on both sites with a
+   partition and a site restart mid-run; final digests are bit-identical
+   across sites, equal to a single-site oracle fed the union of acked
+   semilattice writes, and histcheck's geo verdict is clean.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from redisson_tpu.client import RedissonTPU
+from redisson_tpu.commands import OP_TABLE
+from redisson_tpu.config import Config
+from redisson_tpu.fault import inject
+from redisson_tpu.fault.inject import FaultInjector, FaultPlan, FaultRule
+from redisson_tpu.geo import (DESTRUCTIVE_KINDS, NEG_STAMP, SEMILATTICE_KINDS,
+                              SHIP_KINDS, connect_sites, converge, stamp_of)
+from tools.histcheck import check_geo
+
+
+def make_site(root, sid):
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_persist(os.path.join(str(root), sid)).fsync = "always"
+    g = cfg.use_geo(sid)
+    g.poll_interval_s = 0.005
+    g.anti_entropy_interval_s = 0.05
+    return RedissonTPU.create(cfg)
+
+
+@pytest.fixture
+def pair(tmp_path):
+    a, b = make_site(tmp_path, "A"), make_site(tmp_path, "B")
+    connect_sites([a, b])
+    sites = [a, b]
+    yield sites
+    inject.uninstall()
+    for c in sites:
+        try:
+            c.shutdown()
+        except Exception:
+            pass
+
+
+def _partition(*targets, times=10_000):
+    """Drop every geo_link tick toward the named peer site ids."""
+    inject.install(FaultInjector(FaultPlan(rules=[
+        FaultRule(seam="geo_link", target=t, nth=1, times=times)
+        for t in targets])))
+
+
+def _digest(client, keys):
+    """Opaque per-key state digest (type tag + raw cells) via the same
+    export the links ship — what histcheck compares across sites."""
+    out = {}
+    for k in keys:
+        ex = client.geo._export(k)
+        if ex is None:
+            out[k] = None
+        else:
+            otype, cells, _meta = ex
+            out[k] = (str(otype), np.asarray(cells, np.uint8).tobytes())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. wiring
+# ---------------------------------------------------------------------------
+
+def test_geo_requires_persist():
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_geo("lonely")
+    with pytest.raises(ValueError, match="persist"):
+        RedissonTPU.create(cfg)
+
+
+def test_geo_config_roundtrip():
+    cfg = Config.from_dict({
+        "geo": {"site_id": "eu-west", "poll_interval_s": 0.5,
+                "batch_records": 128, "anti_entropy_interval_s": 2.0},
+    })
+    assert cfg.geo is not None
+    assert cfg.geo.site_id == "eu-west"
+    assert cfg.geo.poll_interval_s == 0.5
+    assert cfg.geo.batch_records == 128
+    assert cfg.geo.anti_entropy_interval_s == 2.0
+
+
+def test_ship_kind_sets_against_op_table():
+    # Every shipped kind is a real write op; the semilattice set is
+    # exactly the sketch joins, and the geo_* apply kinds exist as
+    # journaled write ops (so crash replay covers remote applies).
+    for kind in SHIP_KINDS:
+        assert OP_TABLE[kind].write, kind
+    assert SEMILATTICE_KINDS == {"hll_add", "bloom_add", "bitset_set"}
+    assert "bitset_clear" in DESTRUCTIVE_KINDS  # SETBIT 0 is NOT a join
+    for kind in ("geo_merge", "geo_replace", "geo_delete", "geo_flush"):
+        assert OP_TABLE[kind].write, kind
+        assert kind not in SHIP_KINDS  # echo-loop cut
+    assert stamp_of([3, "A"]) == (3, "A") > NEG_STAMP
+
+
+def test_site_id_collision_rejected(tmp_path):
+    a = make_site(tmp_path, "A")
+    b = make_site(os.path.join(tmp_path, "other"), "A")
+    try:
+        with pytest.raises(ValueError, match="collides"):
+            a.geo.connect(b.geo)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 2. convergence through the fused path
+# ---------------------------------------------------------------------------
+
+def test_two_sites_converge_bit_identical(pair):
+    a, b = pair
+    a.get_hyper_log_log("h").add_all([f"a{i}" for i in range(800)])
+    b.get_hyper_log_log("h").add_all([f"b{i}" for i in range(800)])
+    a.get_bit_set("bits").set_bits(range(0, 400, 3))
+    b.get_bit_set("bits").set_bits(range(1, 400, 3))
+    fa = a.get_bloom_filter("blm")
+    fa.try_init(10_000, 0.01)
+    fa.add_all([f"x{i}" for i in range(200)])
+    fb = b.get_bloom_filter("blm")
+    fb.try_init(10_000, 0.01)
+    fb.add_all([f"y{i}" for i in range(200)])
+
+    assert converge(pair, 30), "two-site mesh never settled"
+    assert a.get_hyper_log_log("h").count() == b.get_hyper_log_log("h").count()
+    want = len(set(range(0, 400, 3)) | set(range(1, 400, 3)))
+    assert a.get_bit_set("bits").cardinality() == want
+    assert b.get_bit_set("bits").cardinality() == want
+    assert all(fb.contains(f"x{i}") for i in range(200))
+    assert all(fa.contains(f"y{i}") for i in range(200))
+
+    keys = ["h", "bits", "blm"]
+    da, db = _digest(a, keys), _digest(b, keys)
+    assert da == db, "converged sites must be bit-identical"
+
+    # Remote applies landed through the fused delta_merge_stack path,
+    # never the per-op classic fallback.
+    for c in pair:
+        sk = c._routing.sketch
+        assert sk.counters["geo_planes"] > 0
+        assert sk.counters["geo_classic"] == 0
+
+    # The folded/sparse wire encoding beats shipping raw journal payloads.
+    for c in pair:
+        for link in c.geo.links.values():
+            assert 0 < link.stats["link_bytes"] < link.stats["raw_bytes"]
+
+
+def test_info_replication_and_staleness(pair):
+    a, b = pair
+    a.get_bit_set("k").set_bits([1, 2, 3])
+    assert converge(pair, 30)
+    rep = a.info()["replication"]
+    assert rep["role"] == "active"
+    assert rep["site_id"] == "A"
+    assert rep["version_vector"]["A"] == a.geo.journal_last_seq()
+    peer = rep["peers"]["B"]
+    assert peer["acked_seq"] == a.geo.journal_last_seq()
+    assert peer["lag_records"] == 0
+    for field in ("lag_seconds", "link_bytes", "raw_bytes",
+                  "partitions", "repairs"):
+        assert field in peer
+    st = a.geo.staleness()
+    assert set(st) == {"B"} and st["B"] >= 0.0
+    # B's view mirrors it.
+    assert b.info()["replication"]["peers"]["A"]["acked_seq"] == \
+        b.geo.journal_last_seq()
+
+
+def test_wire_info_replication_section(tmp_path):
+    """Stock `redis-cli INFO replication` observes the geo fleet: the wire
+    front-end renders client.info()'s replication section verbatim."""
+    from redisson_tpu.interop.resp_client import SyncRespClient
+
+    cfg = Config()
+    cfg.use_local()
+    cfg.use_persist(os.path.join(str(tmp_path), "A")).fsync = "always"
+    g = cfg.use_geo("A")
+    g.poll_interval_s = 0.005
+    cfg.use_serve()
+    cfg.use_wire()
+    a = make_site(tmp_path, "B")
+    c = RedissonTPU.create(cfg)
+    try:
+        connect_sites([a, c])
+        c.get_bit_set("wk").set_bits([1, 2])
+        assert converge([a, c], 30)
+        cli = SyncRespClient("127.0.0.1", c.wire.port, retry_attempts=1)
+        try:
+            text = cli.execute("INFO", "replication")
+            if isinstance(text, bytes):
+                text = text.decode()
+        finally:
+            cli.close()
+        assert "# replication" in text
+        assert "role:active" in text
+        assert "site_id:A" in text
+        assert "version_vector" in text
+        assert "peers_B_acked_seq" in text or "acked_seq" in text
+    finally:
+        c.shutdown()
+        a.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# 3. destructive LWW contract
+# ---------------------------------------------------------------------------
+
+def test_delete_wins_when_newer(pair):
+    a, b = pair
+    ha = a.get_hyper_log_log("h")
+    ha.add_all(["x1", "x2", "x3"])
+    assert converge(pair, 30)
+    a.get_keys().delete("h")  # delete stamp > every write stamp
+    assert converge(pair, 30)
+    assert a.get_hyper_log_log("h").count() == 0
+    assert b.get_hyper_log_log("h").count() == 0
+    assert _digest(a, ["h"]) == _digest(b, ["h"]) == {"h": None}
+
+
+def test_delete_loses_to_newer_write_resurrects(pair):
+    a, b = pair
+    # Pump B's journal so its stamps outrun A's.
+    pump = b.get_bit_set("pump")
+    for i in range(20):
+        pump.set_bits([i])
+    a.get_hyper_log_log("h").add_all(["x1", "x2"])
+    assert converge(pair, 30)
+
+    _partition("A", "B")
+    b.get_hyper_log_log("h").add_all(["y1", "y2", "y3"])  # high stamp
+    a.get_keys().delete("h")                              # low stamp: loses
+    time.sleep(0.05)
+    inject.uninstall()
+    assert converge(pair, 30)
+
+    # Add-wins: the older delete is suppressed, B re-ships full state and
+    # A resurrects the key with all five elements.
+    ca, cb = a.get_hyper_log_log("h").count(), b.get_hyper_log_log("h").count()
+    assert ca == cb == 5, (ca, cb)
+    assert (a.geo.applier.resurrections + b.geo.applier.resurrections) >= 1
+    assert (a.geo.applier.suppressed + b.geo.applier.suppressed) >= 1
+
+
+def test_rename_replicates_as_delete_plus_replace(pair):
+    a, b = pair
+    src = a.get_bit_set("src")
+    src.set_bits([1, 5, 9])
+    assert converge(pair, 30)
+    src.rename("dst")
+    assert converge(pair, 30)
+    assert b.get_bit_set("dst").cardinality() == 3
+    assert b.get_bit_set("src").cardinality() == 0
+    assert _digest(a, ["src", "dst"]) == _digest(b, ["src", "dst"])
+
+
+def test_bitset_clear_is_lww_replace(pair):
+    a, b = pair
+    ba = a.get_bit_set("c")
+    ba.set_bits(range(10))
+    assert converge(pair, 30)
+    ba.clear_bits([3, 4])
+    assert converge(pair, 30)
+    assert b.get_bit_set("c").cardinality() == 8
+    assert _digest(a, ["c"]) == _digest(b, ["c"])
+
+
+def test_flushall_replicates(pair):
+    a, b = pair
+    a.get_hyper_log_log("h").add_all(["x1", "x2"])
+    b.get_bit_set("bits").set_bits(range(16))
+    assert converge(pair, 30)
+    a.get_keys().flushall()
+    assert converge(pair, 30)
+    assert b.get_hyper_log_log("h").count() == 0
+    assert b.get_bit_set("bits").cardinality() == 0
+    assert a.get_bit_set("bits").cardinality() == 0
+
+
+def test_flushall_loses_to_newer_write_resurrects(pair):
+    """A flush whose stamp is older than a concurrent write at another
+    site wipes the key at the flushing site but not at the peer — the
+    peer must re-ship the survivor (same add-wins rule as DEL) or the
+    mesh diverges."""
+    a, b = pair
+    pump = b.get_bit_set("pump")
+    for i in range(25):
+        pump.set_bits([i])           # push B's stamps ahead of A's
+    a.get_hyper_log_log("old").add_all(["o1", "o2"])
+    assert converge(pair, 30)
+
+    _partition("A", "B")
+    b.get_hyper_log_log("survivor").add_all(["s1", "s2", "s3"])  # high stamp
+    a.get_keys().flushall()                                      # low stamp
+    time.sleep(0.05)
+    inject.uninstall()
+    assert converge(pair, 30)
+
+    # "survivor" beat the flush on the LWW order: resurrected at A.
+    assert a.get_hyper_log_log("survivor").count() == 3
+    assert b.get_hyper_log_log("survivor").count() == 3
+    # "old" predates the flush everywhere: wiped at both sites.
+    assert a.get_hyper_log_log("old").count() == 0
+    assert b.get_hyper_log_log("old").count() == 0
+    keys = ["survivor", "old", "pump"]
+    assert _digest(a, keys) == _digest(b, keys)
+
+
+# ---------------------------------------------------------------------------
+# 4. repair paths
+# ---------------------------------------------------------------------------
+
+def test_partition_heal_converges(pair):
+    a, b = pair
+    _partition("B", times=200)
+    a.get_hyper_log_log("h").add_all([f"p{i}" for i in range(400)])
+    b.get_hyper_log_log("h").add_all([f"q{i}" for i in range(400)])
+    time.sleep(0.1)  # let the partition bite
+    inject.uninstall()
+    assert converge(pair, 30), "no convergence after heal"
+    assert a.get_hyper_log_log("h").count() == b.get_hyper_log_log("h").count()
+    assert a.geo.links["B"].stats["partitions"] > 0
+
+
+def test_site_kill_and_rejoin(pair, tmp_path):
+    a, b = pair
+    ha = a.get_hyper_log_log("h")
+    ha.add_all([f"r{i}" for i in range(300)])
+    assert converge(pair, 30)
+    b.shutdown()
+    ha.add_all([f"s{i}" for i in range(300)])  # writes while B is down
+    b2 = make_site(tmp_path, "B")  # same dir: journal + sidecar recovery
+    pair[1] = b2
+    connect_sites([a, b2])
+    assert converge([a, b2], 30), "no convergence after rejoin"
+    c1 = a.get_hyper_log_log("h").count()
+    c2 = b2.get_hyper_log_log("h").count()
+    assert c1 == c2
+    assert _digest(a, ["h"]) == _digest(b2, ["h"])
+
+
+def test_journal_gap_snapshot_repair(pair):
+    a, b = pair
+    ha = a.get_hyper_log_log("h")
+    ha.add_all(["seed1", "seed2"])
+    assert converge(pair, 30)
+
+    _partition("B")
+    ha.add_all([f"z{i}" for i in range(200)])
+    # GC the journal segments B still needs: the link must fall back to
+    # a full snapshot repair instead of replaying the (gone) suffix.
+    a.snapshot_now()
+    j = a._executor.journal
+    j.rotate()
+    j.remove_segments_below(j.last_seq)
+    inject.uninstall()
+    assert converge(pair, 30), "no convergence after gap repair"
+    assert a.geo.links["B"].stats["gaps"] >= 1, "snapshot path not exercised"
+    assert ha.count() == b.get_hyper_log_log("h").count()
+    assert _digest(a, ["h"]) == _digest(b, ["h"])
+
+
+# ---------------------------------------------------------------------------
+# 5. seeded chaos property test
+# ---------------------------------------------------------------------------
+
+def test_two_site_chaos_convergence(pair, tmp_path):
+    """Concurrent writers on both sites + geo_link partition + whole-site
+    kill/rejoin; afterwards every acked semilattice write is visible at
+    every site, digests are bit-identical and equal to a single-site
+    oracle fed the union of the writes, and histcheck's geo verdict is
+    clean. The DEL key pins the tombstone half of the contract."""
+    a, b = pair
+    rng = np.random.default_rng(0xC0FFEE)
+    keys = ["chaos:h", "chaos:bits"]
+    writes = {"A": [], "B": []}            # acked semilattice writes
+    reads = {"A": [], "B": []}             # (tenant, key, measure, epoch)
+
+    site_seeds = {sid: rng.integers(lo, lo + 1_000_000, size=120)
+                  for sid, lo in (("A", 0), ("B", 1 << 20))}
+
+    def writer(client, sid):
+        hll = client.get_hyper_log_log("chaos:h")
+        bits = client.get_bit_set("chaos:bits")
+        for i, s in enumerate(site_seeds[sid]):
+            vals = [f"{sid}:{s}:{j}" for j in range(5)]
+            hll.add_all(vals)              # sync: acked once it returns
+            writes[sid].append(("hll", vals))
+            idx = [int(s) % 2048 + j for j in range(4)]
+            bits.set_bits(idx)
+            writes[sid].append(("bits", idx))
+            if i % 10 == 0:
+                reads[sid].append(
+                    (sid, "chaos:bits", bits.cardinality(), 0))
+
+    t1 = threading.Thread(target=writer, args=(a, "A"))
+    t2 = threading.Thread(target=writer, args=(b, "B"))
+    t1.start(); t2.start()
+    time.sleep(0.05)
+    _partition("B", times=40)              # transient one-way partition
+    t1.join(); t2.join()
+    inject.uninstall()
+
+    # DEL tombstone contract, concurrently with replication of the rest:
+    # a newer delete of a settled key stays deleted everywhere.
+    a.get_bit_set("chaos:del").set_bits([1, 2, 3])
+    assert converge(pair, 30)
+    a.get_keys().delete("chaos:del")
+
+    # Whole-site kill + rejoin mid-stream.
+    b.shutdown()
+    a.get_hyper_log_log("chaos:h").add_all(["post-kill-1", "post-kill-2"])
+    writes["A"].append(("hll", ["post-kill-1", "post-kill-2"]))
+    b2 = make_site(tmp_path, "B")
+    pair[1] = b2
+    connect_sites([a, b2])
+    assert converge([a, b2], 60), "chaos mesh never settled"
+
+    # Oracle: one fresh site fed the union of every acked write.
+    oracle = make_site(tmp_path, "oracle")
+    try:
+        oh = oracle.get_hyper_log_log("chaos:h")
+        ob = oracle.get_bit_set("chaos:bits")
+        for site in ("A", "B"):
+            for kind, payload in writes[site]:
+                if kind == "hll":
+                    oh.add_all(payload)
+                else:
+                    ob.set_bits(payload)
+        digests = {"A": _digest(a, keys), "B": _digest(b2, keys),
+                   "oracle": _digest(oracle, keys)}
+        # The deleted key must be gone at both real sites.
+        for sid, client in (("A", a), ("B", b2)):
+            digests[sid]["chaos:del"] = _digest(client, ["chaos:del"])[
+                "chaos:del"]
+            assert digests[sid]["chaos:del"] is None, sid
+        digests["oracle"]["chaos:del"] = None
+        verdict = check_geo(digests, acked_keys=keys, site_reads=reads)
+        assert verdict.ok, verdict.summary() + "\n" + "\n".join(verdict.issues)
+        assert verdict.keys_checked == 3
+        assert verdict.reads_checked > 0
+    finally:
+        oracle.shutdown()
+
+    # All remote applies took the fused path.
+    for c in (a, b2):
+        sk = c._routing.sketch
+        assert sk.counters["geo_planes"] > 0
+        assert sk.counters["geo_classic"] == 0

@@ -326,6 +326,30 @@ def test_g006_g009_scoped_to_wire():
     assert "G009" in rules_of(clocked)
 
 
+def test_g006_g009_scoped_to_geo():
+    """geo/ link and applier threads sit between the journal and the
+    dispatcher: an untimed .result() there wedges replication behind one
+    slow apply, and a time.time() lag stamp would let NTP slew corrupt
+    staleness math — both scopes must cover redisson_tpu/geo/."""
+    block_src = """
+        def wait(f):
+            return f.result()
+    """
+    clock_src = """
+        import time
+
+        def stamp():
+            return time.time()
+    """
+    geo = os.path.join(REPO, "redisson_tpu", "geo", "newfile.py")
+    blocked = FileLinter(geo, repo_root=REPO,
+                         source=textwrap.dedent(block_src)).run()
+    clocked = FileLinter(geo, repo_root=REPO,
+                         source=textwrap.dedent(clock_src)).run()
+    assert "G006" in rules_of(blocked)
+    assert "G009" in rules_of(clocked)
+
+
 def test_g006_suppression_with_reason():
     findings = lint_src("""
         def wait(f):
@@ -975,6 +999,46 @@ def test_tier_c_wire_files_in_scope():
         with open(path) as f:
             tree = _ast.parse(f.read())
         assert linter.in_scope(tree), rel
+
+
+def test_tier_c_geo_files_in_scope():
+    """The geo applier/link/manager mutate shared LWW maps and link
+    tables from journal-listener, link, and anti-entropy threads — all
+    three files must stay under Tier C analysis."""
+    import ast as _ast
+    for rel in (os.path.join("redisson_tpu", "geo", "applier.py"),
+                os.path.join("redisson_tpu", "geo", "link.py"),
+                os.path.join("redisson_tpu", "geo", "manager.py")):
+        path = os.path.join(REPO, rel)
+        linter = ConcurrencyLinter(path, repo_root=REPO, explicit=False)
+        with open(path) as f:
+            tree = _ast.parse(f.read())
+        assert linter.in_scope(tree), rel
+
+
+def test_tier_c_geo_applier_discipline_seeded():
+    """The geo applier's GUARDED_BY contract is enforceable: touching the
+    version vector without the lock is a G011 — the same table
+    geo/applier.py registers for the real GeoApplier."""
+    findings = clint_src("""
+        import threading
+
+        GUARDED_BY = {"GeoApplier.vv": "_lock"}
+
+        class GeoApplier:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.vv = {}
+
+            def bad_watermark(self, origin, seq):
+                self.vv[origin] = seq
+
+            def good_watermark(self, origin, seq):
+                with self._lock:
+                    self.vv[origin] = seq
+    """)
+    assert rules_of(findings) == ["G011"]
+    assert "GeoApplier.vv" in findings[0].message
 
 
 def test_g011_locked_suffix_convention():

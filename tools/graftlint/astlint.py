@@ -223,6 +223,7 @@ class FileLinter:
             sub in ("executor.py", "routing.py")
             or sub.startswith("serve/")
             or sub.startswith("wire/")
+            or sub.startswith("geo/")
         )
 
     def _in_fault_scope(self) -> bool:
@@ -248,6 +249,10 @@ class FileLinter:
             or sub.startswith("persist/")
             or sub.startswith("trace/")
             or sub.startswith("wire/")
+            # geo/ link lag and anti-entropy cadence must survive clock
+            # steps: cross-site staleness reported off wallclock would
+            # jump with NTP slew.
+            or sub.startswith("geo/")
         )
 
     def _in_journal_scope(self) -> bool:

@@ -257,6 +257,107 @@ def journal_writes(path: str, kinds: Iterable[str] = ("set",),
     return out
 
 
+# ---------------------------------------------------------------------------
+# Cross-site convergence (geo/)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class GeoVerdict:
+    """Cross-site convergence contract (geo/__init__.py), checked the
+    same engine-agnostic way: per-site final states are opaque values
+    compared with `==`, reads are caller-chosen monotone measures."""
+
+    divergent_keys: int = 0
+    missing_acked: int = 0
+    monotonic_violations: int = 0
+    sites: int = 0
+    keys_checked: int = 0
+    reads_checked: int = 0
+    issues: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.divergent_keys or self.missing_acked
+                    or self.monotonic_violations)
+
+    def summary(self) -> str:
+        status = "OK" if self.ok else "VIOLATIONS"
+        return (f"histcheck-geo {status}: {self.sites} sites, "
+                f"{self.keys_checked} keys, {self.reads_checked} reads | "
+                f"divergent={self.divergent_keys} "
+                f"missing_acked={self.missing_acked} "
+                f"monotonic={self.monotonic_violations}")
+
+
+def check_geo(site_states: Dict[str, Dict[str, Any]],
+              acked_keys: Iterable[str] = (),
+              site_reads: Optional[Dict[str, List[Tuple]]] = None,
+              max_issues: int = 20) -> GeoVerdict:
+    """Verify the geo convergence contract after the mesh settles.
+
+    * **convergence** — every site holds the identical key -> value map
+      (`site_states`; values are opaque digests, compared with `==`).
+      After heal + converge(), any difference is divergence, period —
+      the CRDT/LWW rules promise bit-identical state, not "close".
+    * **acked visibility** — every key in `acked_keys` (semilattice
+      writes acked somewhere and never destructively removed) exists at
+      EVERY site: an acked join can be overridden only by a
+      higher-stamped destructive op, never silently dropped.
+    * **per-site monotonic reads** — `site_reads` maps site ->
+      [(tenant, key, measure, epoch)] in per-tenant recording order,
+      where `measure` is any caller-chosen monotone observable of a
+      semilattice key (HLL cardinality, bit count) and `epoch`
+      increments when the caller acks a destructive op on the key.
+      Within one (site, tenant, key, epoch) the measure must never
+      decrease: local reads may lag remote sites, but a single site's
+      view of a join-only key can only grow.
+    """
+    verdict = GeoVerdict(sites=len(site_states))
+
+    def note(msg: str) -> None:
+        if len(verdict.issues) < max_issues:
+            verdict.issues.append(msg)
+
+    # -- convergence: identical state at every site -------------------------
+    all_keys = set()
+    for state in site_states.values():
+        all_keys.update(state)
+    verdict.keys_checked = len(all_keys)
+    site_items = sorted(site_states.items())
+    for key in sorted(all_keys):
+        vals = [(sid, state.get(key, ABSENT)) for sid, state in site_items]
+        first = vals[0][1]
+        if not all(_values_match(v, first) for _, v in vals[1:]):
+            verdict.divergent_keys += 1
+            held = {sid: ("<absent>" if v is ABSENT else repr(v)[:40])
+                    for sid, v in vals}
+            note(f"divergence: key={key!r} differs across sites: {held}")
+
+    # -- acked writes visible everywhere ------------------------------------
+    for key in acked_keys:
+        for sid, state in site_items:
+            if key not in state:
+                verdict.missing_acked += 1
+                note(f"missing acked key: {key!r} absent at site {sid!r}")
+
+    # -- per-site monotonic reads -------------------------------------------
+    for sid, reads in sorted((site_reads or {}).items()):
+        floors: Dict[Tuple[str, str, Any], Any] = {}
+        for tenant, key, measure, epoch in reads:
+            verdict.reads_checked += 1
+            fk = (tenant, key, epoch)
+            prev = floors.get(fk)
+            if prev is not None and measure < prev:
+                verdict.monotonic_violations += 1
+                note(f"monotonic violation at site {sid!r}: "
+                     f"tenant={tenant!r} key={key!r} epoch={epoch} "
+                     f"measure stepped {prev!r} -> {measure!r}")
+            elif prev is None or measure > prev:
+                floors[fk] = measure
+    return verdict
+
+
 def seq_floor(timeline: List[Tuple[int, Any]], seq: int) -> Any:
     """State of a key at `seq` given its [(write_seq, value)] timeline —
     the value of the last write at or before `seq` (ABSENT before any)."""
