@@ -892,6 +892,95 @@ def bench_pfmerge(jax, dev, sketches=1000):
     return merge_ms
 
 
+def bench_mesh(platform, n, reps, roofline=0.0, sketches=1000, quick=False):
+    """Mesh data plane (PR 19): N logical shards on ONE engine stack.
+
+    Reports the pod-scale numbers the stacks-vs-mesh tradeoff turns on:
+
+      * mesh_inserts_per_sec — client-path HLL ingest through the mesh
+        cluster facade (slot guard + shared dispatcher + sharded bank).
+      * launches_per_window — observed launch count per multi-shard tape
+        window (acceptance: 1.0 — one fused launch retires ALL shards'
+        ops; the stacks plane pays one launch train per shard).
+      * cross_shard_pfmerge_ms — PFMERGE over `sketches` HLLs whose slots
+        span every shard, retired by the shard_map/pmax collective (no
+        host register export).
+      * pct_of_roofline — mesh ingest rate against the tape megakernel's
+        roofline measured by bench_roofline on the active device. On the
+        CPU fallback this is a proxy (CPU scatter bound, not TPU HBM),
+        flagged by the `platform` tag.
+    """
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    cfg.use_cluster(num_shards=4, data_plane="mesh")
+    client = RedissonTPU.create(cfg)
+    try:
+        backend = client.cluster.mesh_client._routing.sketch
+        rng = np.random.default_rng(23)
+
+        # -- ingest rate + launches/window over multi-shard windows --------
+        hs = [client.get_hyper_log_log(f"bench:mesh:h{i}") for i in range(4)]
+
+        def burst():
+            futs = [h.add_ints_async(
+                rng.integers(0, 2**63, n // 4, dtype=np.uint64))
+                for h in hs]
+            for fu in futs:
+                fu.result(timeout=120)
+
+        burst()  # warmup: compile the window shapes
+        s0 = backend.ingest_stats()
+        t0 = time.perf_counter()
+        for _ in range(max(reps - 1, 1)):
+            burst()
+        dt = time.perf_counter() - t0
+        s1 = backend.ingest_stats()
+        rate = max(reps - 1, 1) * n / dt
+        windows = s1.get("tape_runs", 0) - s0.get("tape_runs", 0)
+        launches = (s1.get("window_launches", 0)
+                    - s0.get("window_launches", 0))
+        lpw = round(launches / windows, 2) if windows else 0.0
+
+        # -- cross-shard PFMERGE over `sketches` HLLs ----------------------
+        names = [f"bench:mesh:pf{i}" for i in range(sketches)]
+        futs = []
+        for name in names:
+            futs.append(client.get_hyper_log_log(name).add_ints_async(
+                rng.integers(0, 2**63, 64, dtype=np.uint64)))
+        for fu in futs:
+            fu.result(timeout=300)
+        tgt = client.get_hyper_log_log("bench:mesh:{pfdst}:t")
+        tgt.merge_with(*names)  # compile + warm the collective
+        merge_ms = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            tgt.merge_with(*names)
+            merge_ms = min(merge_ms, (time.perf_counter() - t0) * 1e3)
+
+        pct = 100.0 * rate / roofline if roofline else 0.0
+        proxy = " (CPU proxy roofline)" if platform != "tpu" else ""
+        print(
+            f"# mesh[{platform}]: {rate/1e6:.2f} M inserts/s, "
+            f"{lpw} launches/window over {windows} windows, "
+            f"cross-shard pfmerge({sketches}) {merge_ms:.2f} ms, "
+            f"{pct:.0f}% of roofline{proxy}",
+            file=sys.stderr,
+        )
+        return {
+            "mesh_inserts_per_sec": round(rate, 1),
+            "launches_per_window": lpw,
+            "cross_shard_pfmerge_ms": round(merge_ms, 3),
+            "pct_of_roofline": round(pct, 1),
+            "platform": platform,
+            "collective_merges": backend.counters["collective_merges"],
+            "multi_shard_windows": backend.counters["multi_shard_windows"],
+        }
+    finally:
+        client.shutdown()
+
+
 def bench_replica(quick=False):
     """Read-replica fleet numbers (PR 13): reads/s with 0 vs 2 replicas
     on the compute-read workload (BITCOUNT + cache-busting trickle writer,
@@ -1362,6 +1451,13 @@ def main():
             bench_pfmerge(jax, dev, 32 if quick else 1000), 3)
     except Exception as exc:  # noqa: BLE001
         print(f"# pfmerge bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["mesh"] = bench_mesh(
+            platform, 1 << 12 if quick else 1 << 16, 3 if quick else 12,
+            roofline=result.get("roofline_inserts_per_sec", 0.0),
+            sketches=32 if quick else 1000, quick=quick)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# mesh bench failed: {exc!r}", file=sys.stderr)
     try:
         result.update(bench_wire(quick))
     except Exception as exc:  # noqa: BLE001

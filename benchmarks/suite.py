@@ -1789,6 +1789,213 @@ def cluster_smoke():
     return ok
 
 
+def mesh_smoke():
+    """Mesh data-plane acceptance (PR 19 — the CPU-only CI contract for
+    `data_plane="mesh"`): the same 4-shard cluster facade backed by ONE
+    engine stack over a device mesh instead of N Python stacks. Gates:
+
+      (a) MODE PARITY: a deterministic randomized mixed-kind workload
+          (HLL / bitset / bloom / buckets across all shards, with a LIVE
+          slot migration between halves) produces bit-identical per-op
+          results AND a bit-identical state digest (raw HLL registers via
+          hll_export + bitset/bloom cells via bits_export + bucket
+          values) under data_plane="stacks" and data_plane="mesh";
+      (b) ONE LAUNCH PER MULTI-SHARD WINDOW: a burst of concurrent adds
+          spanning all shards retires through the shard-axis tape —
+          observed window_launches == tape windows (1.0 launches per
+          window) and the multi-shard window counter moves;
+      (c) COLLECTIVE PFMERGE: merging HLLs living on different shards
+          runs as a shard_map/pmax collective — count matches a hashtag
+          co-located single-shard oracle and the link_bytes gauge is FLAT
+          across the merge (no host register export/import round-trip).
+    """
+    import hashlib
+    import random
+    import shutil
+    import tempfile
+
+    from redisson_tpu import native as native_mod
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.ops.crc16 import key_slot
+
+    n_hlls = 6 if _TINY else 12
+    hll_n = 200 if _TINY else 1500
+    n_bits = 4 if _TINY else 8
+    burst_n = 1 << (10 if _TINY else 13)
+    ok = True
+
+    hnames = [f"ms:h{i}" for i in range(n_hlls)]
+    bnames = [f"ms:b{i}" for i in range(n_bits)]
+    knames = [f"ms:k{i}" for i in range(n_bits)]
+    fname = "ms:bloom"
+
+    def workload(c, mgr):
+        """Deterministic mixed-kind workload with a live migration between
+        halves; returns the per-op result list."""
+        rng = random.Random(47)
+        results = []
+        f = c.get_bloom_filter(fname)
+        f.try_init(expected_insertions=50_000, false_probability=0.01)
+
+        def half(tag):
+            for name in hnames:
+                h = c.get_hyper_log_log(name)
+                h.add_all([b"%s:%s:%d" % (tag, name.encode(),
+                                          rng.randrange(1 << 40))
+                           for _ in range(hll_n)])
+                results.append(("pfcount", name, h.count()))
+            for name in bnames:
+                bs = c.get_bit_set(name)
+                bs.set_bits([rng.randrange(1 << 16) for _ in range(64)])
+                results.append(("bitcount", name, int(bs.cardinality())))
+            for name in knames:
+                c.get_bucket(name).set(f"{tag.decode()}:{rng.randrange(1000)}")
+            added = f.add_all([b"%s:f:%d" % (tag, rng.randrange(1 << 30))
+                               for _ in range(200)])
+            results.append(("bfadd", fname, int(np.sum(added))))
+
+        half(b"a")
+        # Live migration between halves: every slot shard 0 owns among the
+        # workload keys moves to shard 2 — both planes replay the same
+        # protocol (begin/flip/adopt + journaled fence), so the second
+        # half lands on the new owner in both.
+        table = mgr.router.slot_table()
+        move = sorted({key_slot(n) for n in hnames + bnames + knames
+                       if table[key_slot(n)] == 0})
+        if move:
+            mgr.migrate_slots(move, 2, timeout_s=120)
+        half(b"b")
+        for name in knames:
+            results.append(("get", name, c.get_bucket(name).get()))
+        return results
+
+    def state_digest(c, mgr):
+        """Bit-identical observable-state fingerprint through the facade:
+        raw HLL registers, bitset/bloom cells, bucket values."""
+        h = hashlib.sha256()
+        router = mgr.router
+        for name in sorted(hnames):
+            exported = router.execute_sync(name, "hll_export", None)
+            regs = exported[0] if exported is not None else b""
+            h.update(name.encode() + np.asarray(regs).tobytes() + b";")
+        for name in sorted(bnames + [fname]):
+            exported = router.execute_sync(name, "bits_export", None)
+            if exported is not None:
+                otype, cells, meta, _version = exported
+                h.update(name.encode() + str(otype).encode()
+                         + np.asarray(cells).tobytes() + b";")
+        for name in sorted(knames):
+            h.update(name.encode()
+                     + repr(c.get_bucket(name).get()).encode() + b";")
+        return h.hexdigest()
+
+    def run(data_plane):
+        tmp = tempfile.mkdtemp(prefix=f"rtpu-mesh-smoke-{data_plane}-")
+        cfg = Config()
+        cfg.use_cluster(num_shards=4, dir=os.path.join(tmp, "cl"),
+                        data_plane=data_plane)
+        c = RedissonTPU.create(cfg)
+        try:
+            results = workload(c, c.cluster)
+            digest = state_digest(c, c.cluster)
+            return c, tmp, results, digest
+        except Exception:
+            _close(c)
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    # -- (a) mode parity: stacks vs mesh ---------------------------------
+    c_s, tmp_s, res_s, dig_s = run("stacks")
+    _close(c_s)
+    shutil.rmtree(tmp_s, ignore_errors=True)
+    c_m, tmp_m, res_m, dig_m = run("mesh")
+    try:
+        same_res = res_s == res_m
+        same_dig = dig_s == dig_m
+        print(f"# mesh-smoke[parity]: {len(res_m)} op results "
+              f"{'identical' if same_res else 'DIVERGED'}, state digest "
+              f"{'identical' if same_dig else 'MISMATCH'} "
+              f"(live migration included)")
+        if not same_res or not same_dig:
+            for a, b in zip(res_s, res_m):
+                if a != b:
+                    print(f"#   first divergence: stacks={a} mesh={b}",
+                          file=sys.stderr)
+                    break
+            print("#   mode parity gate failed", file=sys.stderr)
+            ok = False
+
+        mgr = c_m.cluster
+        backend = mgr.mesh_client._routing.sketch
+
+        # -- (b) one fused launch per multi-shard window -----------------
+        if native_mod.available():
+            hs = [c_m.get_hyper_log_log(f"ms:w{i}") for i in range(4)]
+            rng = np.random.default_rng(31)
+
+            def burst():
+                futs = [h.add_ints_async(rng.integers(
+                    0, 2**63, burst_n, dtype=np.uint64)) for h in hs]
+                for fu in futs:
+                    fu.result(timeout=120)
+
+            burst()  # warmup: compile the window shapes
+            s0 = backend.ingest_stats()
+            m0 = backend.counters["multi_shard_windows"]
+            for _ in range(3):
+                burst()
+            s1 = backend.ingest_stats()
+            windows = s1["tape_runs"] - s0["tape_runs"]
+            launches = s1["window_launches"] - s0["window_launches"]
+            multi = backend.counters["multi_shard_windows"] - m0
+            lpw = launches / max(windows, 1)
+            print(f"# mesh-smoke[window]: {launches} launches / "
+                  f"{windows} windows = {lpw:.2f} per window "
+                  f"({multi} multi-shard)")
+            if windows < 1 or launches != windows or multi < 1:
+                print("#   single-launch window gate failed",
+                      file=sys.stderr)
+                ok = False
+        else:
+            print("# mesh-smoke[window]: native tape encoder unavailable; "
+                  "SKIP (device ingest path)", file=sys.stderr)
+
+        # -- (c) collective PFMERGE: no host register export -------------
+        table = mgr.router.slot_table()
+        names, i = [], 0
+        want_shards = [0, 1, 2]
+        while len(names) < 3:
+            k = f"mpf{i}"
+            if table[key_slot(k)] == want_shards[len(names)]:
+                names.append(k)
+            i += 1
+        vals = [[b"%d:%d" % (j, v) for v in range(hll_n)] for j in range(3)]
+        vals[2] = vals[0][: hll_n // 2]  # overlap exercises the max-fold
+        for nm, vs in zip(names, vals):
+            c_m.get_hyper_log_log(nm).add_all(vs)
+        link0 = backend.counters["link_bytes"]
+        coll0 = backend.counters["collective_merges"]
+        merged = c_m.get_hyper_log_log(names[0]).merge_with_and_count(
+            *names[1:])
+        link_moved = backend.counters["link_bytes"] - link0
+        collectives = backend.counters["collective_merges"] - coll0
+        oracle = c_m.get_hyper_log_log("{mpforacle}")
+        for vs in vals:
+            oracle.add_all(vs)
+        oracle_count = oracle.count()
+        print(f"# mesh-smoke[pfmerge]: cross-shard {merged} vs oracle "
+              f"{oracle_count}; {collectives} collective merge(s), "
+              f"link_bytes moved {link_moved}")
+        if merged != oracle_count or collectives < 1 or link_moved != 0:
+            print("#   collective PFMERGE gate failed", file=sys.stderr)
+            ok = False
+    finally:
+        _close(c_m)
+        shutil.rmtree(tmp_m, ignore_errors=True)
+    return ok
+
+
 def replica_smoke():
     """Read-replica fleet acceptance (the CPU-only CI contract for
     redisson_tpu/replica/). Gates:
@@ -2975,6 +3182,15 @@ def main():
                          "landing on the new owner, and cross-shard "
                          "PFMERGE matching a single-shard oracle, then "
                          "exit")
+    ap.add_argument("--mesh-smoke", action="store_true",
+                    help="mesh data-plane acceptance: per-op results + "
+                         "state digest bit-identical between "
+                         "data_plane=stacks and data_plane=mesh (live "
+                         "migration included), exactly one fused launch "
+                         "per multi-shard tape window, and cross-shard "
+                         "PFMERGE via the shard_map collective with a "
+                         "flat link_bytes gauge (no host register "
+                         "export), then exit")
     ap.add_argument("--replica-smoke", action="store_true",
                     help="read-replica fleet acceptance: randomized mixed "
                          "traffic with every replica-served read inside "
@@ -3057,6 +3273,9 @@ def main():
 
     if args.cluster_smoke:
         sys.exit(0 if cluster_smoke() else 1)
+
+    if args.mesh_smoke:
+        sys.exit(0 if mesh_smoke() else 1)
 
     if args.replica_smoke:
         sys.exit(0 if replica_smoke() else 1)

@@ -338,10 +338,15 @@ class RowAllocator:
         self.capacity = capacity
         self._grow = grow
 
-    def row_of(self, name: str) -> int:
+    def row_of(self, name: str, prefer=None) -> int:
         row = self.rows.get(name)
         if row is not None:
             return row
+        if prefer is not None:
+            row = self.claim_range(*prefer)
+            if row is not None:
+                self.rows[name] = row
+                return row
         if self.free:
             row = self.free.pop()
         else:
@@ -351,6 +356,25 @@ class RowAllocator:
             self.next += 1
         self.rows[name] = row
         return row
+
+    def claim_range(self, lo: int, hi: int) -> Optional[int]:
+        """Allocate a free row inside [lo, hi), or None when the range is
+        full (mesh plane row placement: a shard's preferred device-local
+        block; callers fall back to anywhere-allocation on None)."""
+        for i, r in enumerate(self.free):
+            if lo <= r < hi:
+                return self.free.pop(i)
+        if lo <= self.next < hi:
+            row = self.next
+            self.next += 1
+            return row
+        if self.next < lo <= hi - 1 and hi <= self.capacity:
+            # Skip the watermark forward into the block; the skipped rows
+            # stay allocatable through the free list.
+            self.free.extend(range(self.next, lo))
+            self.next = lo + 1
+            return lo
+        return None
 
     def release(self, name: str) -> Optional[int]:
         """Free the name's row for reuse; returns it (None if absent)."""
@@ -585,12 +609,20 @@ class TpuBackend:
             "launch_us": 0.0,     # host wall time spent issuing them
             "geo_planes": 0,      # remote site planes through the fused path
             "geo_classic": 0,     # remote planes absorbed via the fallback
+            "collective_merges": 0,    # PFMERGE/count runs via mesh collectives
+            "multi_shard_windows": 0,  # tape windows spanning > 1 shard
         }
         # Executor window handoff: last window sequence seen by run().
         self.last_window = None
         self._scratch_lock = threading.Lock()
         # memstat ledger (MemLedger-shaped); bank lifecycle hooks feed it.
         self.accounting = None
+        # Mesh data plane (cluster data_plane="mesh"): attach_mesh installs
+        # the ShardedBank geometry BEFORE the lazy bank exists; None in
+        # every single-engine mode and the stacks plane.
+        self.mesh = None
+        self._sharded_bank = None
+        self._shard_of = None
 
     # row-map views (tests and the durability duck type read these)
     @property
@@ -609,19 +641,117 @@ class TpuBackend:
     def bank_capacity(self, v: int) -> None:
         self._alloc.capacity = v
 
+    def attach_mesh(self, mesh, num_shards: int, shard_of=None) -> None:
+        """Switch the (still-lazy) HLL bank onto a device mesh: rows
+        sharded across `mesh` via NamedSharding(mesh, P("slots")), with
+        per-logical-shard preferred row blocks so a shard's sketches stay
+        device-local. `shard_of` maps a target name to its logical shard
+        (tape shard column + per-shard memstat attribution). Must be
+        called before the first bank-touching op (the client/manager wire
+        it right after construction, before the executor starts)."""
+        from redisson_tpu.parallel.mesh import ShardedBank
+
+        if self.bank is not None:
+            raise RuntimeError("attach_mesh: bank already materialized")
+        sb = ShardedBank(mesh, self._alloc.capacity, num_shards)
+        self.mesh = mesh
+        self._sharded_bank = sb
+        self._shard_of = shard_of
+        self._alloc.capacity = sb.capacity
+
+    def _put(self, arr):
+        """Commit a bank-kernel operand: replicated across the mesh in
+        mesh mode (a jit may not mix mesh-sharded and single-device
+        committed inputs), on the store device otherwise."""
+        import jax
+
+        if self._sharded_bank is not None:
+            return self._sharded_bank.replicate(arr)
+        return jax.device_put(arr, self.store.device)
+
+    def mesh_relocate(self, names, target_shard: int) -> int:
+        """Device-side bank-row relocation for mesh-mode slot migration:
+        move each name's registers into the adopting shard's preferred
+        row block (copy row -> zero old -> remap allocator). MUST run on
+        the dispatcher thread (executor.execute_barrier) — the caller's
+        journaled flip fence orders it against in-flight windows exactly
+        like the stacks plane's migration. A full target block leaves
+        rows in place (placement is a perf hint; collectives mask by row
+        index, so results are unchanged). Returns rows moved."""
+        sb = self._sharded_bank
+        if sb is None or self.bank is None:
+            return 0
+        lo, hi = sb.block(int(target_shard), self._alloc.capacity)
+        moved = 0
+        for name in names:
+            row = self._alloc.rows.get(name)
+            if row is None or lo <= row < hi:
+                continue
+            new = self._alloc.claim_range(lo, hi)
+            if new is None:
+                break
+            regs = engine.hll_bank_row(self.bank, np.int32(row))
+            self.bank = engine.hll_bank_set_row(
+                self.bank, regs, np.int32(new))
+            self.bank = engine.hll_bank_zero_row(self.bank, np.int32(row))
+            self._alloc.rows[name] = new
+            self._alloc.free.append(row)
+            self._bump(name)
+            moved += 1
+        if moved:
+            self._account_bank()
+        return moved
+
+    def mesh_occupancy(self) -> int:
+        """Mesh-wide non-empty bank row count via one psum collective
+        (the DBSIZE analogue for the sharded bank); 0 off-mesh/empty."""
+        if self.mesh is None or self.bank is None:
+            return 0
+        # graftlint: allow-sync(management DBSIZE-style stat; blocking read is the contract)
+        return int(engine.hll_bank_occupancy_collective(
+            self.bank, mesh=self.mesh))
+
     def _grow_bank(self, new_cap: int) -> int:
         """RowAllocator grow hook: double the device bank in place."""
-        self.bank = engine.hll_bank_grow(self._ensure_bank(), new_cap)
+        sb = self._sharded_bank
+        if sb is not None:
+            new_cap = sb.round_capacity(new_cap)
+            sb.capacity = new_cap
+            self.bank = sb.place(
+                engine.hll_bank_grow(self._ensure_bank(), new_cap))
+        else:
+            self.bank = engine.hll_bank_grow(self._ensure_bank(), new_cap)
         self._account_bank()
         return new_cap
 
     def _account_bank(self) -> None:
         """Report the shared HLL bank's device bytes to the memstat
-        ledger (create/grow/flushall are the only size changes)."""
+        ledger (create/grow/flushall are the only size changes). In mesh
+        mode the bank is reported as per-(shard, kind) entries — each
+        allocated row's bytes attribute to the logical shard owning its
+        target name — so memory_stats() rollups stay exact per shard."""
         acct = self.accounting
-        if acct is not None:
-            acct.set_bank_bytes(
-                int(self.bank.nbytes) if self.bank is not None else 0)
+        if acct is None:
+            return
+        nbytes = int(self.bank.nbytes) if self.bank is not None else 0
+        sb = self._sharded_bank
+        if sb is None:
+            acct.set_bank_bytes(nbytes)
+            return
+        set_shard = getattr(acct, "set_bank_shard_bytes", None)
+        if set_shard is None:  # ledger predating mesh accounting
+            acct.set_bank_bytes(nbytes)
+            return
+        cap = max(self._alloc.capacity, 1)
+        row_bytes = nbytes // cap if nbytes else 0
+        shard_of = self._shard_of
+        by_shard: dict = {}
+        assigned = 0
+        for name, _row in self._alloc.rows.items():
+            shard = int(shard_of(name)) if shard_of is not None else 0
+            by_shard[shard] = by_shard.get(shard, 0) + row_bytes
+            assigned += row_bytes
+        set_shard(by_shard, unassigned=nbytes - assigned)
 
     def _plan_ingest(self, nkeys: int, allow_delta: bool = False) -> str:
         """Resolve one run's HLL insert path: 'delta', 'hostfold' or a
@@ -1347,23 +1477,35 @@ class TpuBackend:
         fault_inject.fire("kernel_launch", kind="tape",
                           target=planes[0].target if planes else "")
         t0 = time.perf_counter()
-        dev = self.store.device
         spec_by = {id(p): s for p, s in zip(planes, specs)}
-        tp = tape_mod.encode_window(planes, self._hll_row)
+        tp = tape_mod.encode_window(planes, self._hll_row, self._shard_of)
         self.counters["link_bytes"] += tp.link_bytes
+        if tp.n_shards > 1:
+            # Mesh data plane: this single launch retires a window whose
+            # entries span multiple logical shards (the tape's shard axis).
+            self.counters["multi_shard_windows"] += 1
         n_hll = tp.n_hll
-        wire = jax.device_put(tp.wire, dev)
-        table = jax.device_put(tp.table, dev)
+        # A window with no HLL entries never reads the bank — its dummy
+        # bank stays on the store device, so the operands must too (a jit
+        # may not mix mesh-replicated and single-device committed inputs).
+        mesh_mode = self._sharded_bank is not None and bool(n_hll)
+        put = (self._put if mesh_mode
+               else (lambda a: jax.device_put(a, self.store.device)))
+        wire = put(tp.wire)
+        table = put(tp.table)
         if n_hll:
-            rows_pad = jax.device_put(
-                engine.pad_rows_repeat(tp.hll_rows), dev)
+            rows_pad = put(engine.pad_rows_repeat(tp.hll_rows))
             bank = self._ensure_bank()
         else:
-            rows_pad = jax.device_put(np.zeros((1,), np.int32), dev)
+            rows_pad = put(np.zeros((1,), np.int32))
             bank = jnp.zeros((1, 1), jnp.int32)  # dummy, never read
         store_planes = tp.planes[n_hll:]
         store_old = tuple(
-            self.store.get(p.target).state for p in store_planes)
+            # Mixed mesh window: store-backed old rows must share the
+            # bank's mesh placement inside the fused jit (replicated).
+            put(self.store.get(p.target).state) if mesh_mode
+            else self.store.get(p.target).state
+            for p in store_planes)
         want_old = any(p.kind == "bitset_set" for p in store_planes)
         new_bank, merged, changed, old_packed = engine.tape_apply(
             bank, wire, table, rows_pad, store_old,
@@ -1378,7 +1520,12 @@ class TpuBackend:
                 self._bump(p.target)
         for j, p in enumerate(store_planes):
             row = n_hll + j
-            self.store.swap(p.target, merged[row, : p.cells])
+            new_state = merged[row, : p.cells]
+            if mesh_mode:
+                # Store objects live on the single store device; re-commit
+                # the mesh-placed merged row before the swap.
+                new_state = jax.device_put(new_state, self.store.device)
+            self.store.swap(p.target, new_state)
             self._touch(p.target)
             if p.kind == "bloom_add":
                 # device == mirror + this batch == scratch, by construction
@@ -1473,9 +1620,13 @@ class TpuBackend:
         if self.bank is None:
             import jax
 
-            self.bank = jax.device_put(
-                engine.hll_bank_make(self.bank_capacity), self.store.device
-            )
+            sb = self._sharded_bank
+            if sb is not None:
+                self.bank = sb.place(engine.hll_bank_make(sb.capacity))
+            else:
+                self.bank = jax.device_put(
+                    engine.hll_bank_make(self.bank_capacity),
+                    self.store.device)
             self._account_bank()
         return self.bank
 
@@ -1493,7 +1644,15 @@ class TpuBackend:
         if not create:
             return None
         self._ensure_bank()
-        return self._alloc.row_of(name)
+        prefer = None
+        sb = self._sharded_bank
+        if sb is not None and self._shard_of is not None:
+            # Mesh plane: try the owning shard's preferred row block first
+            # so the row lands on that shard's mesh device (a full block
+            # spills anywhere — placement is a hint, not a domain).
+            prefer = sb.block(int(self._shard_of(name)),
+                              self._alloc.capacity)
+        return self._alloc.row_of(name, prefer=prefer)
 
     def _check_not_hll(self, name: str, otype: str) -> None:
         if name in self._rows:
@@ -1634,8 +1793,7 @@ class TpuBackend:
         for i, n in enumerate(names):
             stack[i] = folds[n]
         self.bank, changed = engine.hll_bank_absorb_rows(
-            self.bank, jax.device_put(stack, self.store.device),
-            jax.device_put(rows, self.store.device),
+            self.bank, self._put(stack), self._put(rows),
         )
         for n in names:
             self._bump(n)
@@ -1708,8 +1866,7 @@ class TpuBackend:
                 def stage(item):
                     row, chunk = item
                     prows, count = engine.pad_rows(chunk)
-                    return (row, jax.device_put(prows, self.store.device),
-                            np.int32(count))
+                    return (row, self._put(prows), np.int32(count))
 
                 def dispatch(_i, staged):
                     row, prows, count = staged
@@ -1862,8 +2019,7 @@ class TpuBackend:
             regs = np.asarray(op.payload["regs"]).astype(np.int32)
             row = self._hll_row(target)
             self.bank = engine.hll_bank_set_row(
-                self.bank, jax.device_put(regs, self.store.device),
-                np.int32(row)
+                self.bank, self._put(regs), np.int32(row)
             )
             self._bump(target)
             op.future.set_result(True)
@@ -1878,14 +2034,23 @@ class TpuBackend:
 
     def _op_hll_count_with(self, target: str, ops: List[Op]) -> None:
         # Union count across sketches: one gather + row-max + estimator
-        # kernel over the padded row vector — never mutates.
+        # kernel over the padded row vector — never mutates. Mesh plane:
+        # the fold runs as a shard_map collective (per-device row max +
+        # one pmax hop) — no register image crosses the host link even
+        # when the rows span every logical shard.
         for op in ops:
             rows = self._count_rows(target, op.payload["names"])
             if rows is None:
                 op.future.set_result(0)
                 continue
-            est = _start_d2h(engine.hll_bank_count_rows(
-                self.bank, engine.pad_rows_repeat(rows)))
+            if self.mesh is not None:
+                self.counters["collective_merges"] += 1
+                est = _start_d2h(engine.hll_bank_count_rows_collective(
+                    self.bank, engine.pad_rows_repeat(rows),
+                    mesh=self.mesh))
+            else:
+                est = _start_d2h(engine.hll_bank_count_rows(
+                    self.bank, engine.pad_rows_repeat(rows)))
             self.completer.submit(
                 # graftlint: allow-sync(completer thread: materializing the staged estimate is this thread's job)
                 _complete_all([op], lambda est=est: int(round(float(est))))
@@ -1908,7 +2073,14 @@ class TpuBackend:
         # existing target registers participate in the max).
         for op in ops:
             trow, rows = self._merge_rows(target, op.payload["names"])
-            self.bank = engine.hll_bank_merge_rows(self.bank, rows, trow)
+            if self.mesh is not None:
+                # Collective PFMERGE: device-side fold + pmax; the target
+                # row's owner scatters the merged registers locally.
+                self.counters["collective_merges"] += 1
+                self.bank = engine.hll_bank_merge_rows_collective(
+                    self.bank, rows, trow, mesh=self.mesh)
+            else:
+                self.bank = engine.hll_bank_merge_rows(self.bank, rows, trow)
             self._bump(target)
             op.future.set_result(None)
 
@@ -1919,8 +2091,13 @@ class TpuBackend:
         # RedissonHyperLogLog.java:78-97).
         for op in ops:
             trow, rows = self._merge_rows(target, op.payload["names"])
-            self.bank, est = engine.hll_bank_merge_count_rows(
-                self.bank, rows, trow)
+            if self.mesh is not None:
+                self.counters["collective_merges"] += 1
+                self.bank, est = engine.hll_bank_merge_count_rows_collective(
+                    self.bank, rows, trow, mesh=self.mesh)
+            else:
+                self.bank, est = engine.hll_bank_merge_count_rows(
+                    self.bank, rows, trow)
             self._bump(target)
             est = _start_d2h(est)
             self.completer.submit(
@@ -2733,8 +2910,7 @@ class TpuBackend:
                 engine.hll_bank_row(self._ensure_bank(), np.int32(row)))
             regs = np.maximum(cur.astype(np.uint8), plane).astype(np.int32)
             self.bank = engine.hll_bank_set_row(
-                self.bank, jax.device_put(regs, self.store.device),
-                np.int32(row))
+                self.bank, self._put(regs), np.int32(row))
             self._bump(target)
             return
         if inner == "bloom_add":
@@ -2784,8 +2960,7 @@ class TpuBackend:
                     row = self._hll_row(target)
                     self.bank = engine.hll_bank_set_row(
                         self._ensure_bank(),
-                        jax.device_put(plane.astype(np.int32),
-                                       self.store.device),
+                        self._put(plane.astype(np.int32)),
                         np.int32(row))
                     self._bump(target)
                 else:

@@ -58,7 +58,7 @@ class RedissonTPU:
         self.cluster = None
 
         ccfg = self.config.cluster
-        if ccfg is not None and ccfg.shard_id < 0:
+        if ccfg is not None and ccfg.shard_id == -1:
             # Slot-sharded namespace: this client is the FACADE — it builds
             # N shard clients (each one re-enters __init__ with shard_id
             # >= 0) and dispatches through the ClusterRouter instead of its
@@ -115,6 +115,22 @@ class RedissonTPU:
             from redisson_tpu.cluster.shard import SlotOwnershipBackend
 
             self._routing = SlotOwnershipBackend(self._routing, ccfg.shard_id)
+        elif ccfg is not None and ccfg.shard_id == -2:
+            # Mesh data plane: this client is the ONE shared engine stack
+            # behind every logical shard. Same waist, but the guard holds
+            # the whole slot->shard table (MeshOwnershipBackend), and the
+            # HLL bank goes onto a device mesh BEFORE any bank-touching
+            # op — including persist recovery below — so every row the
+            # engine ever materializes is mesh-sharded.
+            from redisson_tpu.cluster.shard import MeshOwnershipBackend
+
+            guard = MeshOwnershipBackend(self._routing, ccfg.num_shards)
+            self._routing = guard
+            if hasattr(sketch, "attach_mesh"):
+                from redisson_tpu.parallel.mesh import SLOT_AXIS, get_mesh
+
+                sketch.attach_mesh(get_mesh(axis=SLOT_AXIS),
+                                   ccfg.num_shards, guard.shard_of_key)
         self._backend = self._routing
         self._widths = tuple(tcfg.key_width_buckets)
         from redisson_tpu.observability import MetricsRegistry

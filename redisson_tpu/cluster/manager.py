@@ -31,7 +31,7 @@ from typing import Any, Dict, Iterable, List, Tuple
 
 from redisson_tpu.cluster.migrator import SlotMigrator
 from redisson_tpu.cluster.router import ClusterRouter
-from redisson_tpu.cluster.shard import ClusterShard
+from redisson_tpu.cluster.shard import ClusterShard, MeshShard
 from redisson_tpu.cluster.split import MAX_SLOT, contiguous_assignment
 from redisson_tpu.ops.crc16 import key_slot
 from redisson_tpu.parallel.topology import TopologyManager
@@ -55,17 +55,29 @@ class ClusterManager:
         self.migrations = 0
         self.migration_stats: Dict[str, int] = {}
         self._next_shard_id = 0
+        self.data_plane = getattr(cluster, "data_plane", "stacks") or "stacks"
+        # Mesh data plane: the ONE shared engine stack behind every
+        # logical shard (None on the stacks plane).
+        self.mesh_client = None
 
-        self.shards: Dict[int, ClusterShard] = {}
-        for _ in range(max(1, int(cluster.num_shards))):
-            shard_id = self._next_shard_id
-            self._next_shard_id += 1
-            self.shards[shard_id] = ClusterShard(
-                shard_id, RedissonTPU.create(self._shard_config(shard_id)))
+        self.shards: Dict[int, Any] = {}
+        if self.data_plane == "mesh":
+            self.mesh_client = RedissonTPU.create(self._mesh_config())
+            for _ in range(max(1, int(cluster.num_shards))):
+                shard_id = self._next_shard_id
+                self._next_shard_id += 1
+                self.shards[shard_id] = MeshShard(shard_id, self.mesh_client)
+        else:
+            for _ in range(max(1, int(cluster.num_shards))):
+                shard_id = self._next_shard_id
+                self._next_shard_id += 1
+                self.shards[shard_id] = ClusterShard(
+                    shard_id, RedissonTPU.create(self._shard_config(shard_id)))
 
         table = self._recovered_table()
         self.router = ClusterRouter(self.shards, table,
-                                    retry_depth=cluster.redirect_retries)
+                                    retry_depth=cluster.redirect_retries,
+                                    mesh=self.data_plane == "mesh")
         self._adopt_table(table)
 
         # Failure plane: one pinger per shard (replaceable for drills /
@@ -134,6 +146,43 @@ class ClusterManager:
         # shard_id >= 0 tells the client to install the ownership guard.
         shard_cfg.cluster = dataclasses.replace(cluster, shard_id=shard_id)
         return shard_cfg
+
+    def _mesh_config(self):
+        """Config for the mesh plane's ONE shared engine stack. shard_id
+        == -2 makes the client install the MeshOwnershipBackend guard and
+        attach the sharded bank (never the cluster facade). The ingest
+        path is pinned, not 'auto': the tape megakernel is what retires a
+        multi-shard window in one launch, and the planner's 'delta' path
+        must never be picked here (its fused multi-target stacks assume a
+        single-device bank)."""
+        from redisson_tpu import native as native_mod
+        from redisson_tpu.config import Config, PersistConfig, TpuConfig
+
+        parent = self.config
+        cluster = parent.cluster
+        cfg = Config(
+            codec=parent.codec,
+            threads=parent.threads,
+            inflight_runs=parent.inflight_runs,
+        )
+        tcfg = parent.tpu or TpuConfig()
+        cfg.tpu = dataclasses.replace(
+            tcfg, ingest="tape" if native_mod.available() else "device")
+        if cluster.dir:
+            cfg.persist = PersistConfig(
+                dir=os.path.join(cluster.dir, "mesh"),
+                fsync=cluster.fsync,
+                snapshot_interval_s=0.0)
+        if cluster.replicas_per_shard > 0:
+            raise ValueError(
+                "data_plane='mesh' does not support replicas_per_shard "
+                "yet — the shared stack has one journal, not N")
+        if parent.trace is not None:
+            cfg.trace = dataclasses.replace(parent.trace)
+        if parent.memory is not None:
+            cfg.memory = dataclasses.replace(parent.memory)
+        cfg.cluster = dataclasses.replace(cluster, shard_id=-2)
+        return cfg
 
     def _recovered_table(self) -> List[int]:
         """The live slot table. Fresh start: contiguous near-even ranges.
@@ -233,10 +282,16 @@ class ClusterManager:
         total: Dict[str, int] = {}
         with self._lock:  # one migration at a time (BGSAVE-style)
             for source_id, group in sorted(by_source.items()):
-                migrator = SlotMigrator(
-                    self.router, self.shards[source_id],
-                    self.shards[target_shard], group, timeout_s=timeout_s)
-                stats = migrator.run()
+                if self.data_plane == "mesh":
+                    # graftlint: allow-hold(migrations intentionally serialize under _lock; the relocate barrier resolves on the dispatcher thread, which never takes it)
+                    stats = self._mesh_migrate_group(
+                        source_id, target_shard, group)
+                else:
+                    migrator = SlotMigrator(
+                        self.router, self.shards[source_id],
+                        self.shards[target_shard], group,
+                        timeout_s=timeout_s)
+                    stats = migrator.run()
                 self.migrations += 1
                 for k, v in stats.items():
                     total[k] = total.get(k, 0) + v
@@ -245,6 +300,45 @@ class ClusterManager:
             # an operator-driven reshard's.
             self.migration_stats = total
         return total
+
+    def _mesh_migrate_group(self, source_id: int, target_shard: int,
+                            group: List[int]) -> Dict[str, int]:
+        """Mesh-plane slot migration: no snapshot, no journal tailing —
+        the state is already shared. What moves is (a) OWNERSHIP, via the
+        same journaled begin/flip/adopt records the stacks plane writes
+        (the flip in the shared journal IS the cutover fence: recovery
+        replay rebuilds the table through the identical transition
+        order), and (b) BANK ROW PLACEMENT, a device-side relocation into
+        the adopting shard's preferred row block, run as an executor
+        barrier so it lands at a dispatcher consistency cut — after every
+        window retired under the old owner, before any under the new."""
+        source = self.shards[source_id]
+        target = self.shards[target_shard]
+        slots = set(group)
+        # IMPORTING mark first: ops redirected early (between flip and the
+        # router's table update) find the target accepting.
+        target.begin_migrate(group, target_shard)
+        self.router.begin_cutover(group)
+        try:
+            source.flip(group)          # the journaled cutover fence
+            target.adopt(group)
+        finally:
+            self.router.commit_cutover(group, target_shard)
+        client = self.mesh_client
+        backend = client._routing.sketch
+        executor = client._executor
+
+        def _relocate() -> int:
+            alloc = getattr(backend, "_alloc", None)
+            if alloc is None or not hasattr(backend, "mesh_relocate"):
+                return 0
+            names = [n for n in list(alloc.rows)
+                     if key_slot(n) in slots]
+            return backend.mesh_relocate(names, target_shard)
+
+        moved_rows = int(executor.execute_barrier(_relocate).result())
+        return {"slots": len(group), "keys_moved": moved_rows,
+                "bank_rows_relocated": moved_rows}
 
     def drain_shard(self, shard_id: int) -> int:
         """Move every slot off `shard_id` onto the other non-quarantined
@@ -296,8 +390,20 @@ class ClusterManager:
 
         shard_id = self._next_shard_id
         self._next_shard_id += 1
-        shard = ClusterShard(
-            shard_id, RedissonTPU.create(self._shard_config(shard_id)))
+        if self.data_plane == "mesh":
+            shard = MeshShard(shard_id, self.mesh_client)
+            # Widen the logical-shard axis of the shared bank's preferred
+            # row blocks; device placement is untouched (rows relocate
+            # lazily as slots migrate in).
+            backend = self.mesh_client._routing.sketch
+            sb = getattr(backend, "_sharded_bank", None)
+            if sb is not None:
+                sb.num_shards = max(sb.num_shards, shard_id + 1)
+            guard = self.mesh_client._routing
+            guard.num_shards = max(guard.num_shards, shard_id + 1)
+        else:
+            shard = ClusterShard(
+                shard_id, RedissonTPU.create(self._shard_config(shard_id)))
         shard.adopt([])  # closed ownership: reject until slots migrate in
         self.shards[shard_id] = shard
         self.router.add_shard(shard)
@@ -348,7 +454,7 @@ class ClusterManager:
         quarantined = sum(1 for s in self.shards.values() if s.quarantined)
         replicas = sum(len(s.replicas.replicas) for s in self.shards.values()
                        if s.replicas is not None)
-        return {
+        info = {
             "cluster_enabled": 1,
             "cluster_state": "ok" if quarantined == 0 else "degraded",
             "cluster_slots_assigned": assigned,
@@ -362,7 +468,15 @@ class ClusterManager:
             "redirects": self.router.redirects,
             "retries_exhausted": self.router.retries_exhausted,
             "cross_shard_merges": self.router.cross_shard_merges,
+            "data_plane": self.data_plane,
         }
+        if self.mesh_client is not None:
+            counters = getattr(self.mesh_client._routing.sketch,
+                               "counters", {})
+            info["collective_merges"] = counters.get("collective_merges", 0)
+            info["multi_shard_windows"] = counters.get(
+                "multi_shard_windows", 0)
+        return info
 
     def stats(self) -> Dict[str, Any]:
         return {
@@ -378,5 +492,8 @@ class ClusterManager:
         self.topology.shutdown()
         self.router.close()
         for shard in self.shards.values():
-            shard.shutdown()
+            shard.shutdown()      # mesh: per-shard no-op (shared client)
+        if self.mesh_client is not None:
+            self.mesh_client.shutdown()
+            self.mesh_client = None
         self.shards.clear()

@@ -91,10 +91,16 @@ class ClusterRouter:
     ASK_WAIT_S = 60.0
 
     def __init__(self, shards: Dict[int, Any], table: Sequence[int],
-                 retry_depth: int = RETRY_DEPTH):
+                 retry_depth: int = RETRY_DEPTH, mesh: bool = False):
         if len(table) != MAX_SLOT:
             raise ValueError(f"slot table must cover {MAX_SLOT} slots")
         self._shards = dict(shards)
+        # Mesh data plane: every shard fronts ONE shared engine stack, so
+        # cross-shard PFMERGE submits as a single op (the backend folds it
+        # with a shard_map collective — no host register export) and
+        # keyspace-wide ops dispatch once instead of fanning out N times
+        # over the same store.
+        self._mesh = bool(mesh)
         for sid in set(table):
             if sid not in self._shards:
                 raise ValueError(f"slot table references unknown shard {sid}")
@@ -255,7 +261,15 @@ class ClusterRouter:
         return BatchCollector(self, **submit_kwargs)
 
     def queue_depth(self) -> int:
-        return sum(s.executor.queue_depth() for s in self._shards.values())
+        # Mesh plane: every shard resolves to the SAME executor — dedupe
+        # so the depth is not over-counted N times.
+        seen, total = set(), 0
+        for s in self._shards.values():
+            ex = s.executor
+            if id(ex) not in seen:
+                seen.add(id(ex))
+                total += ex.queue_depth()
+        return total
 
     # -- keyed submission + redirect retry -----------------------------------
 
@@ -317,6 +331,21 @@ class ClusterRouter:
 
     def _unkeyed_async(self, kind, payload, nkeys, tenant, deadline) -> Future:
         shards = list(self._shards.values())
+        if self._mesh and shards:
+            # One shared store holds the whole keyspace: dispatch ONCE
+            # (fanning out would run the same op N times on the same
+            # engine — duplicated work, and flushall x N journal records).
+            shard = min(shards, key=lambda s: s.shard_id)
+            if kind in ("keys", "flushall", "script_flush", "script_load",
+                        "script_exists", "mget", "mset", "msetnx"):
+                if kind == "keys":
+                    reduce_fn = lambda rs: sorted(set(rs[0] or []))
+                elif kind in ("flushall", "script_flush", "mset"):
+                    reduce_fn = lambda rs: None
+                else:
+                    reduce_fn = lambda rs: rs[0]
+                return self._fanout([(shard, "", kind, payload, nkeys)],
+                                    reduce_fn, tenant, deadline)
         if kind == "keys":
             return self._fanout(
                 [(s, "", kind, payload, 0) for s in shards],
@@ -425,7 +454,17 @@ class ClusterRouter:
                          tenant, deadline) -> Future:
         names = list(payload.get("names") or [])
         home = self._resolve(target)
-        if all(self._resolve(n) is home for n in names):
+        if self._mesh or all(self._resolve(n) is home for n in names):
+            # Mesh plane: names spanning shards are still ONE op — the
+            # shared backend's shard_map collective max-folds the bank
+            # rows device-side (engine.hll_bank_*_collective), so no
+            # register image crosses the host link. The op is tagged with
+            # the TARGET's owner; source rows are readable from any shard
+            # of the shared bank.
+            if self._mesh and any(self._resolve(n) is not home
+                                  for n in names):
+                with self._lock:
+                    self.cross_shard_merges += 1
             pending = _Pending(target, kind, payload, nkeys, tenant, deadline)
             self._submit(pending)
             return pending.outer

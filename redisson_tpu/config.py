@@ -295,8 +295,19 @@ class ClusterConfig:
     # Quarantine-then-migrate on topology node_down events (parallel/
     # topology.py watcher): drain the lost shard's slots onto survivors.
     auto_heal: bool = True
+    # Shard DATA plane. "stacks" (default): N full engine stacks, one
+    # executor/dispatcher/backend per shard. "mesh": N LOGICAL shards share
+    # ONE executor and ONE backend whose HLL bank is row-sharded across a
+    # device mesh (parallel/mesh.ShardedBank); cross-shard PFMERGE/count
+    # run as shard_map collectives instead of export->host-fold->import,
+    # and a multi-shard pipeline window retires in a single fused launch.
+    # Slot ownership, MOVED/ASK generation, journaling order, and migration
+    # semantics are bit-identical between the two planes.
+    data_plane: str = "stacks"
     # INTERNAL: >= 0 marks a config built by the ClusterManager for one
     # shard member (installs the slot-ownership guard); users leave it -1.
+    # -2 marks the SHARED engine client of the mesh data plane (installs
+    # the MeshOwnershipBackend guard, never the cluster facade).
     shard_id: int = -1
 
 
@@ -473,7 +484,8 @@ class Config:
         return self.memory
 
     def use_cluster(self, num_shards: int = 0, dir: str = "",
-                    replicas_per_shard: int = 0) -> "ClusterConfig":
+                    replicas_per_shard: int = 0,
+                    data_plane: str = "") -> "ClusterConfig":
         self.cluster = self.cluster or ClusterConfig()
         if num_shards:
             self.cluster.num_shards = num_shards
@@ -481,6 +493,12 @@ class Config:
             self.cluster.dir = dir
         if replicas_per_shard:
             self.cluster.replicas_per_shard = replicas_per_shard
+        if data_plane:
+            if data_plane not in ("stacks", "mesh"):
+                raise ValueError(
+                    f"cluster.data_plane must be 'stacks' or 'mesh', "
+                    f"got {data_plane!r}")
+            self.cluster.data_plane = data_plane
         return self.cluster
 
     def use_replicas(self, num_replicas: int = 0) -> "ReplicaConfig":

@@ -443,6 +443,124 @@ def pad_rows_repeat(rows):
     return out
 
 
+# ---------------------------------------------------------------------------
+# Mesh collectives (cluster data_plane="mesh")
+#
+# The bank is row-sharded across a 1-D device mesh (parallel/mesh.py
+# SLOT_AXIS). Cross-shard PFMERGE / PFCOUNT / DBSIZE then run as shard_map
+# collectives: each device max-folds the requested rows IT owns, one pmax
+# hop combines the partials across the mesh, and the target row's owner
+# scatters the merged registers back into its local block. No register
+# image ever crosses the host link (the stacks plane's export ->
+# np.maximum.reduce -> import round-trip).
+# ---------------------------------------------------------------------------
+
+try:  # jax >= 0.6 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - version-dependent import path
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from jax.sharding import PartitionSpec as _P
+
+
+@functools.lru_cache(maxsize=None)
+def _mesh_collectives(mesh):
+    """Per-mesh compiled collective entry points.
+
+    Cached on the Mesh object (hashable; parallel/mesh.get_mesh returns a
+    stable instance per device set) so repeated calls reuse the jit cache
+    instead of re-wrapping shard_map every dispatch."""
+    axis = mesh.axis_names[0]
+
+    def _local_fold(bank_local, rows):
+        """Max-fold the globally-indexed `rows` this device owns; returns
+        the pmax-combined merged registers (replicated) plus this device's
+        row base/extent for the writeback scatter."""
+        s_local = bank_local.shape[0]
+        base = jax.lax.axis_index(axis) * s_local
+        lrows = rows - base
+        own = (lrows >= 0) & (lrows < s_local)
+        gathered = bank_local[jnp.clip(lrows, 0, s_local - 1)]
+        partial = jnp.max(jnp.where(own[:, None], gathered, 0), axis=0)
+        return jax.lax.pmax(partial, axis), base, s_local
+
+    def _merge_body(bank_local, rows, target):
+        merged, base, s_local = _local_fold(bank_local, rows)
+        tl = target - base
+        ti = jnp.clip(tl, 0, s_local - 1)
+        t_own = (tl >= 0) & (tl < s_local)
+        upd = jnp.where(t_own, merged, bank_local[ti])
+        return bank_local.at[ti].set(upd)
+
+    def _merge_count_body(bank_local, rows, target):
+        merged, base, s_local = _local_fold(bank_local, rows)
+        tl = target - base
+        ti = jnp.clip(tl, 0, s_local - 1)
+        t_own = (tl >= 0) & (tl < s_local)
+        upd = jnp.where(t_own, merged, bank_local[ti])
+        return bank_local.at[ti].set(upd), hll.count(merged)
+
+    def _count_body(bank_local, rows):
+        merged, _, _ = _local_fold(bank_local, rows)
+        return hll.count(merged)
+
+    def _occupancy_body(bank_local):
+        # DBSIZE-style row occupancy: per-device count of non-empty rows,
+        # one psum hop for the mesh-wide total.
+        # graftlint: allow-int-reduce(0/1 row mask; bounded by bank capacity << 2^31)
+        local = jnp.sum(jnp.any(bank_local != 0, axis=1).astype(jnp.int32))
+        return jax.lax.psum(local, axis)
+
+    bank_spec = _P(axis, None)
+    rep = _P()
+    # The jits below close over the mesh, so they cannot live at module
+    # level; `_mesh_collectives` is lru_cached per mesh, so each compiles
+    # exactly once per device topology.
+    # graftlint: allow-recompile(constructed once per mesh via lru_cache)
+    merge = jax.jit(_shard_map(
+        _merge_body, mesh=mesh, in_specs=(bank_spec, rep, rep),
+        out_specs=bank_spec), donate_argnums=(0,))
+    # graftlint: allow-recompile(constructed once per mesh via lru_cache)
+    merge_count = jax.jit(_shard_map(
+        _merge_count_body, mesh=mesh, in_specs=(bank_spec, rep, rep),
+        out_specs=(bank_spec, rep)), donate_argnums=(0,))
+    # graftlint: allow-recompile(constructed once per mesh via lru_cache)
+    count = jax.jit(_shard_map(
+        _count_body, mesh=mesh, in_specs=(bank_spec, rep),
+        out_specs=rep))
+    # graftlint: allow-recompile(constructed once per mesh via lru_cache)
+    occupancy = jax.jit(_shard_map(
+        _occupancy_body, mesh=mesh, in_specs=(bank_spec,),
+        out_specs=rep))
+    return {"merge": merge, "merge_count": merge_count, "count": count,
+            "occupancy": occupancy}
+
+
+def hll_bank_merge_rows_collective(bank, rows, target, *, mesh):
+    """PFMERGE `rows` into row `target` on a mesh-sharded bank — the
+    device-side fold stays on the shard axis; the only cross-device
+    traffic is one pmax of the m merged registers."""
+    return _mesh_collectives(mesh)["merge"](
+        bank, jnp.asarray(rows, jnp.int32), jnp.int32(target))
+
+
+def hll_bank_merge_count_rows_collective(bank, rows, target, *, mesh):
+    """Fused collective PFMERGE + PFCOUNT (one launch, one pmax hop)."""
+    return _mesh_collectives(mesh)["merge_count"](
+        bank, jnp.asarray(rows, jnp.int32), jnp.int32(target))
+
+
+def hll_bank_count_rows_collective(bank, rows, *, mesh):
+    """Union cardinality over rows of a mesh-sharded bank (countWith)."""
+    return _mesh_collectives(mesh)["count"](
+        bank, jnp.asarray(rows, jnp.int32))
+
+
+def hll_bank_occupancy_collective(bank, *, mesh):
+    """Mesh-wide non-empty row count (DBSIZE analogue) via one psum."""
+    return _mesh_collectives(mesh)["occupancy"](bank)
+
+
 @jax.jit
 def bitset_pack(bits):
     """[m] uint8 cells -> packed bytes (numpy packbits big-endian order:

@@ -128,6 +128,12 @@ class Op:
     # majority of ops at the default sampling stride; every stamp below
     # guards on it so disabled tracing costs one attribute read.
     span: Any = None
+    # Logical cluster shard this op was dispatched FOR (mesh data plane:
+    # N logical shards share one executor, and the ownership guard at the
+    # backend waist compares this tag against the authoritative slot
+    # owner to generate MOVED exactly like the per-stack guards do).
+    # -1 = untagged (single-engine modes and the stacks data plane).
+    shard: int = -1
 
 
 def _op_payload_nbytes(op: Op) -> int:
@@ -325,9 +331,10 @@ class CommandExecutor:
 
     def execute_async(self, target: str, kind: str, payload: Any,
                       nkeys: int = 0, tenant: str = "",
-                      deadline: Optional[float] = None) -> Future:
+                      deadline: Optional[float] = None,
+                      shard: int = -1) -> Future:
         op = Op(target=target, kind=kind, payload=payload, nkeys=nkeys,
-                tenant=tenant, deadline=deadline)
+                tenant=tenant, deadline=deadline, shard=shard)
         with self._cv:
             self._enqueue_locked(op)
             self._cv.notify()
@@ -336,7 +343,8 @@ class CommandExecutor:
     def execute_many(self, staged: Sequence[Tuple[str, str, Any, int]],
                      tenant: str = "",
                      deadline: Optional[float] = None,
-                     admitted_ats: Optional[Sequence[float]] = None
+                     admitted_ats: Optional[Sequence[float]] = None,
+                     shard: int = -1
                      ) -> List[Future]:
         """Enqueue a pre-staged op list under ONE lock acquisition (the
         RBatch dispatch path): per-target FIFO order follows list order, and
@@ -347,7 +355,7 @@ class CommandExecutor:
         read, so a sampled span's admission stage covers network queueing
         too. Threaded per-op through the tracer's same-thread handoff."""
         ops = [Op(target=t, kind=k, payload=p, nkeys=n, tenant=tenant,
-                  deadline=deadline) for (t, k, p, n) in staged]
+                  deadline=deadline, shard=shard) for (t, k, p, n) in staged]
         trace = self._trace
         annotate = (trace.tracer.annotate_next
                     if trace is not None and admitted_ats is not None
@@ -391,9 +399,11 @@ class CommandExecutor:
             op.span = trace.begin_op(op.kind, op.target, op.tenant, op.nkeys)
         q.append(op)
 
-    def execute_sync(self, target: str, kind: str, payload: Any, nkeys: int = 0):
+    def execute_sync(self, target: str, kind: str, payload: Any,
+                     nkeys: int = 0, shard: int = -1):
         # graftlint: allow-g006(sync facade: blocks exactly like the reference's CommandSyncExecutor latch; serve-mode callers get deadline-bounded waits via the serving layer)
-        return self.execute_async(target, kind, payload, nkeys).result()
+        return self.execute_async(target, kind, payload, nkeys,
+                                  shard=shard).result()
 
     def execute_barrier(self, fn: Callable[[], Any], target: str = "") -> Future:
         """Run `fn` inline on the dispatcher thread, ordered like an op on

@@ -34,7 +34,7 @@ from redisson_tpu.ops import bloom as bloom_ops
 from redisson_tpu.ops import bloom_math
 from redisson_tpu.ops import hll as hll_ops
 from redisson_tpu.parallel import sharded, sharded_bits
-from redisson_tpu.parallel.mesh import build_mesh
+from redisson_tpu.parallel.mesh import get_mesh
 from redisson_tpu.store import SketchStore
 
 
@@ -66,7 +66,7 @@ class PodBackend:
     DISPATCH_TIME_STATE = True
 
     def __init__(self, cfg):
-        self.mesh = build_mesh(cfg.num_shards)
+        self.mesh = get_mesh(cfg.num_shards)
         self.seed = cfg.hash_seed
         cap = cfg.bank_capacity
         ndev = self.mesh.devices.size
@@ -146,7 +146,7 @@ class PodBackend:
         """Migrate the bank onto a mesh of `num_shards` devices — the
         topology-change path (master failover / shard add+remove in the
         reference becomes a re-device_put under a new sharding here)."""
-        new_mesh = build_mesh(num_shards)
+        new_mesh = get_mesh(num_shards)
         cap = self.bank_capacity
         ndev = new_mesh.devices.size
         if cap % ndev:

@@ -71,7 +71,7 @@ def _backend(c):
 def _random_tape(rng, t2, lanes):
     """A randomized raw tape: mixed op codes, random lengths, random
     old/wire rows, plus the numpy-oracle expected outputs."""
-    table = np.zeros((t2, 4), np.int32)
+    table = np.zeros((t2, wk.TABLE_COLS), np.int32)
     old = np.zeros((t2, lanes), np.uint8)
     wire = np.zeros((t2, lanes), np.uint8)
     want = np.zeros((t2, lanes), np.uint8)
@@ -82,7 +82,7 @@ def _random_tape(rng, t2, lanes):
             length = 0
         else:
             length = int(rng.integers(1, lanes + 1))
-        table[t] = (op, -1, 0, length)
+        table[t] = (op, -1, 0, length, 0)
         if op == wk.OP_HLL:
             old[t] = rng.integers(0, 65, lanes, np.uint8)
             wire[t, :length] = rng.integers(0, 65, length, np.uint8)
@@ -150,7 +150,7 @@ def test_encode_window_orders_hll_first_and_pads_pow2():
     tp = tape_mod.encode_window(planes, lambda name: 5)
     assert [p.kind for p in tp.planes] == [
         "hll_add", "bitset_set", "bloom_add"]
-    assert tp.table.shape == (4, 4)  # 3 entries pad to pow2
+    assert tp.table.shape == (4, wk.TABLE_COLS)  # 3 entries pad to pow2
     assert tp.n_hll == 1 and tp.hll_rows.tolist() == [5]
     assert tp.table[0].tolist()[:2] == [wk.OP_HLL, 5]
     assert tp.table[3, 0] == wk.OP_PAD and tp.table[3, 3] == 0

@@ -140,11 +140,13 @@ def build_registry():
     import jax
     import jax.numpy as jnp
 
+    from redisson_tpu import engine as eng
     from redisson_tpu.ingest import kernels as ik
     from redisson_tpu.ops import bitset, bloom, hashing, hll
     from redisson_tpu.ops import pallas_kernels as pk
     from redisson_tpu.ops import u64 as u
     from redisson_tpu.ops import window_kernel as wk
+    from redisson_tpu.parallel.mesh import SLOT_AXIS, get_mesh
 
     bits = jnp.zeros(((1 << 20) + 8,), jnp.uint8)  # exercises the pad path
     small = jnp.zeros((4096,), jnp.uint8)
@@ -160,12 +162,14 @@ def build_registry():
     stack = jnp.zeros((3, 2048), jnp.uint8)
     bank = jnp.zeros((100, 128), jnp.int32)
     # one tape row per op kind (hll / bloom / bitset) plus a pad row, so
-    # the audit traces every switch arm of the window megakernel
+    # the audit traces every switch arm of the window megakernel; the
+    # fifth column is the tape's shard axis (wk.COL_SHARD, mesh plane)
     tape_old = jnp.zeros((4, 256), jnp.uint8)
     tape_wire = jnp.zeros((4, 256), jnp.uint8)
     tape_tab = jnp.asarray(
-        [[wk.OP_HLL, 0, 0, 256], [wk.OP_BLOOM, 1, 256, 256],
-         [wk.OP_BITSET, 2, 512, 256], [wk.OP_PAD, 0, 0, 0]], jnp.int32)
+        [[wk.OP_HLL, 0, 0, 256, 0], [wk.OP_BLOOM, 1, 256, 256, 1],
+         [wk.OP_BITSET, 2, 512, 256, 0], [wk.OP_PAD, 0, 0, 0, 0]],
+        jnp.int32)
     pred = jnp.zeros((8,), bool)
 
     m_np2 = 1000003        # non-power-of-two <= 2^31: long-division path
@@ -289,6 +293,27 @@ def build_registry():
          lambda: (ik.hll_insert_segmented_lax, (regs, bucket, rank)), {}),
         ("ingest.bits_insert_segmented_lax",
          lambda: (ik.bits_insert_segmented_lax, (small, idx1d)), {}),
+        # -- mesh collectives (cluster data_plane="mesh"; traced over a
+        # 1-device mesh — the shard_map body is device-count-invariant) --
+        ("engine.hll_bank_merge_rows_collective",
+         lambda: (pc(eng.hll_bank_merge_rows_collective,
+                     mesh=get_mesh(1, SLOT_AXIS)),
+                  (jnp.zeros((8, hll.M), jnp.int32),
+                   jnp.zeros((4,), jnp.int32), jnp.int32(0))), {}),
+        ("engine.hll_bank_merge_count_rows_collective",
+         lambda: (pc(eng.hll_bank_merge_count_rows_collective,
+                     mesh=get_mesh(1, SLOT_AXIS)),
+                  (jnp.zeros((8, hll.M), jnp.int32),
+                   jnp.zeros((4,), jnp.int32), jnp.int32(0))), {}),
+        ("engine.hll_bank_count_rows_collective",
+         lambda: (pc(eng.hll_bank_count_rows_collective,
+                     mesh=get_mesh(1, SLOT_AXIS)),
+                  (jnp.zeros((8, hll.M), jnp.int32),
+                   jnp.zeros((4,), jnp.int32))), {}),
+        ("engine.hll_bank_occupancy_collective",
+         lambda: (pc(eng.hll_bank_occupancy_collective,
+                     mesh=get_mesh(1, SLOT_AXIS)),
+                  (jnp.zeros((8, hll.M), jnp.int32),)), {}),
     ]
     del jax
     return reg
