@@ -774,6 +774,88 @@ def bench_lock_witness(rounds=200, reps=3):
     return pct
 
 
+def bench_contract_witness(rounds=200, reps=3):
+    """Contract-coverage witness tax (PR 20): the batched-insert path —
+    the workload that hammers the executor's enqueue funnel, where the
+    witness tap lives — with the witness armed vs disarmed. The disarmed
+    side is ONE module-global probe (`RECORD is None`) per op, i.e. the
+    production configuration; the armed side adds a thread-local dict
+    increment per op. Budget < 1%: the witness is an always-on candidate
+    for CI smokes, so it must be invisible in the enqueue path. Both
+    clients live side by side and single passes alternate off/on
+    (best-of-reps each), so scheduler drift hits both sides instead of
+    biasing whichever ran second."""
+    import shutil
+    import tempfile
+
+    from redisson_tpu import contractwitness as cw
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+
+    batch = 64
+    ints = np.random.default_rng(29).integers(
+        0, 2**63, size=(rounds, batch), dtype=np.uint64)
+
+    def one_pass(client, tag, armed):
+        cw.arm(force=True) if armed else cw.disarm()
+        h = client.get_hyper_log_log(f"bench:cw:{tag}")
+        m = client.get_map(f"bench:cwm:{tag}")
+        pend = []
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            pend.append(h.add_ints_async(ints[i]))
+            pend.append(m.put_async(f"f{i}", i))
+            if len(pend) >= 8:
+                for f in pend:
+                    f.result(timeout=60)
+                pend.clear()
+        for f in pend:
+            f.result(timeout=60)
+        dt = time.perf_counter() - t0
+        cw.disarm()
+        return dt
+
+    root_a = tempfile.mkdtemp(prefix="rtpu-bench-cw-a-")
+    root_b = tempfile.mkdtemp(prefix="rtpu-bench-cw-b-")
+    base = wit = float("inf")
+    try:
+        off_client = RedissonTPU.create(
+            _persist_cfg(root_a))
+        try:
+            on_client = RedissonTPU.create(
+                _persist_cfg(root_b))
+            try:
+                one_pass(off_client, "p", False)  # warm compile/caches
+                one_pass(on_client, "w", True)
+                for _ in range(max(2, reps)):
+                    base = min(base, one_pass(off_client, "p", False))
+                    wit = min(wit, one_pass(on_client, "w", True))
+            finally:
+                on_client.shutdown()
+        finally:
+            off_client.shutdown()
+    finally:
+        cw.uninstall()
+        shutil.rmtree(root_a, ignore_errors=True)
+        shutil.rmtree(root_b, ignore_errors=True)
+
+    pct = 100.0 * (wit / base - 1.0)
+    print(f"# contract_witness_overhead: {base * 1e3:.1f} ms off -> "
+          f"{wit * 1e3:.1f} ms armed ({pct:+.1f}%; budget < 1%)",
+          file=sys.stderr)
+    return pct
+
+
+def _persist_cfg(root):
+    from redisson_tpu.config import Config
+
+    cfg = Config()
+    # fsync "off" for the same reason as bench_lock_witness: an everysec
+    # fsync tick landing inside one ~300ms timed pass is pure variance.
+    cfg.use_persist(root).fsync = "off"
+    return cfg
+
+
 def bench_fault(rounds=200, reps=3):
     """Fault-subsystem numbers (PR 8): fault_overhead_pct — the batched-
     insert workload with taxonomy + injection seams + watchdog + rebuild
@@ -1439,6 +1521,12 @@ def main():
             50 if quick else 200, reps=2 if quick else 3), 1)
     except Exception as exc:  # noqa: BLE001
         print(f"# lock witness bench failed: {exc!r}", file=sys.stderr)
+    try:
+        result["contract_witness_overhead_pct"] = round(
+            bench_contract_witness(50 if quick else 200,
+                                   reps=2 if quick else 3), 1)
+    except Exception as exc:  # noqa: BLE001
+        print(f"# contract witness bench failed: {exc!r}", file=sys.stderr)
     try:
         pct, rebuild_s = bench_fault(
             50 if quick else 200, reps=2 if quick else 3)

@@ -3126,6 +3126,181 @@ def geo_smoke():
     return ok
 
 
+def contract_smoke():
+    """Op-contract acceptance (graftlint Tier E + the runtime contract
+    witness). Gates:
+
+      (a) STATIC CONTRACT CLEAN: `tools.graftlint.contracts.analyze()`
+          reports zero G019-G022 findings — every per-subsystem kind
+          registry agrees with the OP_TABLE, every journaled write has a
+          replay path, every destructive geo kind arbitrates;
+      (b) NO DECLARED-BUT-DEAD CELLS: with the contract witness armed, a
+          workload drives every execution surface (facade ingest, the
+          RESP wire window, a two-site geo converge, crash-recovery
+          replay) and the witnessed (kind x surface) matrix must cover
+          every statically declared write-kind cell — plus, dynamically,
+          every kind the replay journal actually holds. A declared cell
+          nothing exercises is where the next registry drift hides.
+    """
+    import shutil
+    import tempfile
+
+    from redisson_tpu import contractwitness as cw
+    from redisson_tpu.client import RedissonTPU
+    from redisson_tpu.config import Config
+    from redisson_tpu.geo import connect_sites, converge
+    from redisson_tpu.interop.resp_client import SyncRespClient
+    from redisson_tpu.persist.journal import iter_records
+    from tools.graftlint.contracts import analyze, declared_cells
+
+    ok = True
+
+    findings, _, stats = analyze()
+    for f in findings:
+        print(f"{f.file}:{f.line}: {f.rule} {f.message}", file=sys.stderr)
+    if findings:
+        print(f"# contract-smoke: static tier unclean "
+              f"({len(findings)} finding(s))", file=sys.stderr)
+        ok = False
+    declared = declared_cells()
+
+    tmp = tempfile.mkdtemp(prefix="rtpu-contract-smoke-")
+    journaled = set()
+    cw.arm(force=True)
+    cw.contract_witness_reset()
+    try:
+        # -- facade + journal seed: every delta-plane write kind --------
+        pdir = os.path.join(tmp, "p")
+        cfg = Config()
+        cfg.use_local()
+        cfg.use_persist(pdir).fsync = "always"
+        c = RedissonTPU.create(cfg)
+        try:
+            c.get_hyper_log_log("cs:h").add_all(
+                [f"v{i}" for i in range(64)])
+            bf = c.get_bloom_filter("cs:bf")
+            bf.try_init(1024, 0.01)
+            bf.add_all([f"b{i}" for i in range(64)])
+            c.get_bit_set("cs:bits").set_bits(range(0, 64, 2))
+            c.get_keys().delete("cs:h")
+        finally:
+            c.shutdown()
+        journaled = {rec.kind for rec in iter_records(pdir)}
+
+        # -- replay: recover the journal through the live executor ------
+        cfg2 = Config()
+        cfg2.use_local()
+        cfg2.use_persist(pdir).fsync = "always"
+        r = RedissonTPU.create(cfg2)
+        try:
+            replayed = (r.persist.last_recovery or {}).get("replayed", 0)
+            if not replayed:
+                print("# contract-smoke: recovery replayed nothing",
+                      file=sys.stderr)
+                ok = False
+        finally:
+            r.shutdown()
+
+        # -- wire: one pipeline covering every staged write command -----
+        wcfg = Config()
+        wcfg.use_serve()
+        wcfg.use_wire()
+        w = RedissonTPU(wcfg)
+        try:
+            cli = SyncRespClient("127.0.0.1", w.wire.port,
+                                 retry_attempts=1, timeout=30.0)
+            cli.connect()
+            try:
+                cli.pipeline([
+                    ("PFADD", "cs:wh", "a", "b"),
+                    ("PFADD", "cs:wh2", "c"),
+                    ("PFMERGE", "cs:wm", "cs:wh", "cs:wh2"),
+                    ("PFCOUNT", "cs:wm"),
+                    ("SETBIT", "cs:wb", "3", "1"),
+                    ("SETBIT", "cs:wb", "3", "0"),
+                    ("SETBIT", "cs:wb2", "1", "1"),
+                    ("BITOP", "AND", "cs:wd", "cs:wb", "cs:wb2"),
+                    ("GETBIT", "cs:wb", "3"),
+                    ("BITCOUNT", "cs:wb"),
+                    ("DEL", "cs:wb2"),
+                    ("EXISTS", "cs:wb"),
+                    ("KEYS", "cs:*"),
+                    ("FLUSHALL",),
+                ])
+            finally:
+                cli.close()
+        finally:
+            _close(w)
+
+        # -- geo: two sites, one origin op per arbitration action -------
+        def site(sid):
+            scfg = Config()
+            scfg.use_local()
+            scfg.use_persist(os.path.join(tmp, sid)).fsync = "always"
+            g = scfg.use_geo(sid)
+            g.poll_interval_s = 0.005
+            g.anti_entropy_interval_s = 0.05
+            return RedissonTPU.create(scfg)
+
+        a, b = site("A"), site("B")
+        try:
+            connect_sites([a, b])
+            a.get_keys().flushall()                      # -> geo_flush
+            a.get_hyper_log_log("cs:g").add_all(         # -> geo_merge
+                [f"g{i}" for i in range(32)])
+            gd = a.get_hyper_log_log("cs:gd")
+            gd.add_all(["d1", "d2"])
+            a.get_keys().delete("cs:gd")                 # -> geo_delete
+            gr = a.get_hyper_log_log("cs:gr")
+            gr.add_all(["r1", "r2"])
+            gr.rename("cs:gr2")                          # -> geo_replace
+            b.get_hyper_log_log("cs:g").add_all(["bside"])
+            if not converge([a, b], timeout_s=60):
+                print("# contract-smoke: geo mesh never converged",
+                      file=sys.stderr)
+                ok = False
+        finally:
+            _close(a)
+            _close(b)
+
+        snap = cw.contract_snapshot()
+    finally:
+        cw.uninstall()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    cells = snap.get("cells", {})
+    dead = {}
+    for surf, kinds in declared.items():
+        missing = sorted(set(kinds) - set(cells.get(surf, {})))
+        if missing:
+            dead[surf] = missing
+    replay_missing = sorted(journaled - set(cells.get("replay", {})))
+    if dead:
+        print(f"# contract-smoke: DECLARED-BUT-DEAD cells: {dead}",
+              file=sys.stderr)
+        ok = False
+    if replay_missing:
+        print(f"# contract-smoke: journaled kinds never witnessed on the "
+              f"replay surface: {replay_missing}", file=sys.stderr)
+        ok = False
+
+    result = {
+        "static_findings": len(findings),
+        "tier_e_stats": stats,
+        "declared_cells": {s: len(k) for s, k in declared.items()},
+        "witnessed_cells": {s: len(k) for s, k in cells.items()},
+        "journaled_kinds": sorted(journaled),
+        "dead_cells": dead,
+        "replay_missing": replay_missing,
+    }
+    print(json.dumps({"contract_smoke": result}), flush=True)
+    print(f"# contract-smoke: {'PASS' if ok else 'FAIL'} — "
+          f"{sum(len(k) for k in declared.values())} declared cell(s), "
+          f"{sum(len(k) for k in cells.values())} witnessed, "
+          f"{len(journaled)} journaled kind(s) replayed", file=sys.stderr)
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, choices=sorted(CONFIGS))
@@ -3237,6 +3412,12 @@ def main():
                          "apply took the fused delta path, and the link "
                          "ships fewer bytes per record than the raw "
                          "journal payloads, then exit")
+    ap.add_argument("--contract-smoke", action="store_true",
+                    help="op-contract gate: graftlint Tier E static pass "
+                         "must be clean, then a witnessed workload must "
+                         "cover every declared (kind x surface) write "
+                         "cell — facade, wire, geo, and journal replay — "
+                         "with zero declared-but-dead cells, then exit")
     ap.add_argument("--chaos-smoke", action="store_true",
                     help="seeded fault injection: retry absorption digest-"
                          "identical to a fault-free oracle, uncertain-fault "
@@ -3261,6 +3442,9 @@ def main():
 
     if args.race_smoke:
         sys.exit(0 if race_smoke() else 1)
+
+    if args.contract_smoke:
+        sys.exit(0 if contract_smoke() else 1)
 
     if args.chaos_smoke:
         sys.exit(0 if chaos_smoke() else 1)
@@ -3310,9 +3494,19 @@ def main():
         if bad_tier_d:
             print(f"# lint-smoke: tier_d missing/unclean: {tier_d}",
                   file=sys.stderr)
+        # Tier E must be present AND clean over a real op universe: a
+        # lint run that silently skipped the contract tier (import
+        # failure, an empty OP_TABLE extraction) must fail the gate.
+        tier_e = tiers.get("tier_e")
+        bad_tier_e = (tier_e is None or tier_e.get("kinds", 0) < 100
+                      or tier_e.get("declared_cells", 0) < 14
+                      or any(tier_e.get("rules", {"": 1}).values()))
+        if bad_tier_e:
+            print(f"# lint-smoke: tier_e missing/unclean: {tier_e}",
+                  file=sys.stderr)
         print(f"# lint-smoke: {len(dicts)} finding(s); tier_d="
-              f"{tier_d}", file=sys.stderr)
-        sys.exit(1 if (dicts or bad_tier_d) else 0)
+              f"{tier_d}; tier_e={tier_e}", file=sys.stderr)
+        sys.exit(1 if (dicts or bad_tier_d or bad_tier_e) else 0)
 
     global _INGEST
     _INGEST = args.ingest

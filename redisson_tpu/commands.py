@@ -32,10 +32,21 @@ class OpDescriptor:
                              # "-" = no wire analogue (engine/device only)
     write: bool              # mutates keyspace/sketch state
     tiers: FrozenSet[str] = field(default_factory=frozenset)
+    #: Machine-readable contract annotation (graftlint Tier E, G020).
+    #: A tpu-tier kind with a RESP analogue is expected to be served by the
+    #: wire front-end (wire/commands.py); a kind deliberately absent from
+    #: that table declares its escape here:
+    #:   "engine-only(<why>)" — facade-reachable, not wire-served
+    #:   "internal(<why>)"    — no client surface at all (replication,
+    #:                          checkpoint, migration transport)
+    #: Kinds with redis_name "-" are implicitly internal. An empty reason
+    #: does not count as an escape — the lint flags it.
+    contract: str = ""
 
 
-def _d(kind, redis_name, write, tiers):
-    return OpDescriptor(kind, redis_name, write, frozenset(tiers.split()))
+def _d(kind, redis_name, write, tiers, contract=""):
+    return OpDescriptor(kind, redis_name, write, frozenset(tiers.split()),
+                        contract)
 
 
 _ALL = "engine redis"
@@ -63,7 +74,9 @@ OP_TABLE = {d.kind: d for d in [
     _d("flushall", "FLUSHALL", True, _ALL + " tpu"),
     _d("keys", "KEYS", False, _ALL + " tpu"),
     _d("type", "TYPE", False, _ALL),
-    _d("rename", "RENAME", True, _ALL + " tpu"),
+    _d("rename", "RENAME", True, _ALL + " tpu",
+       "engine-only(wire RENAME needs the cross-slot move semantics the "
+       "cluster router does not expose yet)"),
     _d("persist", "PERSIST", True, _ALL),
     _d("pexpire", "PEXPIRE", True, _ALL),
     _d("pexpireat", "PEXPIREAT", True, _ALL),
@@ -203,27 +216,52 @@ OP_TABLE = {d.kind: d for d in [
     _d("hll_count", "PFCOUNT", False, "tpu redis"),
     _d("hll_count_with", "PFCOUNT", False, "tpu redis"),
     _d("hll_merge_with", "PFMERGE", True, "tpu redis"),
-    _d("hll_merge_count", "PFMERGE", True, "tpu redis"),
-    _d("hll_export", "GET", False, "tpu redis"),
-    _d("hll_import", "RESTORE", True, "tpu"),
+    _d("hll_merge_count", "PFMERGE", True, "tpu redis",
+       "engine-only(facade composite of PFMERGE+PFCOUNT in one dispatch; "
+       "wire clients issue the two commands separately)"),
+    _d("hll_export", "GET", False, "tpu redis",
+       "engine-only(redis-interop register export; wire reads are served "
+       "by PFCOUNT)"),
+    _d("hll_import", "RESTORE", True, "tpu",
+       "internal(checkpoint/replica-bootstrap restore transport)"),
     _d("bitset_set", "SETBIT", True, "tpu redis"),
     _d("bitset_clear", "SETBIT", True, "tpu redis"),
     _d("bitset_get", "GETBIT", False, "tpu redis"),
     _d("bitset_cardinality", "BITCOUNT", False, "tpu redis"),
-    _d("bitset_length", "GETRANGE", False, "tpu redis"),
+    _d("bitset_length", "GETRANGE", False, "tpu redis",
+       "engine-only(facade bit-length probe; the wire exposes byte sizing "
+       "via the BITOP reply rider)"),
     _d("bitset_size", "STRLEN", False, "tpu redis"),
-    _d("bitset_set_range", "SETBIT", True, "tpu redis"),
+    _d("bitset_set_range", "SETBIT", True, "tpu redis",
+       "engine-only(facade bulk range set; wire SETBIT is single-bit)"),
     _d("bitset_op", "BITOP", True, "tpu redis"),
-    _d("bloom_init", "LUA", True, "tpu redis"),
-    _d("bloom_add", "SETBIT", True, "tpu redis"),
-    _d("bloom_contains", "GETBIT", False, "tpu redis"),
-    _d("bloom_contains_count", "BITCOUNT", False, "tpu redis"),
-    _d("bloom_count", "BITCOUNT", False, "tpu redis"),
-    _d("bloom_meta", "HGETALL", False, "tpu redis"),
+    # Bloom kinds are facade-only: the reference's RBloomFilter is a
+    # Lua/bitfield composite object, not a single RESP command — a wire
+    # surface needs that object protocol, not a command mapping.
+    _d("bloom_init", "LUA", True, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
+    _d("bloom_add", "SETBIT", True, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
+    _d("bloom_contains", "GETBIT", False, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
+    _d("bloom_contains_count", "BITCOUNT", False, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
+    _d("bloom_count", "BITCOUNT", False, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
+    _d("bloom_meta", "HGETALL", False, "tpu redis",
+       "engine-only(bloom wire surface needs the reference's Lua-object "
+       "protocol)"),
     # Generic bitset/bloom state export/import (checkpoint + durability;
     # the sharded pod tier serves these from mesh-sharded arrays).
-    _d("bits_export", "DUMP", False, "tpu"),
-    _d("bits_import", "RESTORE", True, "tpu"),
+    _d("bits_export", "DUMP", False, "tpu",
+       "internal(checkpoint + slot-migration transport)"),
+    _d("bits_import", "RESTORE", True, "tpu",
+       "internal(checkpoint + slot-migration transport)"),
     # Barrier flushing host-mirror bloom bits into device state before a
     # device-side read (durability/checkpoint); internal, no wire analogue.
     _d("bloom_sync", "-", True, "tpu"),

@@ -57,6 +57,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from redisson_tpu import contractwitness as _cw
 from redisson_tpu.concurrency import make_condition, make_lock
 from redisson_tpu.fault import inject as fault_inject
 from redisson_tpu.fault.taxonomy import StateUncertainFault, classify
@@ -335,6 +336,11 @@ class CommandExecutor:
                       shard: int = -1) -> Future:
         op = Op(target=target, kind=kind, payload=payload, nkeys=nkeys,
                 tenant=tenant, deadline=deadline, shard=shard)
+        # Contract-witness tap at the single enqueue funnel: every real op
+        # kind passes here regardless of surface (facade, wire window,
+        # journal replay, replica stream, geo apply).
+        if _cw.RECORD is not None and kind != BARRIER_KIND:
+            _cw.RECORD(kind)
         with self._cv:
             self._enqueue_locked(op)
             self._cv.notify()
@@ -356,6 +362,10 @@ class CommandExecutor:
         too. Threaded per-op through the tracer's same-thread handoff."""
         ops = [Op(target=t, kind=k, payload=p, nkeys=n, tenant=tenant,
                   deadline=deadline, shard=shard) for (t, k, p, n) in staged]
+        if _cw.RECORD is not None:
+            for op in ops:
+                if op.kind != BARRIER_KIND:
+                    _cw.RECORD(op.kind)
         trace = self._trace
         annotate = (trace.tracer.annotate_next
                     if trace is not None and admitted_ats is not None

@@ -45,6 +45,7 @@ import collections
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from redisson_tpu import contractwitness
 from redisson_tpu.concurrency import make_lock
 
 Stamp = Tuple[int, str]
@@ -236,9 +237,10 @@ class GeoApplier:
             payload = {k: v for k, v in msg.items()
                        if k not in ("kind", "target", "repair")}
             payload["stamp"] = stamp
-            fut = self._m.execute_async(
-                msg["target"], action, payload,
-                nkeys=int(msg.get("nkeys", 0) or 0))
+            with contractwitness.surface("geo"):
+                fut = self._m.execute_async(
+                    msg["target"], action, payload,
+                    nkeys=int(msg.get("nkeys", 0) or 0))
             self._track(fut)
         if resurrect is not None:
             self._m.broadcast_repair(resurrect)
@@ -256,8 +258,9 @@ class GeoApplier:
         with self._lock:
             doomed = [k for k in keys
                       if self.lw.get(k, NEG_STAMP) < stamp]
-        fut = self._m.execute_async(
-            "", "geo_flush", {"keys": doomed, "stamp": stamp})
+        with contractwitness.surface("geo"):
+            fut = self._m.execute_async(
+                "", "geo_flush", {"keys": doomed, "stamp": stamp})
         self._track(fut)
         survivors = keys.difference(doomed)
         shipped = sum(1 for k in sorted(survivors)

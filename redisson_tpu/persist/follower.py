@@ -29,7 +29,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 from zlib import crc32
 
-from redisson_tpu import checkpoint
+from redisson_tpu import checkpoint, contractwitness
 from redisson_tpu.concurrency import make_lock
 from redisson_tpu.persist.journal import (
     _FRAME,
@@ -329,8 +329,10 @@ class JournalFollower:
             if key != group:
                 drain()
                 group = key
-            futures.append(
-                executor.execute_async(rec.target, rec.kind, rec.payload))
+            with contractwitness.surface("replica"):
+                futures.append(
+                    executor.execute_async(rec.target, rec.kind,
+                                           rec.payload))
         drain()
         with self._applied_lock:
             self._applied = last_seq

@@ -26,7 +26,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional
 
-from redisson_tpu import checkpoint
+from redisson_tpu import checkpoint, contractwitness
 from redisson_tpu.persist.journal import iter_records
 from redisson_tpu.persist.snapshotter import STRUCTURES_FILE, find_snapshots
 
@@ -88,7 +88,9 @@ def recover(client, path: str, replay_window: int = REPLAY_WINDOW) -> Dict[str, 
             group = key
         elif len(pending) >= replay_window:
             errors += drain(replay_window // 2)
-        pending.append(executor.execute_async(rec.target, rec.kind, rec.payload))
+        with contractwitness.surface("replay"):
+            pending.append(
+                executor.execute_async(rec.target, rec.kind, rec.payload))
         replayed += 1
         last_seq = rec.seq
     errors += drain(0)

@@ -673,3 +673,117 @@ def test_pool_add_listener_marshals_to_io_thread():
             assert events.append in pool._pool._listeners
         finally:
             pool.close()
+
+
+# ---------------------------------------------------------------------------
+# Tier E audit pins (PR 20): the contract drift the new tier surfaced was
+# a family of tpu-tier ops with RESP analogues that the wire command
+# table silently did not serve — bloom_*, bits_export/import, the
+# hll_merge_count/hll_export composites, bitset_length/set_range, and
+# RENAME. Each is now an *explicit* OpDescriptor contract escape
+# (engine-only/internal with a reason) instead of an undeclared hole.
+# These pins keep the declarations honest.
+# ---------------------------------------------------------------------------
+
+def test_every_tpu_kind_is_wire_served_or_escaped():
+    # The G020 invariant, pinned independently of graftlint's own gate:
+    # a new tpu-tier kind with a redis_name must either be staged by
+    # wire/commands.py or carry a reasoned escape annotation.
+    import re
+
+    from redisson_tpu.commands import OP_TABLE
+    from tools.graftlint.contracts import gather
+
+    wire_kinds = gather()["wire_kinds"]
+    escape = re.compile(r"^(engine-only|internal)\((.+)\)$", re.DOTALL)
+    holes = []
+    for kind, d in sorted(OP_TABLE.items()):
+        if "tpu" not in d.tiers or d.redis_name == "-":
+            continue
+        if kind in wire_kinds:
+            continue
+        m = escape.match(d.contract or "")
+        if m is None or not m.group(2).strip():
+            holes.append(kind)
+    assert holes == [], (
+        f"tpu-tier kinds invisible to RESP clients with no declared "
+        f"escape: {holes}")
+
+
+def test_bloom_family_escape_is_declared():
+    # The audit's concrete finding: the whole bloom surface (added PR 13)
+    # never reached the wire table. It is engine-only by design — the
+    # reference's RBloomFilter speaks a Lua-object protocol, not plain
+    # commands — and that design decision must stay machine-readable.
+    from redisson_tpu.commands import OP_TABLE
+
+    for kind in ("bloom_init", "bloom_add", "bloom_contains",
+                 "bloom_count", "bloom_meta"):
+        assert OP_TABLE[kind].contract.startswith("engine-only("), kind
+
+    # Transport-only kinds are internal, not engine-only: they have no
+    # client surface at all (checkpoint / slot migration payloads).
+    for kind in ("bits_export", "bits_import", "hll_import"):
+        assert OP_TABLE[kind].contract.startswith("internal("), kind
+
+
+def test_wire_table_extraction_sees_conditional_kinds():
+    # SETBIT picks its kind at runtime (`"bitset_set" if value else
+    # "bitset_clear"`); the audit's first extraction pass (staged-tuple
+    # literals only) missed the clear arm and called bitset_clear a wire
+    # hole. Pin the conditional-kind form staying visible.
+    from tools.graftlint.contracts import gather
+
+    wire_kinds = gather()["wire_kinds"]
+    assert "bitset_set" in wire_kinds
+    assert "bitset_clear" in wire_kinds
+
+
+def test_foldable_kinds_all_coalesce():
+    # The delta plane's foldable() dispatcher and the TPU backend's
+    # COALESCE_GROUPS must agree, or a foldable kind dispatches one
+    # device launch per op instead of riding the fused delta window.
+    from redisson_tpu.backend_tpu import TpuBackend
+    from redisson_tpu.commands import OP_TABLE
+    from tools.graftlint.contracts import gather
+
+    foldable = gather()["foldable_kinds"]
+    assert foldable, "foldable() extraction came back empty"
+    write_foldable = {k for k in foldable
+                     if k in OP_TABLE and OP_TABLE[k].write}
+    assert write_foldable <= set(TpuBackend.COALESCE_GROUPS), (
+        write_foldable - set(TpuBackend.COALESCE_GROUPS))
+
+
+def test_contract_witness_tags_replay_and_facade_surfaces(tmp_path):
+    # End-to-end pin for the runtime half: the same kind lands in
+    # different matrix cells depending on which seam dispatched it.
+    from redisson_tpu import contractwitness as cw
+
+    def make(jdir):
+        cfg = Config()
+        cfg.use_local()
+        cfg.use_persist(str(jdir)).fsync = "always"
+        return RedissonTPU.create(cfg)
+
+    cw.arm(force=True)
+    try:
+        cw.contract_witness_reset()
+        c = make(tmp_path)
+        try:
+            c.get_hyper_log_log("cwpin").add_all([b"a", b"b"])
+        finally:
+            c.shutdown()
+        facade = cw.contract_snapshot()["cells"].get("facade", {})
+        assert facade.get("hll_add", 0) >= 1
+
+        cw.contract_witness_reset()
+        c2 = make(tmp_path)
+        try:
+            assert c2.get_hyper_log_log("cwpin").count() == 2
+        finally:
+            c2.shutdown()
+        cells = cw.contract_snapshot()["cells"]
+        assert cells.get("replay", {}).get("hll_add", 0) >= 1, cells
+    finally:
+        cw.uninstall()

@@ -1887,3 +1887,350 @@ def test_cli_no_async_skips_tier_d():
     assert out.returncode == 0, out.stdout + out.stderr
     payload = json.loads(out.stdout)
     assert payload["tier_d"]["modules"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Tier E: whole-program op-contract analysis (G019-G022)
+# ---------------------------------------------------------------------------
+
+def _contract_universe():
+    """A minimal self-consistent op universe: four kinds, every registry
+    agreeing. Each seeded-violation test perturbs exactly one key."""
+    from redisson_tpu.commands import _d
+
+    ops = {d.kind: d for d in [
+        _d("hll_add", "PFADD", True, "engine tpu"),
+        _d("hll_count", "PFCOUNT", False, "engine tpu"),
+        _d("delete", "DEL", True, "engine tpu"),
+        _d("geo_merge", "-", True, "engine"),
+    ]}
+    return {
+        "op_table": ops,
+        "cluster_kinds": frozenset(),
+        "semilattice_kinds": frozenset({"hll_add"}),
+        "destructive_kinds": frozenset({"delete"}),
+        "ship_kinds": frozenset({"hll_add", "delete"}),
+        "coalesce_groups": {"hll_add": "delta"},
+        "global_coalesce": frozenset(),
+        "read_kinds": frozenset({"hll_count"}),
+        "pinned_kinds": frozenset(),
+        "lint_write_kinds": frozenset({"hll_add", "delete", "geo_merge"}),
+        "both_kinds": frozenset({"delete"}),
+        "foldable_kinds": frozenset({"hll_add"}),
+        "wire_kinds": frozenset({"hll_add", "hll_count", "delete"}),
+        "facade_kinds": {"hll_add": ("models/hll.py", 1),
+                         "hll_count": ("models/hll.py", 2)},
+        "engine_handlers": {"hll_add", "hll_count", "geo_merge"},
+        "tpu_handlers": {"hll_add", "hll_count"},
+        "applier_local_branches": {"delete", "flushall"},
+        "applier_rebuild_branches": {"geo_merge"},
+    }
+
+
+def contract_findings(**perturb):
+    from tools.graftlint.contracts import analyze
+
+    u = _contract_universe()
+    u.update(perturb)
+    findings, _, stats = analyze(**u)
+    return findings, stats
+
+
+def test_contract_universe_is_clean():
+    findings, stats = contract_findings()
+    assert findings == [], [f.message for f in findings]
+    assert stats["kinds"] == 4 and stats["write_kinds"] == 3
+
+
+def test_g019_registry_kind_not_in_op_table():
+    findings, stats = contract_findings(
+        cluster_kinds=frozenset({"warp_flip"}))
+    assert [f.rule for f in findings] == ["G019"]
+    assert "warp_flip" in findings[0].message
+    assert "CLUSTER_KINDS" in findings[0].message
+    assert stats["rules"]["G019"] == 1
+
+
+def test_g019_foldable_kind_missing_from_coalesce():
+    findings, _ = contract_findings(coalesce_groups={})
+    assert [f.rule for f in findings] == ["G019"]
+    assert "hll_add" in findings[0].message
+    assert "COALESCE_GROUPS" in findings[0].message
+
+
+def test_g019_kind_classified_both_semilattice_and_destructive():
+    findings, _ = contract_findings(
+        destructive_kinds=frozenset({"delete", "hll_add"}),
+        applier_local_branches={"delete", "hll_add"})
+    assert [f.rule for f in findings] == ["G019"]
+    assert "BOTH" in findings[0].message
+
+
+def test_g019_shipped_kind_unclassified():
+    findings, _ = contract_findings(
+        ship_kinds=frozenset({"hll_add", "delete", "hll_count"}))
+    rules = [f.rule for f in findings]
+    assert set(rules) == {"G019"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "neither" in msgs          # unclassified
+    assert "never journals" in msgs   # hll_count is not write=True
+
+
+def test_g019_geo_record_kind_in_ship_set():
+    findings, _ = contract_findings(
+        ship_kinds=frozenset({"hll_add", "delete", "geo_merge"}),
+        semilattice_kinds=frozenset({"hll_add", "geo_merge"}))
+    assert any("echo-loop" in f.message for f in findings)
+    assert all(f.rule == "G019" for f in findings)
+
+
+def test_g019_g007_write_set_drift():
+    findings, _ = contract_findings(
+        lint_write_kinds=frozenset({"hll_add", "delete"}))  # geo_merge lost
+    assert [f.rule for f in findings] == ["G019"]
+    assert "G007" in findings[0].message
+    assert findings[0].file == "tools/graftlint/astlint.py"
+
+
+def test_g020_facade_kind_not_in_op_table():
+    findings, _ = contract_findings(
+        facade_kinds={"hll_add": ("models/hll.py", 1),
+                      "mystery_op": ("models/hll.py", 9)})
+    assert [f.rule for f in findings] == ["G020"]
+    assert "mystery_op" in findings[0].message
+    assert findings[0].file == "models/hll.py"
+    assert findings[0].line == 9
+
+
+def test_g020_facade_read_kind_unroutable():
+    findings, _ = contract_findings(read_kinds=frozenset())
+    assert [f.rule for f in findings] == ["G020"]
+    assert "hll_count" in findings[0].message
+    assert "READ_KINDS" in findings[0].message
+
+
+def test_g020_wire_hole_without_contract_escape():
+    findings, _ = contract_findings(wire_kinds=frozenset())
+    assert {f.rule for f in findings} == {"G020"}
+    flagged = {f.message.split("'")[1] for f in findings}
+    assert flagged == {"hll_add", "hll_count", "delete"}
+
+
+def test_g020_contract_escape_clears_wire_hole():
+    from redisson_tpu.commands import _d
+
+    u = _contract_universe()
+    ops = dict(u["op_table"])
+    ops["delete"] = _d("delete", "DEL", True, "engine tpu",
+                       "engine-only(facade composite; router owns DEL)")
+    findings, _ = contract_findings(
+        op_table=ops, wire_kinds=frozenset({"hll_add", "hll_count"}))
+    assert findings == [], [f.message for f in findings]
+    # ... but an EMPTY reason is not an escape
+    ops["delete"] = _d("delete", "DEL", True, "engine tpu", "engine-only( )")
+    findings, _ = contract_findings(
+        op_table=ops, wire_kinds=frozenset({"hll_add", "hll_count"}))
+    assert [f.rule for f in findings] == ["G020"]
+
+
+def test_g021_journaled_kind_without_replay_handler():
+    findings, _ = contract_findings(
+        tpu_handlers=frozenset({"hll_count"}))  # hll_add lost its handler
+    assert [f.rule for f in findings] == ["G021"]
+    assert "hll_add" in findings[0].message
+    assert "tpu backend" in findings[0].message
+
+
+def test_g021_both_kinds_satisfy_dispatch():
+    # delete has NO _op_delete in either backend in the fixture — the
+    # RoutingBackend._BOTH fan-out is its dispatch path, and that counts.
+    findings, _ = contract_findings(both_kinds=frozenset())
+    assert [f.rule for f in findings] == ["G021"]
+    assert "delete" in findings[0].message
+
+
+def test_g022_destructive_kind_missing_lww_branch():
+    findings, _ = contract_findings(applier_local_branches={"flushall"})
+    assert [f.rule for f in findings] == ["G022"]
+    assert "delete" in findings[0].message
+    assert "note_local" in findings[0].message
+
+
+def test_g022_geo_kind_missing_rebuild_branch():
+    findings, _ = contract_findings(applier_rebuild_branches=set())
+    assert [f.rule for f in findings] == ["G022"]
+    assert "geo_merge" in findings[0].message
+    assert "rebuild" in findings[0].message
+
+
+def test_tier_e_suppression_requires_reason():
+    from tools.graftlint import contracts
+
+    base = 'OP_TABLE = [\n    _d("delete", "DEL", True, "engine tpu"),{allow}\n]\n'
+    rel = contracts.OP_TABLE_FILE
+
+    def run(allow):
+        src = contracts._Src(rel, base.format(allow=allow))
+        findings, _ = contract_findings(
+            wire_kinds=frozenset({"hll_add", "hll_count"}),
+            sources={rel: src})
+        return findings
+
+    assert [f.rule for f in run("")] == ["G020"]
+    # bare allow (no reason) does not suppress
+    assert [f.rule for f in run("  # graftlint: allow-contract")] == ["G020"]
+    assert [f.rule for f in run("  # graftlint: allow-contract()")] == ["G020"]
+    # tier-wide escape with a reason does
+    assert run("  # graftlint: allow-contract(router owns DEL)") == []
+    # ... as does the per-rule alias and the rule id
+    assert run("  # graftlint: allow-hole(router owns DEL)") == []
+    assert run("  # graftlint: allow-g020(router owns DEL)") == []
+    # a DIFFERENT rule's alias does not
+    assert [f.rule for f in
+            run("  # graftlint: allow-drift(router owns DEL)")] == ["G020"]
+
+
+def test_tier_e_rules_registered():
+    for rule in ("G019", "G020", "G021", "G022"):
+        assert rule in RULES
+        assert tier_of(rule) == "e"
+    for alias in ("drift", "hole", "replay", "arbiter"):
+        assert alias in SUPPRESS_ALIASES
+    assert tier_of("G018") == "d"
+
+
+def test_tier_e_findings_are_baselinable():
+    from tools.graftlint import contracts
+
+    findings, sources, _ = contracts.analyze(
+        **{**_contract_universe(),
+           "cluster_kinds": frozenset({"warp_flip"})})
+    assert [f.rule for f in findings] == ["G019"]
+    lines = sources.get(findings[0].file, [])
+    text = lines[findings[0].line - 1] if findings[0].line <= len(lines) else ""
+    d = findings[0].to_dict(text)
+    assert d["fingerprint"]
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        bl = os.path.join(td, "bl.json")
+        baseline_mod.write(bl, [d])
+        assert d["fingerprint"] in baseline_mod.load(bl)
+        with open(bl) as fh:
+            data = json.load(fh)
+        assert data["version"] == 3
+        assert [e["fingerprint"] for e in data["tiers"]["e"]] == \
+            [d["fingerprint"]]
+
+
+def test_seeded_g002_survives_e_only_baseline_update():
+    # The satellite-3 pin: `--update-baseline --tier e` must not launder a
+    # Tier A regression into the baseline, and v1/v2 files still load.
+    from tools.graftlint.cli import collect_full
+
+    a_src = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def count(bits):
+            return int(jnp.sum(bits, axis=0)[0])
+    """)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        pa = os.path.join(td, "hot.py")
+        with open(pa, "w") as fh:
+            fh.write(a_src)
+        dicts, _ = collect_full([pa], jaxpr=False, repo_root=td)
+        by_rule = {d["rule"]: d for d in dicts}
+        assert "G002" in by_rule
+        e_dict = {"rule": "G019", "file": "redisson_tpu/commands.py",
+                  "line": 1, "message": "seeded", "hint": "",
+                  "fingerprint": "feedc0de00000000"}
+        bl = os.path.join(td, "bl.json")
+
+        # An e-only update must NOT baseline the seeded G002 ...
+        baseline_mod.write(bl, dicts + [e_dict], tiers=("e",))
+        grand = baseline_mod.load(bl)
+        assert e_dict["fingerprint"] in grand
+        assert by_rule["G002"]["fingerprint"] not in grand
+
+        # ... and once tier A holds entries, an e-only rewrite keeps them.
+        baseline_mod.write(bl, dicts)
+        assert by_rule["G002"]["fingerprint"] in baseline_mod.load(bl)
+        baseline_mod.write(bl, [e_dict], tiers=("e",))
+        grand2 = baseline_mod.load(bl)
+        assert by_rule["G002"]["fingerprint"] in grand2
+        assert e_dict["fingerprint"] in grand2
+
+
+def test_baseline_v2_format_still_loads():
+    # A pre-Tier-E baseline (version 2, no "e" section) must keep loading.
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        bl = os.path.join(td, "bl.json")
+        with open(bl, "w") as fh:
+            json.dump({"version": 2,
+                       "tiers": {"a": [{"fingerprint": "aaa111"}],
+                                 "d": [{"fingerprint": "ddd444"}]}}, fh)
+        grand = baseline_mod.load(bl)
+        assert {"aaa111", "ddd444"} <= grand
+
+
+def test_repo_tier_e_clean():
+    from tools.graftlint.contracts import analyze
+
+    findings, _, stats = analyze()
+    assert findings == [], (
+        "graftlint Tier E findings — the op contract drifted; fix the "
+        "registry or declare a reasoned escape:\n"
+        + "\n".join(f"{f.file}:{f.line} {f.rule} {f.message}"
+                    for f in findings)
+    )
+    assert stats["kinds"] > 100
+    assert stats["surfaces"]["wire"] >= 14
+    assert stats["declared_cells"] >= 14
+
+
+def test_tier_e_covers_live_registries():
+    # The default gather() must see the real registries, not stand-ins.
+    from redisson_tpu.cluster.shard import CLUSTER_KINDS
+    from redisson_tpu.geo.applier import SHIP_KINDS
+    from tools.graftlint.contracts import gather
+
+    u = gather()
+    assert u["cluster_kinds"] == CLUSTER_KINDS
+    assert u["ship_kinds"] == SHIP_KINDS
+    assert "hll_add" in u["wire_kinds"]        # wire AST extraction
+    assert "bitset_clear" in u["wire_kinds"]   # incl. conditional-kind SETBIT
+    assert "hll_add" in u["facade_kinds"]      # facade AST extraction
+    assert "delete" in u["applier_local_branches"]
+    assert "geo_merge" in u["applier_rebuild_branches"]
+    assert "hll_add" in u["foldable_kinds"]
+
+
+def test_cli_json_tier_e_block():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-jaxpr",
+         os.path.join(ENGINE_DIR, "interop", "pool.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["findings"] == []
+    assert set(payload["tier_e"]["rules"]) == {"G019", "G020", "G021", "G022"}
+    assert all(v == 0 for v in payload["tier_e"]["rules"].values())
+    assert payload["tier_e"]["kinds"] > 100
+    assert payload["tier_e"]["declared_cells"] >= 14
+
+
+def test_cli_no_contracts_skips_tier_e():
+    out = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--json", "--no-jaxpr",
+         "--no-contracts", os.path.join(ENGINE_DIR, "interop", "pool.py")],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["tier_e"]["kinds"] == 0
+    assert payload["tier_e"]["declared_cells"] == 0

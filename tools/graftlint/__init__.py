@@ -1,4 +1,4 @@
-"""graftlint: three-tier static analysis for the redisson_tpu engine.
+"""graftlint: five-tier static analysis for the redisson_tpu engine.
 
 Tier A (`astlint`) is an AST pass over the source with rules G001-G010
 for the engine's real failure modes (int32 reduction overflow, implicit
@@ -9,7 +9,14 @@ leaks and reduction-crossing narrowing. Tier C (`concurrency`) checks
 lock discipline over the threaded service stack: guarded-by registry
 violations (G011), unguarded shared mutation (G012), blocking-under-lock
 (G013), and static lock-order cycles (G014); its runtime complement is
-the OrderedLock witness in ``redisson_tpu/concurrency.py``.
+the OrderedLock witness in ``redisson_tpu/concurrency.py``. Tier D
+(`asynclint`) covers asyncio/event-loop discipline (G015-G018) with the
+loop-stall witness as its runtime half. Tier E (`contracts`) is
+whole-program: it checks the distributed op contract — every
+per-subsystem kind registry against the OP_TABLE (G019), client/wire
+surface coverage (G020), journal replay dispatch (G021), and geo LWW
+arbitration completeness (G022); its runtime complement is the contract
+coverage witness in ``redisson_tpu/contractwitness.py``.
 
 CLI: ``python -m tools.graftlint`` (see cli.py). Programmatic use:
 ``run_lint(paths)`` returns finding dicts; ``collect_full(paths)`` also

@@ -10,11 +10,12 @@ Fingerprints hash (rule, file, normalized source line) so edits elsewhere
 in the file don't invalidate entries; moving or editing the flagged line
 does, on purpose.
 
-Format v2 keeps a section per tier (`{"version": 2, "tiers": {"a": [...],
-"b": [...], "c": [...], "d": [...]}}`) so `--update-baseline --tier d`
-rewrites only the Tier D section: adopting a new tier can never silently
-re-baseline a regression in an older tier. v1 flat files
-(`{"findings": [...]}`) still load.
+Format v3 keeps a section per tier (`{"version": 3, "tiers": {"a": [...],
+"b": [...], "c": [...], "d": [...], "e": [...]}}`) so `--update-baseline
+--tier e` rewrites only the Tier E section: adopting a new tier can never
+silently re-baseline a regression in an older tier. v2 files (no "e"
+section) and v1 flat files (`{"findings": [...]}`) still load — missing
+sections normalize to empty, and v1 entries are routed by `tier_of`.
 """
 
 from __future__ import annotations
@@ -24,7 +25,7 @@ import os
 
 from .findings import tier_of
 
-TIERS = ("a", "b", "c", "d")
+TIERS = ("a", "b", "c", "d", "e")
 
 
 def _read(path: str) -> dict:
@@ -75,7 +76,7 @@ def write(path: str, finding_dicts: list[dict],
                 "note": d["message"],
             })
     data = {
-        "version": 2,
+        "version": 3,
         "tiers": {t: (fresh[t] if t in selected else existing[t])
                   for t in TIERS},
     }

@@ -2,20 +2,23 @@
 
     python -m tools.graftlint [paths ...] [--json] [--no-jaxpr]
                               [--no-concurrency] [--no-async]
+                              [--no-contracts]
                               [--baseline FILE] [--update-baseline]
-                              [--tier {a,b,c,d}]
+                              [--tier {a,b,c,d,e}]
 
 Exit codes: 0 clean (or baselined-only), 1 findings, 2 internal error.
 Default target is the repo's ``redisson_tpu/`` tree with the committed
 baseline; Tier B (jaxpr audit) runs unless ``--no-jaxpr``; Tier C
 (concurrency discipline: G011-G014) runs unless ``--no-concurrency``;
 Tier D (asyncio/event-loop discipline: G015-G018) runs unless
-``--no-async``. ``--json`` output carries a ``tier_c`` block (per-rule
-counts + the static lock-order graph) and a ``tier_d`` block (per-rule
-counts + scoped-module stats). ``--update-baseline`` rewrites the whole
-baseline by default; ``--tier`` (repeatable) restricts the rewrite to
-that tier's section so adopting one tier cannot re-baseline another's
-regressions.
+``--no-async``; Tier E (whole-program op-contract: G019-G022) runs
+unless ``--no-contracts``. ``--json`` output carries a ``tier_c`` block
+(per-rule counts + the static lock-order graph), a ``tier_d`` block
+(per-rule counts + scoped-module stats) and a ``tier_e`` block
+(per-rule counts + op-universe / surface stats). ``--update-baseline``
+rewrites the whole baseline by default; ``--tier`` (repeatable)
+restricts the rewrite to that tier's section so adopting one tier
+cannot re-baseline another's regressions.
 """
 
 from __future__ import annotations
@@ -35,37 +38,44 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 TIER_C_RULES = ("G011", "G012", "G013", "G014")
 TIER_D_RULES = ("G015", "G016", "G017", "G018")
+TIER_E_RULES = ("G019", "G020", "G021", "G022")
 
 
 def collect(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
-            asynciol=True):
+            asynciol=True, contracts=True):
     """Run all tiers; returns finding dicts (with fingerprints). The
     long-standing programmatic surface (`run_lint`) — see collect_tiers
-    for the tier_c/tier_d stat blocks."""
+    for the tier_c/tier_d/tier_e stat blocks."""
     dicts, _ = collect_full(paths, jaxpr=jaxpr, concurrency=concurrency,
-                            repo_root=repo_root, asynciol=asynciol)
+                            repo_root=repo_root, asynciol=asynciol,
+                            contracts=contracts)
     return dicts
 
 
 def collect_full(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
-                 asynciol=True):
+                 asynciol=True, contracts=True):
     """Compat wrapper: returns (finding dicts, tier_c block)."""
     dicts, tiers = collect_tiers(paths, jaxpr=jaxpr, concurrency=concurrency,
-                                 repo_root=repo_root, asynciol=asynciol)
+                                 repo_root=repo_root, asynciol=asynciol,
+                                 contracts=contracts)
     return dicts, tiers["tier_c"]
 
 
 def collect_tiers(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
-                  asynciol=True):
+                  asynciol=True, contracts=True):
     """Run all tiers; returns (finding dicts with fingerprints,
     {"tier_c": per-rule counts + lock-order graph,
-     "tier_d": per-rule counts + scoped-module stats})."""
+     "tier_d": per-rule counts + scoped-module stats,
+     "tier_e": per-rule counts + op-universe/surface stats})."""
     findings, linters = lint_paths(paths, repo_root=repo_root)
     sources = {lt.relpath: lt.lines for lt in linters}
     tier_c = {"rules": {r: 0 for r in TIER_C_RULES},
               "lock_graph": {"edges": [], "cycles": []}}
     tier_d = {"rules": {r: 0 for r in TIER_D_RULES},
               "modules": 0, "async_defs": 0, "confined_keys": 0}
+    tier_e = {"rules": {r: 0 for r in TIER_E_RULES},
+              "kinds": 0, "write_kinds": 0, "surfaces": {},
+              "declared_cells": 0}
     if concurrency:
         from .concurrency import analyze_paths
 
@@ -92,6 +102,15 @@ def collect_tiers(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
         for f in d_findings:
             if f.rule in tier_d["rules"]:
                 tier_d["rules"][f.rule] += 1
+    if contracts:
+        from .contracts import analyze as analyze_contracts
+
+        e_findings, e_sources, e_stats = analyze_contracts(
+            repo_root=repo_root)
+        findings += e_findings
+        for rel, lines in e_sources.items():
+            sources.setdefault(rel, lines)
+        tier_e.update(e_stats)
     if jaxpr:
         from .jaxpr_audit import run_audits
 
@@ -102,7 +121,7 @@ def collect_tiers(paths, jaxpr=True, concurrency=True, repo_root=REPO_ROOT,
         text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
         out.append(f.to_dict(text))
     out.sort(key=lambda d: (d["file"], d["line"], d["rule"]))
-    return out, {"tier_c": tier_c, "tier_d": tier_d}
+    return out, {"tier_c": tier_c, "tier_d": tier_d, "tier_e": tier_e}
 
 
 def run(argv=None) -> int:
@@ -125,6 +144,10 @@ def run(argv=None) -> int:
     ap.add_argument("--no-async", action="store_true", dest="no_async",
                     help="skip Tier D (asyncio/event-loop discipline: "
                          "loop-block, unawaited, loop-affinity, handoff)")
+    ap.add_argument("--no-contracts", action="store_true",
+                    help="skip Tier E (whole-program op-contract: registry "
+                         "drift, surface holes, replay safety, geo "
+                         "arbitration completeness)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered fingerprints")
     ap.add_argument("--update-baseline", action="store_true",
@@ -138,12 +161,14 @@ def run(argv=None) -> int:
     try:
         dicts, tiers = collect_tiers(args.paths, jaxpr=not args.no_jaxpr,
                                      concurrency=not args.no_concurrency,
-                                     asynciol=not args.no_async)
+                                     asynciol=not args.no_async,
+                                     contracts=not args.no_contracts)
     except Exception as exc:  # noqa: BLE001
         print(f"graftlint: internal error: {type(exc).__name__}: {exc}",
               file=sys.stderr)
         return 2
     tier_c, tier_d = tiers["tier_c"], tiers["tier_d"]
+    tier_e = tiers["tier_e"]
 
     if args.update_baseline:
         baseline_mod.write(args.baseline, dicts,
@@ -160,7 +185,7 @@ def run(argv=None) -> int:
     if args.as_json:
         print(json.dumps(
             {"findings": fresh, "baselined": baselined,
-             "tier_c": tier_c, "tier_d": tier_d},
+             "tier_c": tier_c, "tier_d": tier_d, "tier_e": tier_e},
             indent=2))
     else:
         for d in fresh:
@@ -174,5 +199,8 @@ def run(argv=None) -> int:
               f"lock-order graph: {nedges} edge(s), {ncycles} cycle(s); "
               f"tier D: {tier_d['modules']} module(s), "
               f"{tier_d['async_defs']} async def(s), "
-              f"{tier_d['confined_keys']} confined key(s)")
+              f"{tier_d['confined_keys']} confined key(s); "
+              f"tier E: {tier_e['kinds']} kind(s), "
+              f"{tier_e['write_kinds']} write, "
+              f"{tier_e['declared_cells']} declared cell(s)")
     return 1 if fresh else 0

@@ -3,7 +3,9 @@
 Rule ids are stable (baseline fingerprints embed them). Tier A (AST) rules
 are G001-G010; tier B (jaxpr) rules are J0xx; tier C (concurrency) rules
 are G011-G014; tier D (asyncio/event-loop discipline) rules are
-G015-G018. Each rule has a short alias usable
+G015-G018; tier E (whole-program op-contract) rules are G019-G022, which
+also honor the tier-wide `allow-contract(reason)`. Each rule has a short
+alias usable
 in suppression comments: `# graftlint: allow-<alias>(reason)` — a reason is
 mandatory, an empty `allow-sync()` does not suppress.
 """
@@ -152,6 +154,34 @@ RULES = {
         "done-callback — the callback runs on the resolving executor "
         "thread, not the loop",
     ),
+    "G019": (
+        "drift",
+        "registry drift: a per-subsystem kind registry (geo semilattice/"
+        "destructive/ship sets, cluster ownership kinds, delta "
+        "COALESCE_GROUPS, replica READ_KINDS, the G007 write set) "
+        "disagrees with the OP_TABLE — an op the vocabulary defines one "
+        "way and a subsystem treats another",
+    ),
+    "G020": (
+        "hole",
+        "surface hole: a kind reachable from the client facade that "
+        "OP_TABLE doesn't define, a facade read kind the replica router "
+        "can't classify, or a tpu-tier kind with a RESP analogue that "
+        "the wire command table doesn't serve and no "
+        "engine-only(why)/internal(why) contract escape declares",
+    ),
+    "G021": (
+        "replay",
+        "replay safety: a journaled write kind whose declared tiers have "
+        "no _op_<kind> replay handler — crash recovery and followers "
+        "would raise 'unknown op kind' and drop the write",
+    ),
+    "G022": (
+        "arbiter",
+        "arbitration completeness: a destructive geo kind with no LWW "
+        "branch in GeoApplier.note_local, or a geo_* apply kind absent "
+        "from the rebuild stamp fold — silent cross-site divergence",
+    ),
     "J001": ("x64", "64-bit dtype (int64/uint64/float64) appears in a traced jaxpr"),
     "J002": ("narrow", "convert_element_type narrows an integer across a reduction"),
     "J000": ("trace", "op failed to trace during the jaxpr audit"),
@@ -160,13 +190,16 @@ RULES = {
 
 def tier_of(rule: str) -> str:
     """Baseline section for a rule id: 'a' (AST G001-G010), 'b' (jaxpr
-    J0xx), 'c' (concurrency G011-G014), 'd' (asyncio G015-G018)."""
+    J0xx), 'c' (concurrency G011-G014), 'd' (asyncio G015-G018), 'e'
+    (op-contract G019-G022)."""
     if rule.startswith("J"):
         return "b"
     try:
         n = int(rule[1:])
     except ValueError:
         return "a"
+    if n >= 19:
+        return "e"
     if n >= 15:
         return "d"
     if n >= 11:
